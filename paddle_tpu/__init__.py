@@ -1,0 +1,53 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle (reference layout: python/paddle/fluid).
+
+Front-end: declarative Program/Block/Op IR (like fluid). Back-end: the
+Executor lowers whole programs through JAX to single XLA computations;
+parallelism is SPMD over a jax.sharding.Mesh (paddle_tpu.parallel).
+
+Typical flow (identical to the reference's fluid API):
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name='x', shape=[13])
+    y = fluid.layers.data(name='y', shape=[1])
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': ..., 'y': ...}, fetch_list=[loss])
+"""
+
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import learning_rate_decay  # noqa: F401
+from . import nets  # noqa: F401
+from . import io  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from . import backward  # noqa: F401
+from . import parallel  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+
+from .core.backward import append_backward  # noqa: F401
+from .core.executor import Executor  # noqa: F401
+from .core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from .core.program import (Program, Variable, default_main_program,  # noqa
+                           default_startup_program, program_guard,
+                           reset_default_programs, switch_main_program,
+                           switch_startup_program)
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .core import unique_name  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+__version__ = '0.1.0'
+
+# Drop-in familiarity: scripts written against the reference often do
+# `import paddle.fluid as fluid`; `paddle_tpu` IS the fluid-level namespace.
+fluid = __import__(__name__)

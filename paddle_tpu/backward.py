@@ -1,0 +1,3 @@
+"""Re-export of core.backward (reference: python/paddle/fluid/backward.py)."""
+
+from .core.backward import append_backward, grad_var_name  # noqa: F401

@@ -1,0 +1,134 @@
+"""Gradient / error clipping (reference: python/paddle/fluid/clip.py)."""
+
+from .layers.helper import LayerHelper
+
+__all__ = ['ErrorClipByValue', 'GradientClipByValue', 'GradientClipByNorm',
+           'GradientClipByGlobalNorm', 'append_gradient_clip_ops',
+           'set_gradient_clip', 'error_clip_callback']
+
+
+class BaseErrorClipAttr(object):
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(type='clip', inputs={'X': [grad_name]},
+                        outputs={'Out': [grad_name]},
+                        attrs={'min': self.min, 'max': self.max})
+
+
+def error_clip_callback(block, op_desc):
+    pass
+
+
+class BaseGradientClipAttr(object):
+    def create_operators(self, param, grad, helper):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def create_operators(self, param, grad, helper):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def create_operators(self, param, grad, helper):
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        out.shape = grad.shape
+        out.stop_gradient = True
+        helper.append_op(type='clip', inputs={'X': [grad]},
+                         outputs={'Out': [out]},
+                         attrs={'min': self.min, 'max': self.max})
+        return param, out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def create_operators(self, param, grad, helper):
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        out.shape = grad.shape
+        out.stop_gradient = True
+        helper.append_op(type='clip_by_norm', inputs={'X': [grad]},
+                         outputs={'Out': [out]},
+                         attrs={'max_norm': self.clip_norm})
+        return param, out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Global-norm clip. TPU-native: ONE fused op over all grads (the
+    reference builds a chain of square/sum ops per grad)."""
+
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self._pending = []
+
+    def create_operators(self, param, grad, helper):
+        # Defer: collect all grads, emit one fused op at the end.
+        self._pending.append((param, grad))
+        return param, grad
+
+    def flush(self, helper):
+        if not self._pending:
+            return []
+        grads = [g for _, g in self._pending]
+        outs = []
+        for _, g in self._pending:
+            o = helper.create_variable_for_type_inference(g.dtype)
+            o.shape = g.shape
+            o.stop_gradient = True
+            outs.append(o)
+        helper.append_op(type='global_norm_clip',
+                         inputs={'X': grads},
+                         outputs={'Out': outs},
+                         attrs={'max_global_norm': self.clip_norm})
+        result = [(p, o) for (p, _), o in zip(self._pending, outs)]
+        self._pending = []
+        return result
+
+
+_gradient_clip_attr = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _gradient_clip_attr
+    _gradient_clip_attr = clip
+    if param_list is not None:
+        for p in param_list:
+            if hasattr(p, 'gradient_clip_attr'):
+                p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    helper = LayerHelper('gradient_clip')
+    res = []
+    global_clips = {}
+    for p, g in param_grads:
+        clip_attr = getattr(p, 'gradient_clip_attr', None) or \
+            _gradient_clip_attr
+        if clip_attr is None:
+            res.append((p, g))
+            continue
+        if isinstance(clip_attr, GradientClipByGlobalNorm):
+            key = clip_attr.group_name
+            global_clips.setdefault(key, clip_attr)
+            clip_attr.create_operators(p, g, helper)
+        else:
+            res.append(clip_attr.create_operators(p, g, helper))
+    for clip_attr in global_clips.values():
+        res.extend(clip_attr.flush(helper))
+    return res

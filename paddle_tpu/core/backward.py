@@ -1,0 +1,59 @@
+"""append_backward (reference: python/paddle/fluid/backward.py).
+
+The reference appends explicit grad ops per forward op (backward.cc
+transpiles OpDesc -> grad OpDesc). TPU-native design: autodiff is delegated
+to jax.value_and_grad over the traced forward section, which XLA then fuses
+with the forward. append_backward therefore records a single
+``backward_marker`` op carrying (loss, params, grad var names); the Executor
+splits the op list there, differentiates the prefix, and seeds ``p@GRAD``
+variables for the suffix (regularizers, clips, optimizer update ops) to
+consume — identical dataflow to the reference, one XLA computation.
+"""
+
+from .program import Parameter
+
+GRAD_SUFFIX = '@GRAD'
+
+
+def grad_var_name(name):
+    return name + GRAD_SUFFIX
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append the backward section for ``loss``.
+
+    Returns list of (param_var, grad_var) like the reference.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad_set = set(no_grad_set or [])
+    no_grad_names = set(v if isinstance(v, str) else v.name
+                        for v in no_grad_set)
+
+    if parameter_list is not None:
+        names = [p if isinstance(p, str) else p.name for p in parameter_list]
+        params = [block.var(n) for n in names]
+    else:
+        params = program.all_parameters()
+    params = [p for p in params
+              if isinstance(p, Parameter) and p.trainable
+              and not p.stop_gradient and p.name not in no_grad_names]
+    if not params:
+        raise ValueError('append_backward: no trainable parameters found')
+
+    params_and_grads = []
+    for p in params:
+        g = block.create_var(name=grad_var_name(p.name), shape=p.shape,
+                             dtype=p.dtype)
+        g.stop_gradient = True
+        params_and_grads.append((p, g))
+
+    block.append_op(
+        type='backward_marker',
+        inputs={'Loss': [loss.name]},
+        outputs={'Grads': [g.name for _, g in params_and_grads]},
+        attrs={'param_names': [p.name for p, _ in params_and_grads],
+               'grad_names': [g.name for _, g in params_and_grads],
+               'loss_name': loss.name})
+    return params_and_grads

@@ -1,0 +1,58 @@
+"""Dtype handling for the paddle_tpu IR.
+
+The IR stores dtypes as canonical strings; lowering converts to jnp dtypes.
+Mirrors the reference's ``paddle/fluid/framework/data_type.h`` enum
+(FP16/FP32/FP64/INT16/INT32/INT64/BOOL/UINT8) with bfloat16 added as the
+TPU-preferred half precision.
+"""
+
+import numpy as np
+
+_CANONICAL = {
+    'float16': 'float16',
+    'fp16': 'float16',
+    'bfloat16': 'bfloat16',
+    'bf16': 'bfloat16',
+    'float32': 'float32',
+    'fp32': 'float32',
+    'float': 'float32',
+    'float64': 'float64',
+    'fp64': 'float64',
+    'double': 'float64',
+    'int8': 'int8',
+    'uint8': 'uint8',
+    'int16': 'int16',
+    'int32': 'int32',
+    'int': 'int32',
+    'int64': 'int64',
+    'long': 'int64',
+    'bool': 'bool',
+}
+
+
+def canonical_dtype(dtype):
+    """Normalize a user-provided dtype (string / numpy dtype) to a canonical string."""
+    if dtype is None:
+        return 'float32'
+    if isinstance(dtype, str):
+        key = dtype.lower()
+    else:
+        try:
+            key = np.dtype(dtype).name
+        except TypeError:
+            key = str(dtype)
+    if key not in _CANONICAL:
+        raise ValueError('Unsupported dtype: %r' % (dtype,))
+    return _CANONICAL[key]
+
+
+def to_jnp_dtype(dtype):
+    import jax.numpy as jnp
+    name = canonical_dtype(dtype)
+    if name == 'bfloat16':
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def is_float_dtype(dtype):
+    return canonical_dtype(dtype) in ('float16', 'bfloat16', 'float32', 'float64')

@@ -1,0 +1,49 @@
+"""Device places (reference: paddle/fluid/platform/place.h CPUPlace/CUDAPlace).
+
+TPU-native: TPUPlace maps onto a jax TPU device; CPUPlace onto the host
+platform. A place resolves lazily so that importing paddle_tpu never forces
+jax backend initialization.
+"""
+
+
+class Place(object):
+    device_kind = None
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return '%s(%d)' % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        """Resolve to a concrete jax device, or None to use the default."""
+        import jax
+        kind = self.device_kind
+        devs = [d for d in jax.devices() if d.platform == kind]
+        if not devs:
+            if kind == 'tpu':
+                # Fall back to whatever the default backend offers (e.g. the
+                # 8-virtual-device CPU mesh used in tests).
+                devs = jax.devices()
+            else:
+                devs = jax.devices('cpu')
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    device_kind = 'cpu'
+
+
+class TPUPlace(Place):
+    """The TPU analog of the reference's CUDAPlace (platform/place.h:60)."""
+    device_kind = 'tpu'
+
+
+# Alias kept for scripts written against the reference's naming.
+CUDAPlace = TPUPlace

@@ -1,0 +1,101 @@
+"""Op lowering registry.
+
+Reference analog: paddle/fluid/framework/op_registry.h (REGISTER_OP_KERNEL).
+Instead of per-device C++ kernels, each op type registers ONE lowering
+function that maps traced jax values -> traced jax values; the Executor
+composes lowerings for a whole Program and jits the result, so XLA performs
+fusion/placement (there is no per-op dispatch at run time).
+"""
+
+OP_LOWERINGS = {}
+
+
+def register(op_type):
+    def deco(fn):
+        if op_type in OP_LOWERINGS:
+            raise ValueError('duplicate lowering for op %r' % op_type)
+        OP_LOWERINGS[op_type] = fn
+        return fn
+    return deco
+
+
+def get_lowering(op_type):
+    fn = OP_LOWERINGS.get(op_type)
+    if fn is None:
+        raise NotImplementedError(
+            'No TPU lowering registered for op type %r. Known ops: %s' %
+            (op_type, ', '.join(sorted(OP_LOWERINGS))))
+    return fn
+
+
+class LoweringContext(object):
+    """Execution context handed to each op lowering.
+
+    env      : dict var name -> traced jax value
+    op       : the Operator being lowered
+    block    : Block for var metadata lookups
+    rng      : per-op PRNG key factory (stable across steps given base key)
+    """
+
+    def __init__(self, env, op, block, op_index, base_key, is_test=False):
+        self.env = env
+        self.op = op
+        self.block = block
+        self.op_index = op_index
+        self._base_key = base_key
+        self.is_test = is_test
+
+    # ---- inputs / outputs ----
+    def input(self, slot):
+        name = self.op.input(slot)
+        if name is None:
+            return None
+        return self.env[name]
+
+    def input_list(self, slot):
+        return [self.env[n] for n in self.op.inputs.get(slot, [])]
+
+    def has_input(self, slot):
+        names = self.op.inputs.get(slot, [])
+        return bool(names) and names[0] in self.env
+
+    def set_output(self, slot, value):
+        name = self.op.output(slot)
+        if name is None:
+            return
+        var = self.block._find_var_recursive(name)
+        if var is not None and var.stop_gradient:
+            import jax
+            value = jax.lax.stop_gradient(value)
+        self.env[name] = value
+
+    def set_output_list(self, slot, values):
+        names = self.op.outputs.get(slot, [])
+        for name, value in zip(names, values):
+            var = self.block._find_var_recursive(name)
+            if var is not None and var.stop_gradient:
+                import jax
+                value = jax.lax.stop_gradient(value)
+            self.env[name] = value
+
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def out_var(self, slot):
+        name = self.op.output(slot)
+        return self.block._find_var_recursive(name) if name else None
+
+    def in_var(self, slot):
+        name = self.op.input(slot)
+        return self.block._find_var_recursive(name) if name else None
+
+    # ---- randomness ----
+    def rng_key(self):
+        """A PRNG key unique to this op instance, folded from the step key."""
+        import jax
+        return jax.random.fold_in(self._base_key, self.op_index)
+
+    def out_dtype(self, slot, default='float32'):
+        var = self.out_var(slot)
+        from .dtypes import to_jnp_dtype
+        return to_jnp_dtype(var.dtype if var is not None else default)

@@ -1,0 +1,81 @@
+"""Scope: runtime variable store (reference: paddle/fluid/framework/scope.{h,cc}).
+
+Holds name -> array (numpy or jax.Array). Persistable program variables
+(parameters, optimizer accumulators, learning rate, batch-norm statistics)
+live here between Executor.run calls. Values stay on device as jax.Arrays to
+avoid host<->HBM round trips; only fetched vars are pulled to host.
+"""
+
+import numpy as np
+
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self._parent = parent
+
+    def var(self, name):
+        """Get-or-create slot for name (mirrors Scope::Var)."""
+        if name not in self._vars and (self._parent is None or
+                                       self._parent.find(name) is None):
+            self._vars[name] = None
+        return name
+
+    def find(self, name):
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.find(name)
+        return None
+
+    def has(self, name):
+        return name in self._vars or (self._parent is not None and
+                                      self._parent.has(name))
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name):
+        value = self.find(name)
+        if value is None:
+            raise KeyError('Variable %r has no value in scope (did you run '
+                           'the startup program?)' % name)
+        return value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        return Scope(parent=self)
+
+    def keys(self):
+        return list(self._vars.keys())
+
+    def numpy(self, name):
+        return np.asarray(self.get(name))
+
+    def clear(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return _guard()

@@ -1,0 +1,17 @@
+"""Datasets (reference: python/paddle/v2/dataset).
+
+Zero-egress environment: each module first looks for cached files under
+$PADDLE_TPU_DATA (or ~/.cache/paddle_tpu); when absent it falls back to a
+deterministic synthetic generator with the same schema/cardinality so
+models, tests, and benchmarks run anywhere.
+"""
+
+from . import common  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import ctr  # noqa: F401

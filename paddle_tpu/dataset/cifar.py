@@ -1,0 +1,42 @@
+"""CIFAR-10/100 (reference: python/paddle/v2/dataset/cifar.py)."""
+
+import numpy as np
+
+from . import common
+
+_TRAIN_N = 4096
+_TEST_N = 1024
+
+
+def _synthetic(name, split, n, num_classes):
+    r = common.rng(name, split)
+    t = common.rng(name, 'templates').rand(num_classes, 3, 32, 32) \
+        .astype('float32')
+    labels = r.randint(0, num_classes, size=n)
+    imgs = t[labels] + 0.2 * r.randn(n, 3, 32, 32).astype('float32')
+    imgs = np.clip(imgs, 0.0, 1.0).astype('float32')
+    return imgs.reshape(n, 3 * 32 * 32), labels.astype('int64')
+
+
+def _reader(name, split, n, num_classes):
+    def reader():
+        xs, ys = _synthetic(name, split, n, num_classes)
+        for i in range(len(xs)):
+            yield xs[i], int(ys[i])
+    return reader
+
+
+def train10():
+    return _reader('cifar10', 'train', _TRAIN_N, 10)
+
+
+def test10():
+    return _reader('cifar10', 'test', _TEST_N, 10)
+
+
+def train100():
+    return _reader('cifar100', 'train', _TRAIN_N, 100)
+
+
+def test100():
+    return _reader('cifar100', 'test', _TEST_N, 100)

@@ -1,0 +1,40 @@
+"""Shared dataset helpers (reference: python/paddle/v2/dataset/common.py)."""
+
+import hashlib
+import os
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    'PADDLE_TPU_DATA',
+    os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu', 'dataset'))
+
+
+def cached_path(category, filename):
+    return os.path.join(DATA_HOME, category, filename)
+
+
+def has_cached(category, filename):
+    return os.path.exists(cached_path(category, filename))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def rng(name, split):
+    """Deterministic per-(dataset, split) generator for synthetic data."""
+    seed = int(hashlib.md5(('%s/%s' % (name, split)).encode()).hexdigest()[:8],
+               16)
+    return np.random.RandomState(seed)
+
+
+def download(url, category, md5sum=None):
+    raise RuntimeError(
+        'Network access is unavailable in this environment. Place the file '
+        'for %r under %s, or use the synthetic fallback (automatic).' %
+        (category, os.path.join(DATA_HOME, category)))

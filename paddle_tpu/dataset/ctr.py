@@ -1,0 +1,40 @@
+"""CTR dataset for Wide&Deep (analog of the reference's high-dim sparse
+CTR workloads served by paddle/pserver; schema mirrors Criteo: 13 dense
+ints + 26 categorical hashes -> click)."""
+
+import numpy as np
+
+from . import common
+
+DENSE_DIM = 13
+SPARSE_SLOTS = 26
+HASH_DIM = 10 ** 4
+_TRAIN_N = 8192
+_TEST_N = 1024
+
+
+def _synthetic(split, n):
+    r = common.rng('ctr', split)
+    dense = r.rand(n, DENSE_DIM).astype('float32')
+    sparse = r.randint(0, HASH_DIM, size=(n, SPARSE_SLOTS)).astype('int64')
+    w_d = common.rng('ctr', 'wd').randn(DENSE_DIM) * 0.5
+    w_s = common.rng('ctr', 'ws').randn(HASH_DIM) * 0.1
+    logit = dense @ w_d + w_s[sparse].sum(axis=1) - 1.0
+    click = (1.0 / (1.0 + np.exp(-logit)) > r.rand(n)).astype('int64')
+    return dense, sparse, click
+
+
+def _reader(split, n):
+    def reader():
+        dense, sparse, click = _synthetic(split, n)
+        for i in range(n):
+            yield dense[i], sparse[i], int(click[i])
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)

@@ -1,0 +1,49 @@
+"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py).
+Synthetic fallback: two token distributions (positive/negative vocab bias)
+so sentiment models separate the classes."""
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 5000
+_TRAIN_N = 2048
+_TEST_N = 512
+_MAX_LEN = 100
+
+
+def word_dict():
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _synthetic(split, n):
+    r = common.rng('imdb', split)
+    labels = r.randint(0, 2, size=n)
+    seqs = []
+    for i in range(n):
+        length = r.randint(10, _MAX_LEN)
+        # positive reviews skew to low ids, negative to high ids
+        if labels[i] == 1:
+            toks = np.minimum(r.exponential(_VOCAB / 8, length).astype(int),
+                              _VOCAB - 1)
+        else:
+            toks = _VOCAB - 1 - np.minimum(
+                r.exponential(_VOCAB / 8, length).astype(int), _VOCAB - 1)
+        seqs.append(toks.astype('int64'))
+    return seqs, labels.astype('int64')
+
+
+def _reader(split, n):
+    def reader():
+        seqs, labels = _synthetic(split, n)
+        for s, l in zip(seqs, labels):
+            yield s, int(l)
+    return reader
+
+
+def train(word_idx=None):
+    return _reader('train', _TRAIN_N)
+
+
+def test(word_idx=None):
+    return _reader('test', _TEST_N)

@@ -1,0 +1,40 @@
+"""PTB-style language-model n-grams (reference: v2/dataset/imikolov.py)."""
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 2048
+_TRAIN_N = 8192
+_TEST_N = 1024
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _synthetic(split, n, gram):
+    """Markov-ish synthetic n-grams: next word correlates with previous."""
+    r = common.rng('imikolov', split)
+    first = r.randint(0, _VOCAB, size=n)
+    rows = [first]
+    for _ in range(gram - 1):
+        nxt = (rows[-1] * 31 + 17 + r.randint(0, 64, size=n)) % _VOCAB
+        rows.append(nxt)
+    return np.stack(rows, axis=1).astype('int64')
+
+
+def _reader(split, n, gram):
+    def reader():
+        grams = _synthetic(split, n, gram)
+        for row in grams:
+            yield tuple(int(v) for v in row)
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _reader('train', _TRAIN_N, n)
+
+
+def test(word_idx=None, n=5):
+    return _reader('test', _TEST_N, n)

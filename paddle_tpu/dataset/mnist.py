@@ -1,0 +1,60 @@
+"""MNIST (reference: python/paddle/v2/dataset/mnist.py).
+Real data when cached as mnist.npz; else class-structured synthetic digits
+(each class = fixed template + noise) so LeNet actually learns."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+_TRAIN_N = 8192
+_TEST_N = 2048
+
+
+def _load_real(split):
+    path = common.cached_path('mnist', 'mnist.npz')
+    if not os.path.exists(path):
+        return None
+    data = np.load(path)
+    if split == 'train':
+        return data['x_train'], data['y_train']
+    return data['x_test'], data['y_test']
+
+
+def _templates():
+    r = common.rng('mnist', 'templates')
+    return (r.rand(10, 28, 28) > 0.72).astype('float32')
+
+
+def _synthetic(split, n):
+    r = common.rng('mnist', split)
+    t = _templates()
+    labels = r.randint(0, 10, size=n)
+    imgs = t[labels] + 0.25 * r.randn(n, 28, 28).astype('float32')
+    imgs = np.clip(imgs, 0.0, 1.0)
+    # normalize to [-1, 1] like the reference reader
+    imgs = (imgs * 2.0 - 1.0).astype('float32')
+    return imgs.reshape(n, 784), labels.astype('int64')
+
+
+def _reader(split, n):
+    def reader():
+        real = _load_real(split)
+        if real is not None:
+            xs, ys = real
+            xs = (xs.reshape(len(xs), 784).astype('float32') / 127.5) - 1.0
+            ys = ys.astype('int64')
+        else:
+            xs, ys = _synthetic(split, n)
+        for i in range(len(xs)):
+            yield xs[i], int(ys[i])
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)

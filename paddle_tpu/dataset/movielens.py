@@ -1,0 +1,54 @@
+"""MovieLens ratings (reference: v2/dataset/movielens.py)."""
+
+import numpy as np
+
+from . import common
+
+_USERS = 944
+_MOVIES = 1683
+_TRAIN_N = 8192
+_TEST_N = 1024
+
+
+def max_user_id():
+    return _USERS - 1
+
+
+def max_movie_id():
+    return _MOVIES - 1
+
+
+def max_job_id():
+    return 20
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _synthetic(split, n):
+    r = common.rng('movielens', split)
+    users = r.randint(0, _USERS, size=n)
+    movies = r.randint(0, _MOVIES, size=n)
+    u_bias = common.rng('movielens', 'ub').randn(_USERS)
+    m_bias = common.rng('movielens', 'mb').randn(_MOVIES)
+    score = 3.0 + u_bias[users] + m_bias[movies] + 0.3 * r.randn(n)
+    score = np.clip(np.round(score), 1, 5)
+    return users.astype('int64'), movies.astype('int64'), \
+        score.astype('float32')
+
+
+def _reader(split, n):
+    def reader():
+        users, movies, scores = _synthetic(split, n)
+        for u, m, s in zip(users, movies, scores):
+            yield int(u), int(m), float(s)
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)

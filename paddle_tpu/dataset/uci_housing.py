@@ -1,0 +1,37 @@
+"""UCI housing (reference: python/paddle/v2/dataset/uci_housing.py).
+13 features -> house price; synthetic fallback keeps the linear structure
+so fit_a_line converges the same way."""
+
+import numpy as np
+
+from . import common
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+_TRAIN_N = 404
+_TEST_N = 102
+
+
+def _synthetic(split, n):
+    r = common.rng('uci_housing', split)
+    w = common.rng('uci_housing', 'w').randn(13, 1) * 2.0
+    x = r.randn(n, 13).astype('float32')
+    y = (x @ w + 3.0 + 0.1 * r.randn(n, 1)).astype('float32')
+    return x, y
+
+
+def _reader(split, n):
+    def reader():
+        x, y = _synthetic(split, n)
+        for i in range(x.shape[0]):
+            yield x[i], y[i]
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)

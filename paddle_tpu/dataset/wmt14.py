@@ -1,0 +1,55 @@
+"""WMT14 en-de NMT pairs (reference: v2/dataset/wmt14.py).
+Synthetic fallback: target = deterministic per-token mapping of source
+(+BOS/EOS), so seq2seq/Transformer models can drive loss to ~0 — a real
+learnability check, like copy-task benchmarks."""
+
+import numpy as np
+
+from . import common
+
+_VOCAB = 8000
+_TRAIN_N = 4096
+_TEST_N = 512
+_MAX_LEN = 50
+
+BOS = 0
+EOS = 1
+UNK = 2
+
+
+def _map_token(tok):
+    return 3 + (tok * 7 + 11) % (_VOCAB - 3)
+
+
+def _synthetic(split, n):
+    r = common.rng('wmt14', split)
+    pairs = []
+    for _ in range(n):
+        length = r.randint(5, _MAX_LEN)
+        src = (3 + r.randint(0, _VOCAB - 3, size=length)).astype('int64')
+        trg = np.asarray([_map_token(t) for t in src], dtype='int64')
+        pairs.append((src, np.concatenate([[BOS], trg]),
+                      np.concatenate([trg, [EOS]])))
+    return pairs
+
+
+def _reader(split, n):
+    def reader():
+        for src, trg_in, trg_out in _synthetic(split, n):
+            yield src, trg_in, trg_out
+    return reader
+
+
+def train(dict_size=_VOCAB):
+    return _reader('train', _TRAIN_N)
+
+
+def test(dict_size=_VOCAB):
+    return _reader('test', _TEST_N)
+
+
+def get_dict(dict_size=_VOCAB, reverse=False):
+    word_dict = {('w%d' % i): i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in word_dict.items()}
+    return word_dict

@@ -1,0 +1,175 @@
+"""Parameter initializers (reference: python/paddle/fluid/initializer.py).
+
+Each initializer appends ONE op to the startup program that produces the
+parameter value; the Executor runs the startup program once, on device, so
+even ResNet-scale init happens as a single compiled XLA program.
+"""
+
+import math
+
+from .core.program import default_startup_program
+
+
+class Initializer(object):
+    def __call__(self, var, block=None):
+        raise NotImplementedError
+
+    @staticmethod
+    def _startup_block(block):
+        return block if block is not None else \
+            default_startup_program().global_block()
+
+    @staticmethod
+    def _fan_in_out(var):
+        shape = var.shape
+        if len(shape) < 2:
+            return (shape[0] if shape else 1,) * 2
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        receptive = 1
+        for s in shape[2:]:
+            receptive *= s
+        # conv OIHW: fan_in = I*r, fan_out = O*r
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def __call__(self, var, block=None):
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='fill_constant', outputs={'Out': [var.name]},
+                    attrs={'shape': list(var.shape), 'value': self.value})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block=None):
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='uniform_random', outputs={'Out': [var.name]},
+                    attrs={'shape': list(var.shape), 'min': self.low,
+                           'max': self.high, 'seed': self.seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='gaussian_random', outputs={'Out': [var.name]},
+                    attrs={'shape': list(var.shape), 'mean': self.loc,
+                           'std': self.scale, 'seed': self.seed})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='truncated_gaussian_random',
+                    outputs={'Out': [var.name]},
+                    attrs={'shape': list(var.shape), 'mean': self.loc,
+                           'std': self.scale, 'seed': self.seed})
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform = uniform
+        self.fan_in, self.fan_out, self.seed = fan_in, fan_out, seed
+
+    def __call__(self, var, block=None):
+        fan_in, fan_out = self._fan_in_out(var)
+        fan_in = self.fan_in if self.fan_in is not None else fan_in
+        fan_out = self.fan_out if self.fan_out is not None else fan_out
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fan_in + fan_out))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block=None):
+        fan_in, _ = self._fan_in_out(var)
+        fan_in = self.fan_in if self.fan_in is not None else fan_in
+        if self.uniform:
+            limit = math.sqrt(6.0 / fan_in)
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / fan_in)
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class BilinearInitializer(Initializer):
+    """For conv_transpose upsampling kernels (initializer.py Bilinear)."""
+
+    def __call__(self, var, block=None):
+        import numpy as np
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError('Bilinear initializer needs a 4-D weight')
+        c_out, c_in, h, w = shape
+        f = np.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype='float32')
+        og = np.ogrid[:h, :w]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        weight[range(min(c_out, c_in)), range(min(c_out, c_in)), :, :] = filt
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='assign_value', outputs={'Out': [var.name]},
+                    attrs={'values': weight.tolist(),
+                           'shape': list(shape)})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, var, block=None):
+        import numpy as np
+        b = self._startup_block(block)
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+        b.append_op(type='assign_value', outputs={'Out': [var.name]},
+                    attrs={'values': np.asarray(self.value).tolist(),
+                           'shape': list(np.asarray(self.value).shape)})
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def force_init_on_cpu():
+    return False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    yield

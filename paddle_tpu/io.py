@@ -1,0 +1,171 @@
+"""Model save/load (reference: python/paddle/fluid/io.py).
+
+Persistables (params + optimizer state + BN stats) are serialized from the
+Scope to an .npz bundle plus a JSON manifest — a single-file, orbax-free
+checkpoint format that round-trips bf16 via uint16 views.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .core.program import Parameter, default_main_program
+from .core.scope import global_scope
+
+__all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
+           'load_params', 'load_persistables', 'save_inference_model',
+           'load_inference_model', 'get_inference_program',
+           'save_checkpoint', 'load_checkpoint']
+
+_PARAMS_FILE = 'params.npz'
+_MANIFEST_FILE = 'manifest.json'
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _is_persistable(var):
+    return bool(getattr(var, 'persistable', False)) and not var.is_data
+
+
+def _to_numpy(value):
+    arr = np.asarray(value)
+    if arr.dtype.name == 'bfloat16':
+        return arr.view(np.uint16), 'bfloat16'
+    return arr, arr.dtype.name
+
+
+def _from_numpy(arr, dtype_name):
+    if dtype_name == 'bfloat16':
+        import jax.numpy as jnp
+        return np.asarray(arr).view(jnp.bfloat16)
+    return arr
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    arrays, manifest = {}, {}
+    for v in vars:
+        value = scope.find(v.name)
+        if value is None:
+            continue
+        arr, dtype_name = _to_numpy(value)
+        arrays[v.name] = arr
+        manifest[v.name] = {'dtype': dtype_name,
+                            'shape': list(np.asarray(arr).shape)}
+    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+    with open(os.path.join(dirname, _MANIFEST_FILE), 'w') as f:
+        json.dump(manifest, f, indent=1)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    if not path.endswith('.npz'):
+        path += '.npz'
+    data = np.load(path)
+    with open(os.path.join(dirname, _MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    wanted = set(v.name for v in vars)
+    for name in data.files:
+        if name not in wanted:
+            continue
+        arr = _from_numpy(data[name], manifest[name]['dtype'])
+        scope.var(name)
+        scope.set(name, arr)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    test_program = main_program.clone(for_test=True)
+    return test_program.prune(target_vars)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Serialize a pruned inference program + params (reference io.py:
+    save_inference_model / paddle/fluid/inference/io.cc)."""
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    inference_program = get_inference_program(target_vars, main_program)
+    os.makedirs(dirname, exist_ok=True)
+    from .core.serialize import program_to_dict
+    meta = {
+        'feed_names': list(feeded_var_names),
+        'fetch_names': [v.name if not isinstance(v, str) else v
+                        for v in target_vars],
+        'program': program_to_dict(inference_program),
+    }
+    with open(os.path.join(dirname,
+                           model_filename or '__model__.json'), 'w') as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, main_program,
+                      filename=params_filename)
+    return inference_program
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname,
+                           model_filename or '__model__.json')) as f:
+        meta = json.load(f)
+    from .core.serialize import program_from_dict
+    program = program_from_dict(meta['program'])
+    load_vars(executor, dirname, program, predicate=_is_persistable,
+              filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta['fetch_names']]
+    return program, meta['feed_names'], fetch_vars
+
+
+def save_checkpoint(executor, dirname, main_program=None, step=None):
+    """Full training checkpoint: every persistable incl. optimizer state."""
+    save_persistables(executor, dirname, main_program)
+    if step is not None:
+        with open(os.path.join(dirname, 'checkpoint.json'), 'w') as f:
+            json.dump({'step': int(step)}, f)
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    load_persistables(executor, dirname, main_program)
+    path = os.path.join(dirname, 'checkpoint.json')
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f).get('step')
+    return None

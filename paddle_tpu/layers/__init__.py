@@ -1,0 +1,12 @@
+"""paddle_tpu.layers — the fluid.layers-equivalent API surface."""
+
+from . import helper  # noqa: F401
+from .io import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+
+from . import io, tensor, ops, nn, sequence, control_flow, detection  # noqa

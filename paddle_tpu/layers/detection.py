@@ -1,0 +1,53 @@
+"""Detection layers (reference: fluid/layers/detection.py — SSD family).
+
+Round-1 surface: box_coder, iou_similarity, prior_box. The full SSD head
+(multi_box_head / bipartite_match / ssd_loss / detection_output) lands with
+the detection model family (SURVEY.md §7 step 8).
+"""
+
+import numpy as np
+
+from .helper import LayerHelper
+
+__all__ = ['box_coder', 'iou_similarity', 'prior_box']
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True,
+              name=None):
+    helper = LayerHelper('box_coder', name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(type='box_coder',
+                     inputs={'PriorBox': [prior_box],
+                             'PriorBoxVar': [prior_box_var],
+                             'TargetBox': [target_box]},
+                     outputs={'OutputBox': [out]},
+                     attrs={'code_type': code_type,
+                            'box_normalized': box_normalized})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper('iou_similarity', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='iou_similarity', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper('prior_box', name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='prior_box',
+                     inputs={'Input': [input], 'Image': [image]},
+                     outputs={'Boxes': [boxes], 'Variances': [variances]},
+                     attrs={'min_sizes': list(min_sizes),
+                            'max_sizes': list(max_sizes or []),
+                            'aspect_ratios': list(aspect_ratios),
+                            'variances': list(variance), 'flip': flip,
+                            'clip': clip, 'steps': list(steps),
+                            'offset': offset})
+    return boxes, variances

@@ -1,0 +1,24 @@
+"""IO layers (reference: python/paddle/fluid/layers/io.py).
+
+`data` declares a feed slot. The reference's ListenAndServ/Send pserver ops
+have no TPU analog — distribution is SPMD via paddle_tpu.parallel — but
+thin wrappers are provided that lower to mesh collectives for parity.
+"""
+
+from ..core.dtypes import canonical_dtype
+from .helper import LayerHelper
+
+__all__ = ['data']
+
+
+def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True,
+         type=None, stop_gradient=True):
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper.main_program.global_block().create_var(
+        name=name, shape=tuple(shape), dtype=canonical_dtype(dtype),
+        lod_level=lod_level, is_data=True)
+    var.stop_gradient = stop_gradient
+    return var

@@ -1,0 +1,684 @@
+"""Neural-net layers (reference: python/paddle/fluid/layers/nn.py).
+
+Every layer builds IR ops; no computation happens until Executor compiles
+the whole program to one XLA computation.
+"""
+
+from ..core.dtypes import canonical_dtype
+from ..initializer import Constant, Normal, Xavier
+from .helper import LayerHelper
+
+__all__ = [
+    'fc', 'embedding', 'conv2d', 'conv2d_transpose', 'pool2d', 'batch_norm',
+    'layer_norm', 'dropout', 'cross_entropy', 'square_error_cost',
+    'accuracy', 'chunk_eval', 'softmax_with_cross_entropy', 'one_hot',
+    'matmul', 'topk', 'reduce_sum', 'reduce_mean', 'reduce_max',
+    'reduce_min', 'reduce_prod', 'split', 'transpose', 'l2_normalize',
+    'cos_sim', 'smooth_l1', 'im2sequence', 'multiplex', 'label_smooth',
+    'autoincreased_step_counter', 'nce', 'auc', 'group_norm',
+    'bilinear_tensor_product', 'pad', 'relu_layer', 'maxout',
+]
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, use_mkldnn=False, name=None):
+    """Fully-connected layer (reference fluid/layers/nn.py:fc): per-input
+    mul ops + summed bias + activation. The mul lands on the MXU."""
+    helper = LayerHelper('fc', **locals())
+    dtype = helper.input_dtype()
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = helper.param_attr
+    if not isinstance(param_attrs, list):
+        param_attrs = [param_attrs] * len(inputs)
+
+    mul_results = []
+    for inp, pattr in zip(inputs, param_attrs):
+        in_shape = inp.shape
+        flat_dim = _prod(in_shape[num_flatten_dims:])
+        w = helper.create_parameter(attr=pattr, shape=[flat_dim, size],
+                                    dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        tmp.shape = tuple(in_shape[:num_flatten_dims]) + (size,)
+        helper.append_op(
+            type='mul', inputs={'X': [inp], 'Y': [w]},
+            outputs={'Out': [tmp]},
+            attrs={'x_num_col_dims': num_flatten_dims, 'y_num_col_dims': 1})
+        mul_results.append(tmp)
+
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        pre_bias.shape = mul_results[0].shape
+        helper.append_op(type='sum', inputs={'X': mul_results},
+                         outputs={'Out': [pre_bias]})
+
+    pre_act = _append_bias(helper, pre_bias, [size], axis=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def _append_bias(helper, input_var, size, axis=1):
+    bias_attr = helper.bias_attr
+    if bias_attr is False:
+        return input_var
+    b = helper.create_parameter(attr=bias_attr, shape=size,
+                                dtype=input_var.dtype, is_bias=True)
+    tmp = helper.create_variable_for_type_inference(input_var.dtype)
+    tmp.shape = input_var.shape
+    helper.append_op(type='elementwise_add',
+                     inputs={'X': [input_var], 'Y': [b]},
+                     outputs={'Out': [tmp]}, attrs={'axis': axis})
+    return tmp
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    """Embedding lookup (reference nn.py:embedding / lookup_table_op.cc)."""
+    helper = LayerHelper('embedding', **locals())
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    in_shape = input.shape
+    if in_shape is not None:
+        base = in_shape[:-1] if in_shape[-1] == 1 else in_shape
+        out.shape = tuple(base) + (size[1],)
+    if padding_idx is None:
+        padding_idx = -1
+    elif padding_idx < 0:
+        # reference fluid nn.py normalizes negatives to size[0]+padding_idx
+        padding_idx = size[0] + padding_idx
+    helper.append_op(
+        type='lookup_table', inputs={'W': [w], 'Ids': [input]},
+        outputs={'Out': [out]},
+        attrs={'is_sparse': is_sparse, 'padding_idx': padding_idx})
+    return out
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           use_mkldnn=False, act=None, name=None):
+    """2-D convolution, NCHW/OIHW (reference nn.py:conv2d, conv_op.cc)."""
+    helper = LayerHelper('conv2d', **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    num_channels = input.shape[1]
+    fh, fw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    filter_shape = [num_filters, num_channels // groups, fh, fw]
+    import math
+    std = (2.0 / (fh * fw * num_channels)) ** 0.5
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype,
+                                default_initializer=Normal(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    n, c, h, w_in = input.shape
+    oh = (h + 2 * ph - (dh * (fh - 1) + 1)) // sh + 1 if h and h > 0 else h
+    ow = (w_in + 2 * pw - (dw * (fw - 1) + 1)) // sw + 1 \
+        if w_in and w_in > 0 else w_in
+    pre_bias.shape = (n, num_filters, oh, ow)
+    helper.append_op(
+        type='conv2d', inputs={'Input': [input], 'Filter': [w]},
+        outputs={'Output': [pre_bias]},
+        attrs={'strides': [sh, sw], 'paddings': [ph, pw],
+               'dilations': [dh, dw], 'groups': groups})
+    pre_act = _append_bias(helper, pre_bias, [num_filters], axis=1)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None):
+    helper = LayerHelper('conv2d_transpose', **locals())
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError('output_size or filter_size must be set')
+        oh, ow = _pair(output_size)
+        h, w_in = input.shape[2], input.shape[3]
+        fh = oh - (h - 1) * sh + 2 * ph
+        fw = ow - (w_in - 1) * sw + 2 * pw
+    else:
+        fh, fw = _pair(filter_size)
+    filter_shape = [num_channels, num_filters, fh, fw]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    n, _, h, w_in = input.shape
+    oh = (h - 1) * sh - 2 * ph + dh * (fh - 1) + 1 if h and h > 0 else h
+    ow = (w_in - 1) * sw - 2 * pw + dw * (fw - 1) + 1 \
+        if w_in and w_in > 0 else w_in
+    pre_bias.shape = (n, num_filters, oh, ow)
+    helper.append_op(
+        type='conv2d_transpose', inputs={'Input': [input], 'Filter': [w]},
+        outputs={'Output': [pre_bias]},
+        attrs={'strides': [sh, sw], 'paddings': [ph, pw],
+               'dilations': [dh, dw]})
+    pre_act = _append_bias(helper, pre_bias, [num_filters], axis=1)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, use_mkldnn=False, name=None, exclusive=True):
+    helper = LayerHelper('pool2d', **locals())
+    kh, kw = _pair(pool_size)
+    sh, sw = _pair(pool_stride)
+    ph, pw = _pair(pool_padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    n, c, h, w = input.shape
+    if global_pooling:
+        out.shape = (n, c, 1, 1)
+    else:
+        rnd = (lambda a, b: -(-a // b)) if ceil_mode else (lambda a, b: a // b)
+        out.shape = (n, c,
+                     rnd(h + 2 * ph - kh, sh) + 1 if h and h > 0 else -1,
+                     rnd(w + 2 * pw - kw, sw) + 1 if w and w > 0 else -1)
+    helper.append_op(
+        type='pool2d', inputs={'X': [input]}, outputs={'Out': [out]},
+        attrs={'pooling_type': pool_type, 'ksize': [kh, kw],
+               'strides': [sh, sw], 'paddings': [ph, pw],
+               'global_pooling': global_pooling, 'ceil_mode': ceil_mode,
+               'exclusive': exclusive})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, use_mkldnn=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=False):
+    """Batch normalization (reference nn.py:batch_norm, batch_norm_op.cc)."""
+    helper = LayerHelper('batch_norm', **locals())
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    scale = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                   dtype=dtype, is_bias=True)
+    block = helper.main_program.global_block()
+    mean_name = moving_mean_name or helper.name + '.mean'
+    var_name = moving_variance_name or helper.name + '.variance'
+    mean = block.create_var(name=mean_name, shape=(c,), dtype=dtype,
+                            persistable=True)
+    mean.stop_gradient = True
+    variance = block.create_var(name=var_name, shape=(c,), dtype=dtype,
+                                persistable=True)
+    variance.stop_gradient = True
+    Constant(0.0)(mean)
+    Constant(1.0)(variance)
+
+    saved_mean = helper.create_variable_for_type_inference(dtype)
+    saved_var = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type='batch_norm',
+        inputs={'X': [input], 'Scale': [scale], 'Bias': [bias],
+                'Mean': [mean], 'Variance': [variance]},
+        outputs={'Y': [out], 'MeanOut': [mean], 'VarianceOut': [variance],
+                 'SavedMean': [saved_mean], 'SavedVariance': [saved_var]},
+        attrs={'momentum': momentum, 'epsilon': epsilon, 'is_test': is_test,
+               'data_layout': data_layout})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Layer normalization (reference nn.py:layer_norm, layer_norm_op.cc)."""
+    helper = LayerHelper('layer_norm', **locals())
+    dtype = input.dtype
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    inputs = {'X': [input]}
+    if scale:
+        s = helper.create_parameter(attr=helper.param_attr, shape=norm_shape,
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs['Scale'] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=norm_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    mean = helper.create_variable_for_type_inference(dtype)
+    variance = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(type='layer_norm', inputs=inputs,
+                     outputs={'Y': [out], 'Mean': [mean],
+                              'Variance': [variance]},
+                     attrs={'begin_norm_axis': begin_norm_axis,
+                            'epsilon': epsilon})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    helper = LayerHelper('group_norm', **locals())
+    dtype = input.dtype
+    c = input.shape[1]
+    inputs = {'X': [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs['Scale'] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(type='group_norm', inputs=inputs,
+                     outputs={'Y': [out]},
+                     attrs={'groups': groups, 'epsilon': epsilon})
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    helper = LayerHelper('dropout', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    mask = helper.create_variable_for_type_inference(x.dtype)
+    mask.stop_gradient = True
+    helper.append_op(
+        type='dropout', inputs={'X': [x]},
+        outputs={'Out': [out], 'Mask': [mask]},
+        attrs={'dropout_prob': dropout_prob, 'is_test': is_test,
+               'seed': seed if seed is not None else 0,
+               'dropout_implementation': dropout_implementation})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper('cross_entropy')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = tuple(input.shape[:-1]) + (1,)
+    helper.append_op(type='cross_entropy',
+                     inputs={'X': [input], 'Label': [label]},
+                     outputs={'Y': [out]},
+                     attrs={'soft_label': soft_label})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False):
+    helper = LayerHelper('softmax_with_cross_entropy')
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    softmax.shape = logits.shape
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    if logits.shape is not None:
+        loss.shape = tuple(logits.shape[:-1]) + (1,)
+    helper.append_op(type='softmax_with_cross_entropy',
+                     inputs={'Logits': [logits], 'Label': [label]},
+                     outputs={'Softmax': [softmax], 'Loss': [loss]},
+                     attrs={'soft_label': soft_label,
+                            'ignore_index': ignore_index})
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper('square_error_cost')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type='square_error_cost',
+                     inputs={'X': [input], 'Y': [label]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Classification accuracy: topk + accuracy op (reference metric_op)."""
+    helper = LayerHelper('accuracy')
+    values, indices = topk(input, k=k)
+    acc = helper.create_variable_for_type_inference('float32')
+    acc.shape = (1,)
+    if correct is None:
+        correct = helper.create_variable_for_type_inference('int32')
+    if total is None:
+        total = helper.create_variable_for_type_inference('int32')
+    correct.shape = (1,)
+    total.shape = (1,)
+    helper.append_op(type='accuracy',
+                     inputs={'Out': [values], 'Indices': [indices],
+                             'Label': [label]},
+                     outputs={'Accuracy': [acc], 'Correct': [correct],
+                              'Total': [total]})
+    return acc
+
+
+def auc(input, label, curve='ROC', num_thresholds=200, topk=1):
+    helper = LayerHelper('auc')
+    out = helper.create_variable_for_type_inference('float32')
+    out.shape = (1,)
+    helper.append_op(type='auc',
+                     inputs={'Predict': [input], 'Label': [label]},
+                     outputs={'AUC': [out]})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk (NER) evaluation — host-side op placeholder; the heavy decode
+    runs in the evaluator (reference chunk_eval_op.cc)."""
+    raise NotImplementedError(
+        'chunk_eval is computed by evaluator.ChunkEvaluator on host; '
+        'see paddle_tpu/evaluator.py')
+
+
+def one_hot(input, depth):
+    helper = LayerHelper('one_hot')
+    out = helper.create_variable_for_type_inference('float32')
+    if input.shape is not None:
+        base = input.shape[:-1] if input.shape[-1] == 1 else input.shape
+        out.shape = tuple(base) + (depth,)
+    helper.append_op(type='one_hot', inputs={'X': [input]},
+                     outputs={'Out': [out]}, attrs={'depth': depth})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper('matmul', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None and y.shape is not None:
+        xs = list(x.shape)
+        ys = list(y.shape)
+        if transpose_x and len(xs) > 1:
+            xs[-1], xs[-2] = xs[-2], xs[-1]
+        if transpose_y and len(ys) > 1:
+            ys[-1], ys[-2] = ys[-2], ys[-1]
+        if len(xs) >= 2 and len(ys) >= 2:
+            out.shape = tuple(xs[:-1] + ys[-1:])
+    helper.append_op(type='matmul', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'transpose_X': transpose_x,
+                            'transpose_Y': transpose_y, 'alpha': alpha})
+    return out
+
+
+def topk(input, k=1, name=None):
+    helper = LayerHelper('top_k', name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference('int64')
+    if input.shape is not None:
+        s = tuple(input.shape[:-1]) + (k,)
+        values.shape = s
+        indices.shape = s
+    helper.append_op(type='top_k', inputs={'X': [input]},
+                     outputs={'Out': [values], 'Indices': [indices]},
+                     attrs={'k': k})
+    return values, indices
+
+
+def _reduce(op_type, input, dim, keep_dim, name):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    reduce_all = dim is None
+    dims = dim if isinstance(dim, (list, tuple)) else \
+        ([dim] if dim is not None else [0])
+    if input.shape is not None:
+        if reduce_all:
+            out.shape = (1,) * len(input.shape) if keep_dim else ()
+        else:
+            s = list(input.shape)
+            axes = sorted(d % len(s) for d in dims)
+            for ax in reversed(axes):
+                if keep_dim:
+                    s[ax] = 1
+                else:
+                    s.pop(ax)
+            out.shape = tuple(s)
+    helper.append_op(type=op_type, inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'dim': list(dims), 'keep_dim': keep_dim,
+                            'reduce_all': reduce_all})
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_sum', input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_mean', input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_max', input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_min', input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_prod', input, dim, keep_dim, name)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper('split', name=name)
+    in_shape = input.shape
+    axis = dim % len(in_shape) if in_shape is not None else dim
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = None
+        sizes = [in_shape[axis] // num] * num if in_shape else None
+    else:
+        sections = list(num_or_sections)
+        num = len(sections)
+        sizes = sections
+    outs = []
+    for i in range(num):
+        v = helper.create_variable_for_type_inference(input.dtype)
+        if in_shape is not None and sizes is not None:
+            s = list(in_shape)
+            s[axis] = sizes[i]
+            v.shape = tuple(s)
+        outs.append(v)
+    attrs = {'axis': axis}
+    if sections is not None:
+        attrs['sections'] = sections
+    else:
+        attrs['num'] = num
+    helper.append_op(type='split', inputs={'X': [input]},
+                     outputs={'Out': outs}, attrs=attrs)
+    return outs
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper('transpose', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        out.shape = tuple(x.shape[p] for p in perm)
+    helper.append_op(type='transpose', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'axis': list(perm)})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper('l2_normalize', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type='l2_normalize', inputs={'X': [x]},
+                     outputs={'Out': [out], 'Norm': [norm]},
+                     attrs={'axis': axis, 'epsilon': epsilon})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper('cos_sim')
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    if X.shape is not None:
+        out.shape = tuple(X.shape[:-1]) + (1,)
+    helper.append_op(type='cos_sim', inputs={'X': [X], 'Y': [Y]},
+                     outputs={'Out': [out], 'XNorm': [xn], 'YNorm': [yn]})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper('smooth_l1_loss')
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        loss.shape = (x.shape[0], 1)
+    inputs = {'X': [x], 'Y': [y]}
+    if inside_weight is not None:
+        inputs['InsideWeight'] = [inside_weight]
+    if outside_weight is not None:
+        inputs['OutsideWeight'] = [outside_weight]
+    helper.append_op(type='smooth_l1_loss', inputs=inputs,
+                     outputs={'Diff': [diff], 'Out': [loss]},
+                     attrs={'sigma': sigma if sigma is not None else 1.0})
+    return loss
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper('im2sequence', name=name)
+    kh, kw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    pads = padding if isinstance(padding, (list, tuple)) and \
+        len(padding) == 4 else _pair(padding) * 2
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='im2sequence', inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'kernels': [kh, kw], 'strides': [sh, sw],
+                            'paddings': list(pads)})
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper('multiplex')
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    out.shape = inputs[0].shape
+    helper.append_op(type='multiplex',
+                     inputs={'X': inputs, 'Ids': [index]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype='float32',
+                 name=None):
+    helper = LayerHelper('label_smooth', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = label.shape
+    inputs = {'X': [label]}
+    if prior_dist is not None:
+        inputs['PriorDist'] = [prior_dist]
+    helper.append_op(type='label_smooth', inputs=inputs,
+                     outputs={'Out': [out]}, attrs={'epsilon': epsilon})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper('pad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        s = list(x.shape)
+        for i in range(len(s)):
+            if s[i] is not None and s[i] >= 0:
+                s[i] += paddings[2 * i] + paddings[2 * i + 1]
+        out.shape = tuple(s)
+    helper.append_op(type='pad', inputs={'X': [x]}, outputs={'Out': [out]},
+                     attrs={'paddings': list(paddings),
+                            'pad_value': float(pad_value)})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper('maxout', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        n, c, h, w = x.shape
+        out.shape = (n, c // groups, h, w)
+    helper.append_op(type='maxout', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'groups': groups})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper('bilinear_tensor_product', **locals())
+    dtype = x.dtype
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[size, x.shape[-1], y.shape[-1]],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (x.shape[0], size)
+    inputs = {'X': [x], 'Y': [y], 'Weight': [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    helper.append_op(type='bilinear_tensor_product', inputs=inputs,
+                     outputs={'Out': [out]})
+    return helper.append_activation(out)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None):
+    """NCE loss (reference nce_op.cc). TPU-native: sampled softmax using
+    stateless uniform negative sampling fused into one XLA computation."""
+    helper = LayerHelper('nce', **locals())
+    dim = input.shape[-1]
+    num_neg = num_neg_samples if num_neg_samples is not None else 10
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (input.shape[0], 1)
+    helper.append_op(type='nce',
+                     inputs={'Input': [input], 'Label': [label],
+                             'Weight': [w], 'Bias': [b]},
+                     outputs={'Cost': [out]},
+                     attrs={'num_total_classes': num_total_classes,
+                            'num_neg_samples': num_neg})
+    return out
+
+
+def relu_layer(x, name=None):
+    from .ops import relu as _relu
+    return _relu(x, name=name)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 step counter incremented once per executor run
+    (reference nn.py:autoincreased_step_counter)."""
+    helper = LayerHelper('global_step_counter')
+    name = counter_name or '@STEP_COUNTER@'
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        return block.var(name)
+    counter = block.create_var(name=name, dtype='int64', shape=(1,),
+                               persistable=True)
+    counter.stop_gradient = True
+    Constant(float(begin - step))(counter)
+    block.append_op(type='increment', inputs={'X': [counter]},
+                    outputs={'Out': [counter]}, attrs={'step': float(step)})
+    return counter

@@ -1,0 +1,269 @@
+"""Auto-generated thin layers (reference: fluid/layers/ops.py +
+layer_function_generator.py): each wraps one registered op type."""
+
+from ..core.dtypes import canonical_dtype
+from .helper import LayerHelper
+
+_UNARY_OPS = [
+    'sigmoid', 'logsigmoid', 'exp', 'relu', 'tanh', 'tanh_shrink',
+    'softshrink', 'sqrt', 'rsqrt', 'abs', 'ceil', 'floor', 'round',
+    'reciprocal', 'log', 'square', 'softplus', 'softsign', 'brelu',
+    'leaky_relu', 'soft_relu', 'elu', 'relu6', 'pow', 'stanh',
+    'hard_shrink', 'thresholded_relu', 'hard_sigmoid', 'swish', 'gelu',
+    'mish', 'sin', 'cos',
+]
+
+__all__ = list(_UNARY_OPS) + [
+    'mean', 'mul', 'reshape', 'scale', 'sigmoid_cross_entropy_with_logits',
+    'elementwise_add', 'elementwise_div', 'elementwise_sub',
+    'elementwise_mul', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'clip', 'clip_by_norm', 'softmax',
+    'logical_and', 'logical_or', 'logical_xor', 'logical_not',
+    'uniform_random', 'uniform_random_batch_size_like', 'gaussian_random',
+    'gaussian_random_batch_size_like', 'cumsum',
+]
+
+
+def _single_op(op_type, x, attrs=None, dtype=None, extra_outs=()):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    out.shape = x.shape
+    outputs = {'Out': [out]}
+    extras = []
+    for slot, edtype in extra_outs:
+        ev = helper.create_variable_for_type_inference(edtype or x.dtype)
+        ev.shape = x.shape
+        outputs[slot] = [ev]
+        extras.append(ev)
+    helper.append_op(type=op_type, inputs={'X': [x]}, outputs=outputs,
+                     attrs=attrs or {})
+    return out if not extras else (out, extras)
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        return _single_op(op_type, x, attrs)
+    layer.__name__ = op_type
+    layer.__doc__ = 'Elementwise %s (activation_op.cc).' % op_type
+    return layer
+
+
+_g = globals()
+for _name in _UNARY_OPS:
+    _g[_name] = _make_unary(_name)
+
+
+def _binary_op(op_type, x, y, axis=-1, attrs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    a = dict(attrs or {})
+    a['axis'] = axis
+    helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]}, attrs=a)
+    return out
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = _binary_op('elementwise_add', x, y, axis)
+    return _maybe_act(out, act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_sub', x, y, axis), act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_mul', x, y, axis), act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_div', x, y, axis), act)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_max', x, y, axis), act)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_min', x, y, axis), act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(_binary_op('elementwise_pow', x, y, axis), act)
+
+
+def _maybe_act(out, act):
+    if act is None:
+        return out
+    return _single_op(act, out)
+
+
+def mean(x, name=None):
+    helper = LayerHelper('mean', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = (1,)
+    helper.append_op(type='mean', inputs={'X': [x]}, outputs={'Out': [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper('mul', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None and y.shape is not None:
+        out.shape = tuple(x.shape[:x_num_col_dims]) + \
+            tuple(y.shape[y_num_col_dims:])
+    helper.append_op(type='mul', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'x_num_col_dims': x_num_col_dims,
+                            'y_num_col_dims': y_num_col_dims})
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper('reshape', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    new_shape = list(shape)
+    if x.shape is not None:
+        known = 1
+        has_neg = False
+        for i, s in enumerate(new_shape):
+            if s == 0:
+                new_shape[i] = x.shape[i]
+        for s in new_shape:
+            if s == -1:
+                has_neg = True
+            else:
+                known *= s
+        if has_neg and all(d is not None and d >= 0 for d in x.shape):
+            total = 1
+            for d in x.shape:
+                total *= d
+            new_shape = [total // known if s == -1 else s for s in new_shape]
+        out.shape = tuple(new_shape)
+    helper.append_op(type='reshape', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'shape': list(shape)})
+    return _maybe_act(out, act)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _single_op('scale', x, {'scale': float(scale), 'bias': float(bias),
+                                  'bias_after_scale': bias_after_scale})
+    return _maybe_act(out, act)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    helper = LayerHelper('sigmoid_cross_entropy_with_logits', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type='sigmoid_cross_entropy_with_logits',
+                     inputs={'X': [x], 'Label': [label]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _single_op('clip', x, {'min': float(min), 'max': float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_op('clip_by_norm', x, {'max_norm': float(max_norm)})
+
+
+def softmax(input, name=None):
+    return _single_op('softmax', input)
+
+
+def log_softmax(input, name=None):
+    return _single_op('log_softmax', input)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical('logical_and', x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical('logical_or', x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical('logical_xor', x, y)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper('logical_not')
+    out = helper.create_variable_for_type_inference('bool')
+    out.shape = x.shape
+    helper.append_op(type='logical_not', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def _logical(op_type, x, y):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference('bool')
+    out.shape = x.shape
+    helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper('uniform_random')
+    out = helper.create_variable_for_type_inference(canonical_dtype(dtype))
+    out.shape = tuple(shape)
+    helper.append_op(type='uniform_random', outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'min': float(min),
+                            'max': float(max), 'seed': seed})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper('uniform_random_batch_size_like')
+    out = helper.create_variable_for_type_inference(canonical_dtype(dtype))
+    s = list(shape)
+    if input.shape is not None:
+        s[output_dim_idx] = input.shape[input_dim_idx]
+    out.shape = tuple(s)
+    helper.append_op(type='uniform_random_batch_size_like',
+                     inputs={'Input': [input]}, outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'min': float(min),
+                            'max': float(max), 'seed': seed,
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    return out
+
+
+def gaussian_random(shape, dtype='float32', mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper('gaussian_random')
+    out = helper.create_variable_for_type_inference(canonical_dtype(dtype))
+    out.shape = tuple(shape)
+    helper.append_op(type='gaussian_random', outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'mean': float(mean),
+                            'std': float(std), 'seed': seed})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, dtype='float32',
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper('gaussian_random_batch_size_like')
+    out = helper.create_variable_for_type_inference(canonical_dtype(dtype))
+    s = list(shape)
+    if input.shape is not None:
+        s[output_dim_idx] = input.shape[input_dim_idx]
+    out.shape = tuple(s)
+    helper.append_op(type='gaussian_random_batch_size_like',
+                     inputs={'Input': [input]}, outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'mean': float(mean),
+                            'std': float(std), 'seed': seed,
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return _single_op('cumsum', x, {'axis': axis, 'exclusive': exclusive,
+                                    'reverse': reverse})

@@ -1,0 +1,20 @@
+"""Op lowerings: IR op type -> JAX/lax tracing functions.
+
+Importing this package registers every lowering (the analog of the
+reference's REGISTER_OP kernel registrations in paddle/fluid/operators/*).
+"""
+
+from . import tensor_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import conv_ops  # noqa: F401
+from . import norm_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
+from . import control_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import lr_ops  # noqa: F401

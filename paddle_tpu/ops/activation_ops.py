@@ -1,0 +1,78 @@
+"""Activation ops (reference: paddle/fluid/operators/activation_op.cc —
+the full 20+ activation family)."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _unary(name, fn):
+    @register(name)
+    def _op(ctx, fn=fn):
+        ctx.set_output('Out', fn(ctx.input('X'), ctx))
+
+
+_unary('sigmoid', lambda x, ctx: jax.nn.sigmoid(x))
+_unary('logsigmoid', lambda x, ctx: jax.nn.log_sigmoid(x))
+_unary('exp', lambda x, ctx: jnp.exp(x))
+_unary('relu', lambda x, ctx: jax.nn.relu(x))
+_unary('tanh', lambda x, ctx: jnp.tanh(x))
+_unary('tanh_shrink', lambda x, ctx: x - jnp.tanh(x))
+_unary('sqrt', lambda x, ctx: jnp.sqrt(x))
+_unary('rsqrt', lambda x, ctx: jax.lax.rsqrt(x))
+_unary('abs', lambda x, ctx: jnp.abs(x))
+_unary('ceil', lambda x, ctx: jnp.ceil(x))
+_unary('floor', lambda x, ctx: jnp.floor(x))
+_unary('round', lambda x, ctx: jnp.round(x))
+_unary('reciprocal', lambda x, ctx: 1.0 / x)
+_unary('log', lambda x, ctx: jnp.log(x))
+_unary('square', lambda x, ctx: jnp.square(x))
+_unary('softplus', lambda x, ctx: jax.nn.softplus(x))
+_unary('softsign', lambda x, ctx: jax.nn.soft_sign(x))
+_unary('gelu', lambda x, ctx: jax.nn.gelu(x, approximate=False))
+_unary('sign', lambda x, ctx: jnp.sign(x))
+_unary('sin', lambda x, ctx: jnp.sin(x))
+_unary('cos', lambda x, ctx: jnp.cos(x))
+
+_unary('brelu', lambda x, ctx: jnp.clip(x, ctx.attr('t_min', 0.0),
+                                        ctx.attr('t_max', 24.0)))
+_unary('leaky_relu', lambda x, ctx: jax.nn.leaky_relu(
+    x, negative_slope=ctx.attr('alpha', 0.02)))
+_unary('soft_relu', lambda x, ctx: jnp.log1p(
+    jnp.exp(jnp.clip(x, -ctx.attr('threshold', 40.0),
+                     ctx.attr('threshold', 40.0)))))
+_unary('elu', lambda x, ctx: jax.nn.elu(x, alpha=ctx.attr('alpha', 1.0)))
+_unary('relu6', lambda x, ctx: jnp.clip(x, 0.0, ctx.attr('threshold', 6.0)))
+_unary('pow', lambda x, ctx: jnp.power(x, ctx.attr('factor', 1.0)))
+_unary('stanh', lambda x, ctx: ctx.attr('scale_b', 1.7159) * jnp.tanh(
+    ctx.attr('scale_a', 2.0 / 3.0) * x))
+_unary('hard_shrink', lambda x, ctx: jnp.where(
+    jnp.abs(x) > ctx.attr('threshold', 0.5), x, jnp.zeros_like(x)))
+_unary('softshrink', lambda x, ctx: jnp.where(
+    x > ctx.attr('lambda', 0.5), x - ctx.attr('lambda', 0.5),
+    jnp.where(x < -ctx.attr('lambda', 0.5), x + ctx.attr('lambda', 0.5),
+              jnp.zeros_like(x))))
+_unary('thresholded_relu', lambda x, ctx: jnp.where(
+    x > ctx.attr('threshold', 1.0), x, jnp.zeros_like(x)))
+_unary('hard_sigmoid', lambda x, ctx: jnp.clip(
+    ctx.attr('slope', 0.2) * x + ctx.attr('offset', 0.5), 0.0, 1.0))
+_unary('swish', lambda x, ctx: x * jax.nn.sigmoid(ctx.attr('beta', 1.0) * x))
+_unary('mish', lambda x, ctx: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+@register('softmax')
+def _softmax(ctx):
+    ctx.set_output('Out', jax.nn.softmax(ctx.input('X'), axis=-1))
+
+
+@register('log_softmax')
+def _log_softmax(ctx):
+    ctx.set_output('Out', jax.nn.log_softmax(ctx.input('X'), axis=-1))
+
+
+@register('prelu')
+def _prelu(ctx):
+    x = ctx.input('X')
+    alpha = ctx.input('Alpha')
+    ctx.set_output('Out', jnp.where(x > 0, x, alpha * x))

@@ -1,0 +1,71 @@
+"""Collective ops over mesh axes.
+
+Reference analog: paddle/pserver + go/pserver gradient aggregation and the
+reference's NCCL allreduce path. TPU-native: these lower to XLA collectives
+(psum / all_gather / ppermute / all_to_all) which ride the ICI mesh. Under
+the GSPMD executor path most collectives are INSERTED BY XLA from sharding
+annotations; these explicit ops exist for shard_map-style programs and for
+parity with the reference's Send/Recv surface.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _axis(ctx):
+    return ctx.attr('axis_name', 'dp')
+
+
+@register('c_allreduce_sum')
+def _c_allreduce_sum(ctx):
+    ctx.set_output('Out', jax.lax.psum(ctx.input('X'), _axis(ctx)))
+
+
+@register('c_allreduce_mean')
+def _c_allreduce_mean(ctx):
+    ctx.set_output('Out', jax.lax.pmean(ctx.input('X'), _axis(ctx)))
+
+
+@register('c_allreduce_max')
+def _c_allreduce_max(ctx):
+    ctx.set_output('Out', jax.lax.pmax(ctx.input('X'), _axis(ctx)))
+
+
+@register('c_allgather')
+def _c_allgather(ctx):
+    ctx.set_output('Out', jax.lax.all_gather(
+        ctx.input('X'), _axis(ctx), axis=ctx.attr('concat_axis', 0),
+        tiled=True))
+
+
+@register('c_reducescatter')
+def _c_reducescatter(ctx):
+    ctx.set_output('Out', jax.lax.psum_scatter(
+        ctx.input('X'), _axis(ctx),
+        scatter_dimension=ctx.attr('scatter_axis', 0), tiled=True))
+
+
+@register('c_all_to_all')
+def _c_all_to_all(ctx):
+    ctx.set_output('Out', jax.lax.all_to_all(
+        ctx.input('X'), _axis(ctx),
+        split_axis=ctx.attr('split_axis', 0),
+        concat_axis=ctx.attr('concat_axis', 0),
+        tiled=True))
+
+
+@register('c_ppermute')
+def _c_ppermute(ctx):
+    perm = [tuple(p) for p in ctx.attr('perm')]
+    ctx.set_output('Out', jax.lax.ppermute(ctx.input('X'), _axis(ctx), perm))
+
+
+@register('c_broadcast')
+def _c_broadcast(ctx):
+    x = ctx.input('X')
+    root = ctx.attr('root', 0)
+    idx = jax.lax.axis_index(_axis(ctx))
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    ctx.set_output('Out', jax.lax.psum(masked, _axis(ctx)))

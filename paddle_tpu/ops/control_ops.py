@@ -1,0 +1,119 @@
+"""Control-flow op lowerings: static_rnn -> lax.scan, while -> lax.while_loop.
+
+Reference: paddle/fluid/operators/{recurrent_op,while_op}.cc — there the
+executor re-enters the interpreter per step; here the sub-block is traced
+once into the scan/while body, so the loop compiles to a single XLA While.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import LoweringContext, get_lowering, register
+
+
+def _run_block_ops(block, env, base_key, is_test=False):
+    for i, op in enumerate(block.ops):
+        ctx = LoweringContext(env, op, block, 10_000 * (block.idx + 1) + i,
+                              base_key,
+                              is_test=is_test or
+                              bool(op.attrs.get('is_test', False)))
+        get_lowering(op.type)(ctx)
+    return env
+
+
+@register('static_rnn')
+def _static_rnn(ctx):
+    """Lower a StaticRNN sub-block with lax.scan over time (axis 1)."""
+    block = ctx.block.program.block(ctx.attr('sub_block'))
+    step_input_names = ctx.attr('step_input_names')
+    memory_names = ctx.attr('memory_names')  # [(pre, cur), ...]
+    output_names = ctx.attr('output_names')
+    seq_inputs = ctx.input_list('Inputs')      # [b, t, ...] each
+    boot_memories = ctx.input_list('BootMemories')
+    base_key = ctx.rng_key()
+    outer_env = dict(ctx.env)
+
+    def body(carry, xs):
+        env = dict(outer_env)
+        for name, val in zip(step_input_names, xs):
+            env[name] = val
+        for (pre, _), mem in zip(memory_names, carry):
+            env[pre] = mem
+        env = _run_block_ops(block, env, base_key, is_test=ctx.is_test)
+        new_carry = tuple(env[cur] for _, cur in memory_names)
+        outs = tuple(env[name] for name in output_names)
+        return new_carry, outs
+
+    xs = tuple(jnp.swapaxes(x, 0, 1) for x in seq_inputs)  # time-major
+    carry0 = tuple(boot_memories)
+    _, outs = jax.lax.scan(body, carry0, xs)
+    outs = tuple(jnp.swapaxes(o, 0, 1) for o in outs)  # back to batch-major
+    ctx.set_output_list('Outputs', outs)
+
+
+@register('while')
+def _while(ctx):
+    """Lower a While sub-block with lax.while_loop. Loop state = every var
+    read by the body that the body also writes + the condition var."""
+    block = ctx.block.program.block(ctx.attr('sub_block'))
+    cond_name = ctx.op.input('Condition')
+    base_key = ctx.rng_key()
+    read, written = set(), set()
+    for op in block.ops:
+        for n in op.input_names():
+            if n not in written:
+                read.add(n)
+        written.update(op.output_names())
+    state_names = sorted((read & written) | {cond_name} |
+                         {n for n in written if n in ctx.env})
+    state_names = [n for n in state_names if n in ctx.env]
+    outer_env = {k: v for k, v in ctx.env.items() if k not in state_names}
+
+    def cond_fn(state):
+        return jnp.reshape(state[state_names.index(cond_name)], ()).astype(
+            bool) if cond_name in state_names else False
+
+    def body_fn(state):
+        env = dict(outer_env)
+        env.update(dict(zip(state_names, state)))
+        env = _run_block_ops(block, env, base_key, is_test=ctx.is_test)
+        return tuple(env[n] for n in state_names)
+
+    init = tuple(ctx.env[n] for n in state_names)
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(state_names, final):
+        ctx.env[n] = v
+
+
+@register('is_empty')
+def _is_empty(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.asarray([x.size == 0]))
+
+
+# Tensor-array ops: dense [max_len, ...] buffer + int cursor emulation.
+@register('array_write')
+def _array_write(ctx):
+    x = ctx.input('X')
+    i = ctx.input('I').reshape(()).astype(jnp.int32)
+    name = ctx.op.output('Out')
+    arr = ctx.env.get(name)
+    if arr is None or not hasattr(arr, 'shape') or arr.ndim != x.ndim + 1:
+        # First write decides capacity: a modest static default.
+        cap = 64
+        arr = jnp.zeros((cap,) + x.shape, x.dtype)
+    ctx.env[name] = jax.lax.dynamic_update_index_in_dim(arr, x, i, 0)
+
+
+@register('array_read')
+def _array_read(ctx):
+    arr = ctx.input('X')
+    i = ctx.input('I').reshape(()).astype(jnp.int32)
+    ctx.set_output('Out', jax.lax.dynamic_index_in_dim(arr, i, 0,
+                                                       keepdims=False))
+
+
+@register('array_length')
+def _array_length(ctx):
+    arr = ctx.input('X')
+    ctx.set_output('Out', jnp.asarray([arr.shape[0]], dtype=jnp.int64))

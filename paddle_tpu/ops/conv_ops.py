@@ -1,0 +1,156 @@
+"""Convolution / pooling ops.
+
+Reference: paddle/fluid/operators/{conv_op,conv_transpose_op,pool_op}.cc.
+IR semantics stay NCHW for reference-parity; XLA's TPU layout assignment
+re-tiles internally, so no manual NHWC transposes are inserted here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('conv2d')
+def _conv2d(ctx):
+    x = ctx.input('Input')  # NCHW
+    w = ctx.input('Filter')  # OIHW
+    strides = tuple(ctx.attr('strides', [1, 1]))
+    pads = ctx.attr('paddings', [0, 0])
+    dilations = tuple(ctx.attr('dilations', [1, 1]))
+    groups = ctx.attr('groups', 1)
+    padding = [(pads[0], pads[0]), (pads[1], pads[1])] if len(pads) == 2 \
+        else [(pads[0], pads[1]), (pads[2], pads[3])]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=x.dtype if x.dtype == jnp.float32 else None)
+    ctx.set_output('Output', out)
+
+
+@register('conv2d_transpose')
+def _conv2d_transpose(ctx):
+    x = ctx.input('Input')  # NCHW
+    w = ctx.input('Filter')  # IOHW in paddle (in_channels first)
+    strides = tuple(ctx.attr('strides', [1, 1]))
+    pads = ctx.attr('paddings', [0, 0])
+    dilations = tuple(ctx.attr('dilations', [1, 1]))
+    padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
+        transpose_kernel=True)
+    ctx.set_output('Output', out)
+
+
+@register('conv3d')
+def _conv3d(ctx):
+    x = ctx.input('Input')  # NCDHW
+    w = ctx.input('Filter')  # OIDHW
+    strides = tuple(ctx.attr('strides', [1, 1, 1]))
+    pads = ctx.attr('paddings', [0, 0, 0])
+    dilations = tuple(ctx.attr('dilations', [1, 1, 1]))
+    groups = ctx.attr('groups', 1)
+    padding = [(p, p) for p in pads]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    ctx.set_output('Output', out)
+
+
+def _pool2d_impl(x, pooling_type, ksize, strides, pads, global_pooling,
+                 ceil_mode=False, exclusive=True, adaptive=False):
+    n, c, h, w = x.shape
+    if global_pooling or (adaptive and tuple(ksize) == (1, 1)):
+        if pooling_type == 'max':
+            return x.max(axis=(2, 3), keepdims=True)
+        return x.mean(axis=(2, 3), keepdims=True)
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = pads
+    window = (1, 1, kh, kw)
+    stride = (1, 1, sh, sw)
+    padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    if ceil_mode:
+        # pad extra on the bottom/right so ceil-division windows fit
+        eh = max(0, (-(h + 2 * ph - kh) % sh))
+        ew = max(0, (-(w + 2 * pw - kw) % sw))
+        padding = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+    if pooling_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                     padding)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                   padding)
+    if exclusive and (ph or pw or ceil_mode):
+        ones = jnp.ones((1, 1, h, w), dtype=x.dtype)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       stride, padding)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / (kh * kw)
+
+
+@register('pool2d')
+def _pool2d(ctx):
+    x = ctx.input('X')
+    out = _pool2d_impl(
+        x,
+        ctx.attr('pooling_type', 'max'),
+        ctx.attr('ksize', [2, 2]),
+        ctx.attr('strides', [2, 2]) if not ctx.attr('global_pooling', False)
+        else [1, 1],
+        ctx.attr('paddings', [0, 0]),
+        ctx.attr('global_pooling', False),
+        ceil_mode=ctx.attr('ceil_mode', False),
+        exclusive=ctx.attr('exclusive', True))
+    ctx.set_output('Out', out)
+
+
+@register('row_conv')
+def _row_conv(ctx):
+    """row_conv_op.cc (lookahead conv for DeepSpeech): out[t] =
+    sum_{i=0..k-1} w[i] * x[t+i], per feature."""
+    x = ctx.input('X')  # [batch, seq, dim] (padded dense form)
+    w = ctx.input('Filter')  # [k, dim]
+    k = w.shape[0]
+    pads = [(0, 0), (0, k - 1), (0, 0)]
+    xp = jnp.pad(x, pads)
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        out = out + xp[:, i:i + x.shape[1], :] * w[i]
+    ctx.set_output('Out', out)
+
+
+@register('conv_shift')
+def _conv_shift(ctx):
+    """conv_shift_op.cc: circular convolution (NTM addressing)."""
+    x = ctx.input('X')  # [b, m]
+    y = ctx.input('Y')  # [b, n], n odd, n <= m
+    b, m = x.shape
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    gathered = x[:, idx]  # [b, m, n]
+    ctx.set_output('Out', jnp.einsum('bmn,bn->bm', gathered, y))
+
+
+@register('spp')
+def _spp(ctx):
+    """Spatial pyramid pooling (spp_op.cc)."""
+    x = ctx.input('X')
+    levels = ctx.attr('pyramid_height', 2)
+    pooling_type = ctx.attr('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = kh, kw
+        out = _pool2d_impl(x, pooling_type, [kh, kw], [sh, sw], [0, 0], False,
+                           ceil_mode=True)
+        outs.append(out.reshape(n, -1))
+    ctx.set_output('Out', jnp.concatenate(outs, axis=1))

@@ -1,0 +1,98 @@
+"""Detection ops (reference: paddle/fluid/operators/{box_coder_op,
+iou_similarity_op,prior_box_op}.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register
+
+
+@register('iou_similarity')
+def _iou_similarity(ctx):
+    x = ctx.input('X')  # [n, 4] xmin ymin xmax ymax
+    y = ctx.input('Y')  # [m, 4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    ctx.set_output('Out', inter / jnp.maximum(union, 1e-10))
+
+
+@register('box_coder')
+def _box_coder(ctx):
+    prior = ctx.input('PriorBox')        # [m, 4]
+    prior_var = ctx.input('PriorBoxVar')  # [m, 4]
+    target = ctx.input('TargetBox')
+    code_type = ctx.attr('code_type', 'encode_center_size')
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if code_type == 'encode_center_size':
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / prior_var[:, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / prior_var[:, 1],
+            jnp.log(tw[:, None] / pw[None, :]) / prior_var[:, 2],
+            jnp.log(th[:, None] / ph[None, :]) / prior_var[:, 3],
+        ], axis=-1)
+    else:  # decode_center_size
+        t = target  # [n, m, 4] or [m, 4]
+        if t.ndim == 2:
+            t = t[:, None, :]
+        cx = prior_var[:, 0] * t[..., 0] * pw + pcx
+        cy = prior_var[:, 1] * t[..., 1] * ph + pcy
+        w = jnp.exp(prior_var[:, 2] * t[..., 2]) * pw
+        h = jnp.exp(prior_var[:, 3] * t[..., 3]) * ph
+        out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                         cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+    ctx.set_output('OutputBox', out)
+
+
+@register('prior_box')
+def _prior_box(ctx):
+    x = ctx.input('Input')   # feature map NCHW
+    image = ctx.input('Image')  # NCHW
+    min_sizes = ctx.attr('min_sizes')
+    max_sizes = ctx.attr('max_sizes', [])
+    aspect_ratios = list(ctx.attr('aspect_ratios', [1.0]))
+    if ctx.attr('flip', False):
+        aspect_ratios = aspect_ratios + [1.0 / a for a in aspect_ratios
+                                         if a != 1.0]
+    variances = ctx.attr('variances', [0.1, 0.1, 0.2, 0.2])
+    offset = ctx.attr('offset', 0.5)
+    fh, fw = x.shape[2], x.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    steps = ctx.attr('steps', [0.0, 0.0])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+
+    boxes = []
+    cx = (np.arange(fw) + offset) * step_w / iw
+    cy = (np.arange(fh) + offset) * step_h / ih
+    cxg, cyg = np.meshgrid(cx, cy)
+    for ms in min_sizes:
+        for ar in aspect_ratios:
+            bw = ms * np.sqrt(ar) / iw / 2.0
+            bh = ms / np.sqrt(ar) / ih / 2.0
+            boxes.append(np.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh],
+                                  axis=-1))
+        for mx in max_sizes:
+            s = np.sqrt(ms * mx)
+            bw, bh = s / iw / 2.0, s / ih / 2.0
+            boxes.append(np.stack([cxg - bw, cyg - bh, cxg + bw, cyg + bh],
+                                  axis=-1))
+    num_priors = len(boxes)
+    out = np.stack(boxes, axis=2).reshape(fh, fw, num_priors, 4)
+    if ctx.attr('clip', False):
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, dtype='float32'),
+                  (fh, fw, num_priors, 1))
+    ctx.set_output('Boxes', jnp.asarray(out, dtype=jnp.float32))
+    ctx.set_output('Variances', jnp.asarray(var, dtype=jnp.float32))

@@ -1,0 +1,66 @@
+"""Learning-rate decay op: one fused lowering for all schedules.
+
+Reference: python/paddle/fluid/learning_rate_decay.py builds the decay
+formula from many small ops; TPU-native we fuse each schedule into a single
+op so the LR computation adds no per-step overhead.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('lr_decay')
+def _lr_decay(ctx):
+    step = ctx.input('Step').reshape(()).astype(jnp.float32)
+    kind = ctx.attr('kind')
+    lr = ctx.attr('learning_rate')
+    ds = float(ctx.attr('decay_steps', 1))
+    dr = ctx.attr('decay_rate', 0.0)
+    staircase = ctx.attr('staircase', False)
+
+    if kind == 'exponential':
+        p = step / ds
+        if staircase:
+            p = jnp.floor(p)
+        out = lr * jnp.power(dr, p)
+    elif kind == 'natural_exp':
+        p = step / ds
+        if staircase:
+            p = jnp.floor(p)
+        out = lr * jnp.exp(-dr * p)
+    elif kind == 'inverse_time':
+        p = step / ds
+        if staircase:
+            p = jnp.floor(p)
+        out = lr / (1.0 + dr * p)
+    elif kind == 'polynomial':
+        end_lr = ctx.attr('end_learning_rate', 0.0001)
+        power = ctx.attr('power', 1.0)
+        if ctx.attr('cycle', False):
+            div = jnp.ceil(jnp.maximum(step / ds, 1.0))
+            decay_steps = ds * div
+        else:
+            decay_steps = ds
+        gstep = jnp.minimum(step, decay_steps)
+        out = (lr - end_lr) * jnp.power(1.0 - gstep / decay_steps, power) \
+            + end_lr
+    elif kind == 'piecewise':
+        boundaries = jnp.asarray(ctx.attr('boundaries'), jnp.float32)
+        values = jnp.asarray(ctx.attr('values'), jnp.float32)
+        idx = jnp.sum((step >= boundaries).astype(jnp.int32))
+        out = values[idx]
+    elif kind == 'cosine':
+        import math
+        total = float(ctx.attr('total_steps'))
+        out = 0.5 * lr * (1.0 + jnp.cos(math.pi * jnp.minimum(
+            step / total, 1.0)))
+    elif kind == 'noam':
+        d_model = float(ctx.attr('d_model'))
+        warmup = float(ctx.attr('warmup_steps'))
+        s = jnp.maximum(step, 1.0)
+        out = lr * (d_model ** -0.5) * jnp.minimum(
+            s ** -0.5, s * warmup ** -1.5)
+    else:
+        raise NotImplementedError('lr_decay kind %r' % kind)
+    ctx.set_output('Out', out.reshape(1))

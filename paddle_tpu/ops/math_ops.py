@@ -1,0 +1,173 @@
+"""Math ops: mul/matmul/elementwise/reduce/scale/mean/compare/logical.
+
+Reference: paddle/fluid/operators/{mul_op,matmul_op,elementwise_*_op,
+reduce_op,scale_op,mean_op,compare_op,logical_op}.cc
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _flatten_2d(x, num_col_dims):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    tail = 1
+    for s in x.shape[num_col_dims:]:
+        tail *= s
+    return x.reshape(lead, tail)
+
+
+@register('mul')
+def _mul(ctx):
+    """out = flatten(x) @ flatten(y)  (reference mul_op.cc:24)."""
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    xd = ctx.attr('x_num_col_dims', 1)
+    yd = ctx.attr('y_num_col_dims', 1)
+    x2 = _flatten_2d(x, xd)
+    y2 = _flatten_2d(y, yd)
+    out = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xd] + y.shape[yd:]
+    ctx.set_output('Out', out.reshape(out_shape))
+
+
+@register('matmul')
+def _matmul(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    if ctx.attr('transpose_X', False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr('transpose_Y', False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr('alpha', 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output('Out', out)
+
+
+def _broadcast_y(x, y, axis):
+    """Fluid elementwise broadcast: align y's dims to x starting at `axis`."""
+    if x.shape == y.shape:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register('elementwise_' + name)
+    def _op(ctx, fn=fn):
+        x = ctx.input('X')
+        y = _broadcast_y(x, ctx.input('Y'), ctx.attr('axis', -1))
+        ctx.set_output('Out', fn(x, y))
+
+
+_register_elementwise('add', lambda x, y: x + y)
+_register_elementwise('sub', lambda x, y: x - y)
+_register_elementwise('mul', lambda x, y: x * y)
+_register_elementwise('div', lambda x, y: x / y)
+_register_elementwise('max', jnp.maximum)
+_register_elementwise('min', jnp.minimum)
+_register_elementwise('pow', jnp.power)
+_register_elementwise('mod', jnp.mod)
+_register_elementwise('floordiv', jnp.floor_divide)
+
+
+def _register_reduce(name, fn):
+    @register('reduce_' + name)
+    def _op(ctx, fn=fn):
+        x = ctx.input('X')
+        if ctx.attr('reduce_all', False):
+            out = fn(x)
+            if ctx.attr('keep_dim', False):
+                out = out.reshape((1,) * x.ndim)
+        else:
+            dim = ctx.attr('dim', [0])
+            if isinstance(dim, int):
+                dim = [dim]
+            axes = tuple(d % x.ndim for d in dim)
+            out = fn(x, axis=axes)
+            if ctx.attr('keep_dim', False):
+                for ax in sorted(axes):
+                    out = jnp.expand_dims(out, ax)
+        ctx.set_output('Out', out)
+
+
+_register_reduce('sum', jnp.sum)
+_register_reduce('mean', jnp.mean)
+_register_reduce('max', jnp.max)
+_register_reduce('min', jnp.min)
+_register_reduce('prod', jnp.prod)
+
+
+@register('mean')
+def _mean(ctx):
+    """Scalar mean, shaped [1] like the reference LoDTensor (mean_op.cc)."""
+    ctx.set_output('Out', jnp.mean(ctx.input('X')).reshape(1))
+
+
+@register('scale')
+def _scale(ctx):
+    x = ctx.input('X')
+    scale = ctx.attr('scale', 1.0)
+    bias = ctx.attr('bias', 0.0)
+    if ctx.attr('bias_after_scale', True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    ctx.set_output('Out', out.astype(x.dtype))
+
+
+def _register_compare(name, fn):
+    @register(name)
+    def _op(ctx, fn=fn):
+        x = ctx.input('X')
+        y = ctx.input('Y')
+        ctx.set_output('Out', fn(x, y))
+
+
+_register_compare('less_than', lambda x, y: x < y)
+_register_compare('less_equal', lambda x, y: x <= y)
+_register_compare('greater_than', lambda x, y: x > y)
+_register_compare('greater_equal', lambda x, y: x >= y)
+_register_compare('equal', lambda x, y: x == y)
+_register_compare('not_equal', lambda x, y: x != y)
+
+
+def _register_logical(name, fn, unary=False):
+    @register('logical_' + name)
+    def _op(ctx, fn=fn, unary=unary):
+        x = ctx.input('X')
+        if unary:
+            ctx.set_output('Out', fn(x))
+        else:
+            ctx.set_output('Out', fn(x, ctx.input('Y')))
+
+
+_register_logical('and', jnp.logical_and)
+_register_logical('or', jnp.logical_or)
+_register_logical('xor', jnp.logical_xor)
+_register_logical('not', jnp.logical_not, unary=True)
+
+
+@register('cos_sim')
+def _cos_sim(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_output('Out', out)
+    ctx.set_output('XNorm', xn)
+    ctx.set_output('YNorm', yn)
+
+
+@register('dot')
+def _dot(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    ctx.set_output('Out', jnp.sum(x * y, axis=-1, keepdims=True))

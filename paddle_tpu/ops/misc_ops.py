@@ -1,0 +1,133 @@
+"""Misc ops: edit distance, lr-decay helpers, arg ops, interpolation.
+
+Reference: paddle/fluid/operators/{edit_distance_op,arg_min_max_op,
+bilinear_interp_op,...}.cc
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('argmax')
+def _argmax(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.argmax(x, axis=ctx.attr('axis', -1))
+                   .astype(jnp.int64))
+
+
+@register('argmin')
+def _argmin(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.argmin(x, axis=ctx.attr('axis', -1))
+                   .astype(jnp.int64))
+
+
+@register('argsort')
+def _argsort(ctx):
+    x = ctx.input('X')
+    axis = ctx.attr('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set_output('Indices', idx.astype(jnp.int64))
+    ctx.set_output('Out', jnp.sort(x, axis=axis))
+
+
+@register('edit_distance')
+def _edit_distance(ctx):
+    """Levenshtein distance between padded int sequences (edit_distance_op.cc).
+    Computed with a lax.scan DP over the static max length."""
+    hyp = ctx.input('Hyps')  # [b, th] int
+    ref = ctx.input('Refs')  # [b, tr] int
+    hyp_len = ctx.input('HypsLength').reshape(-1) if \
+        ctx.has_input('HypsLength') else \
+        jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
+    ref_len = ctx.input('RefsLength').reshape(-1) if \
+        ctx.has_input('RefsLength') else \
+        jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def per_example(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(prev_row, i):
+            ins = prev_row[1:] + 1.0
+            sub = prev_row[:-1] + (h[i] != r).astype(jnp.float32)
+            left0 = prev_row[0] + 1.0
+
+            def body(carry, j):
+                dele = carry + 1.0
+                cur = jnp.minimum(jnp.minimum(ins[j], sub[j]), dele)
+                return cur, cur
+
+            _, rest = jax.lax.scan(body, left0, jnp.arange(tr))
+            new_row = jnp.concatenate([left0[None], rest])
+            valid = i < hl
+            return jnp.where(valid, new_row, prev_row), None
+
+        final_row, _ = jax.lax.scan(step, row0, jnp.arange(th))
+        return final_row[rl]
+
+    dist = jax.vmap(per_example)(hyp, ref, hyp_len, ref_len)
+    if ctx.attr('normalized', False):
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    ctx.set_output('Out', dist.reshape(b, 1))
+    ctx.set_output('SequenceNum', jnp.asarray([b], jnp.int64))
+
+
+@register('bilinear_interp')
+def _bilinear_interp(ctx):
+    x = ctx.input('X')  # NCHW
+    out_h = ctx.attr('out_h')
+    out_w = ctx.attr('out_w')
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, out_h, out_w), method='bilinear')
+    ctx.set_output('Out', out.astype(x.dtype))
+
+
+@register('nearest_interp')
+def _nearest_interp(ctx):
+    x = ctx.input('X')
+    n, c, h, w = x.shape
+    out = jax.image.resize(x, (n, c, ctx.attr('out_h'), ctx.attr('out_w')),
+                           method='nearest')
+    ctx.set_output('Out', out.astype(x.dtype))
+
+
+@register('isfinite')
+def _isfinite(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.all(jnp.isfinite(x)).reshape(1))
+
+
+@register('print')
+def _print(ctx):
+    x = ctx.input('In')
+    jax.debug.print(ctx.attr('message', 'print: ') + '{}', x)
+    ctx.set_output('Out', x)
+
+
+@register('lod_reset')
+def _lod_reset(ctx):
+    ctx.set_output('Out', ctx.input('X'))
+
+
+@register('where')
+def _where(ctx):
+    ctx.set_output('Out', jnp.where(ctx.input('Condition') > 0,
+                                    ctx.input('X'), ctx.input('Y')))
+
+
+@register('linspace')
+def _linspace(ctx):
+    ctx.set_output('Out', jnp.linspace(
+        ctx.attr('start'), ctx.attr('stop'), ctx.attr('num'),
+        dtype=ctx.out_dtype('Out')))
+
+
+@register('range')
+def _range(ctx):
+    ctx.set_output('Out', jnp.arange(
+        ctx.attr('start', 0), ctx.attr('end'), ctx.attr('step', 1),
+        dtype=ctx.out_dtype('Out')))

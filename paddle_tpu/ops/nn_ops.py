@@ -1,0 +1,302 @@
+"""NN ops: embedding, losses, dropout, normalization helpers.
+
+Reference: paddle/fluid/operators/{lookup_table_op,cross_entropy_op,
+softmax_with_cross_entropy_op,dropout_op,accuracy_op,...}.cc
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('lookup_table')
+def _lookup_table(ctx):
+    """Embedding lookup (lookup_table_op.cc). On TPU a dense gather —
+    XLA lowers to an efficient dynamic-gather on HBM; sparse-grad is
+    unnecessary because the grad is computed by XLA scatter-add."""
+    w = ctx.input('W')
+    ids = ctx.input('Ids')
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.squeeze(-1)
+    padding_idx = ctx.attr('padding_idx', -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    ctx.set_output('Out', out)
+
+
+@register('cross_entropy')
+def _cross_entropy(ctx):
+    """-log(p[label]); soft_label supported (cross_entropy_op.cc)."""
+    x = ctx.input('X')
+    label = ctx.input('Label')
+    eps = 1e-8
+    if ctx.attr('soft_label', False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if label.ndim == x.ndim and label.shape[-1] == 1:
+            label = label.squeeze(-1)
+        p = jnp.take_along_axis(x, label[..., None].astype(jnp.int32),
+                                axis=-1)
+        loss = -jnp.log(p + eps)
+    ctx.set_output('Y', loss)
+
+
+@register('softmax_with_cross_entropy')
+def _softmax_xent(ctx):
+    logits = ctx.input('Logits')
+    label = ctx.input('Label')
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr('soft_label', False):
+        loss = -jnp.sum(label * log_probs, axis=-1, keepdims=True)
+    else:
+        if label.ndim == logits.ndim and label.shape[-1] == 1:
+            label = label.squeeze(-1)
+        picked = jnp.take_along_axis(log_probs,
+                                     label[..., None].astype(jnp.int32),
+                                     axis=-1)
+        loss = -picked
+        ignore_index = ctx.attr('ignore_index', -100)
+        if ignore_index is not None and ignore_index >= 0:
+            mask = (label[..., None] != ignore_index)
+            loss = loss * mask.astype(loss.dtype)
+    ctx.set_output('Softmax', jnp.exp(log_probs))
+    ctx.set_output('Loss', loss)
+
+
+@register('sigmoid_cross_entropy_with_logits')
+def _sigmoid_xent(ctx):
+    x = ctx.input('X')
+    label = ctx.input('Label')
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output('Out', loss)
+
+
+@register('square_error_cost')
+def _square_error_cost(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    ctx.set_output('Out', jnp.square(x - y))
+
+
+@register('smooth_l1_loss')
+def _smooth_l1(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    sigma = ctx.attr('sigma', 1.0)
+    sigma2 = sigma * sigma
+    diff = x - y
+    if ctx.has_input('InsideWeight'):
+        diff = diff * ctx.input('InsideWeight')
+    absd = jnp.abs(diff)
+    loss = jnp.where(absd < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff),
+                     absd - 0.5 / sigma2)
+    if ctx.has_input('OutsideWeight'):
+        loss = loss * ctx.input('OutsideWeight')
+    ctx.set_output('Diff', diff)
+    ctx.set_output('Out', jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                                  keepdims=False)[..., None]
+                   if loss.ndim > 1 else loss)
+
+
+@register('dropout')
+def _dropout(ctx):
+    """dropout_op.cc semantics: train: out = x*mask (downgrade_in_infer)
+    or x*mask/(1-p) (upscale_in_train); test: x*(1-p) or x."""
+    x = ctx.input('X')
+    p = ctx.attr('dropout_prob', 0.5)
+    impl = ctx.attr('dropout_implementation', 'downgrade_in_infer')
+    is_test = ctx.attr('is_test', False) or ctx.is_test
+    if is_test:
+        out = x * (1.0 - p) if impl == 'downgrade_in_infer' else x
+        mask = jnp.ones_like(x)
+    else:
+        keep = jax.random.bernoulli(ctx.rng_key(), 1.0 - p, x.shape)
+        mask = keep.astype(x.dtype)
+        out = x * mask
+        if impl == 'upscale_in_train' and p < 1.0:
+            out = out / (1.0 - p)
+    ctx.set_output('Mask', mask)
+    ctx.set_output('Out', out)
+
+
+@register('accuracy')
+def _accuracy(ctx):
+    """accuracy_op.cc: fraction of rows where any of top-k indices == label."""
+    indices = ctx.input('Indices')
+    label = ctx.input('Label')
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label_cmp = label
+    else:
+        label_cmp = label[..., None]
+    correct = jnp.any(indices == label_cmp, axis=-1)
+    acc = jnp.mean(correct.astype(jnp.float32)).reshape(1)
+    ctx.set_output('Accuracy', acc)
+    ctx.set_output('Correct', jnp.sum(correct.astype(jnp.int32)).reshape(1))
+    ctx.set_output('Total', jnp.asarray([indices.shape[0]], dtype=jnp.int32))
+
+
+@register('auc')
+def _auc(ctx):
+    """Streaming-free AUC approximation over the batch (auc_op.cc)."""
+    probs = ctx.input('Predict')
+    label = ctx.input('Label').reshape(-1)
+    pos_score = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 \
+        else probs.reshape(-1)
+    label_f = label.astype(jnp.float32)
+    pos = label_f
+    neg = 1.0 - label_f
+    # rank-based AUC: P(score_pos > score_neg)
+    diff = pos_score[:, None] - pos_score[None, :]
+    wins = (diff > 0).astype(jnp.float32) + 0.5 * (diff == 0)
+    num = jnp.sum(wins * pos[:, None] * neg[None, :])
+    den = jnp.sum(pos) * jnp.sum(neg)
+    ctx.set_output('AUC', (num / jnp.maximum(den, 1.0)).reshape(1))
+
+
+@register('nce')
+def _nce(ctx):
+    """NCE via uniform negative sampling (nce_op.cc), fused sampled-softmax
+    form: loss = -log σ(s_pos) - Σ log σ(-s_neg)."""
+    x = ctx.input('Input')          # [b, d]
+    label = ctx.input('Label')      # [b, 1]
+    w = ctx.input('Weight')         # [V, d]
+    b = ctx.input('Bias')           # [V, 1]
+    num_neg = ctx.attr('num_neg_samples', 10)
+    num_classes = ctx.attr('num_total_classes')
+    ids = label.reshape(-1).astype(jnp.int32)
+    pos_w = jnp.take(w, ids, axis=0)                    # [b, d]
+    pos_b = jnp.take(b.reshape(-1), ids)                # [b]
+    s_pos = jnp.sum(x * pos_w, axis=-1) + pos_b
+    neg_ids = jax.random.randint(ctx.rng_key(), (num_neg,), 0, num_classes)
+    neg_w = jnp.take(w, neg_ids, axis=0)                # [k, d]
+    neg_b = jnp.take(b.reshape(-1), neg_ids)            # [k]
+    s_neg = x @ neg_w.T + neg_b                         # [b, k]
+    loss = -jax.nn.log_sigmoid(s_pos) - \
+        jnp.sum(jax.nn.log_sigmoid(-s_neg), axis=-1)
+    ctx.set_output('Cost', loss[:, None])
+
+
+@register('l2_normalize')
+def _l2_normalize(ctx):
+    x = ctx.input('X')
+    axis = ctx.attr('axis', -1)
+    eps = ctx.attr('epsilon', 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    ctx.set_output('Out', x / jnp.maximum(norm, eps))
+    ctx.set_output('Norm', norm)
+
+
+@register('maxout')
+def _maxout(ctx):
+    x = ctx.input('X')  # NCHW
+    groups = ctx.attr('groups')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // groups, groups, h, w).max(axis=2)
+    ctx.set_output('Out', out)
+
+
+@register('im2sequence')
+def _im2sequence(ctx):
+    """im2sequence_op.cc: extract patches as a sequence (OCR models)."""
+    x = ctx.input('X')  # NCHW
+    kh, kw = ctx.attr('kernels')
+    sh, sw = ctx.attr('strides', [1, 1])
+    ph0, pw0, ph1, pw1 = ctx.attr('paddings', [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), 'VALID',
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    # patches: [n, c*kh*kw, oh, ow] -> [n*oh*ow, c*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    ctx.set_output('Out', out)
+
+
+@register('label_smooth')
+def _label_smooth(ctx):
+    x = ctx.input('X')
+    eps = ctx.attr('epsilon', 0.1)
+    k = x.shape[-1]
+    if ctx.has_input('PriorDist'):
+        prior = ctx.input('PriorDist')
+        out = (1.0 - eps) * x + eps * prior
+    else:
+        out = (1.0 - eps) * x + eps / k
+    ctx.set_output('Out', out)
+
+
+@register('huber_loss')
+def _huber_loss(ctx):
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    delta = ctx.attr('delta', 1.0)
+    r = y - x
+    absr = jnp.abs(r)
+    loss = jnp.where(absr <= delta, 0.5 * jnp.square(r),
+                     delta * (absr - 0.5 * delta))
+    ctx.set_output('Residual', r)
+    ctx.set_output('Out', loss)
+
+
+@register('rank_loss')
+def _rank_loss(ctx):
+    label = ctx.input('Label')
+    left = ctx.input('Left')
+    right = ctx.input('Right')
+    out = jnp.log1p(jnp.exp(left - right)) - label * (left - right)
+    ctx.set_output('Out', out)
+
+
+@register('margin_rank_loss')
+def _margin_rank_loss(ctx):
+    label = ctx.input('Label')
+    x1 = ctx.input('X1')
+    x2 = ctx.input('X2')
+    margin = ctx.attr('margin', 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output('Out', out)
+    ctx.set_output('Activated', (out > 0).astype(x1.dtype))
+
+
+@register('hinge_loss')
+def _hinge_loss(ctx):
+    logits = ctx.input('Logits')
+    labels = ctx.input('Labels')
+    ctx.set_output('Loss', jnp.maximum(
+        0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+@register('log_loss')
+def _log_loss(ctx):
+    pred = ctx.input('Predicted')
+    label = ctx.input('Labels')
+    eps = ctx.attr('epsilon', 1e-7)
+    ctx.set_output('Loss', -label * jnp.log(pred + eps) -
+                   (1.0 - label) * jnp.log(1.0 - pred + eps))
+
+
+@register('bilinear_tensor_product')
+def _bilinear_tensor_product(ctx):
+    x = ctx.input('X')  # [b, m]
+    y = ctx.input('Y')  # [b, n]
+    w = ctx.input('Weight')  # [k, m, n]
+    out = jnp.einsum('bm,kmn,bn->bk', x, w, y)
+    if ctx.has_input('Bias'):
+        out = out + ctx.input('Bias')
+    ctx.set_output('Out', out)
+
+
+@register('pixel_shuffle')
+def _pixel_shuffle(ctx):
+    x = ctx.input('X')  # NCHW
+    r = ctx.attr('upscale_factor')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    ctx.set_output('Out', out)

@@ -1,0 +1,99 @@
+"""Normalization ops: batch_norm, layer_norm, group_norm.
+
+Reference: paddle/fluid/operators/{batch_norm_op,layer_norm_op}.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('batch_norm')
+def _batch_norm(ctx):
+    x = ctx.input('X')
+    scale = ctx.input('Scale')
+    bias = ctx.input('Bias')
+    mean = ctx.input('Mean')
+    variance = ctx.input('Variance')
+    momentum = ctx.attr('momentum', 0.9)
+    eps = ctx.attr('epsilon', 1e-5)
+    is_test = ctx.attr('is_test', False) or ctx.is_test
+    layout = ctx.attr('data_layout', 'NCHW')
+
+    if layout == 'NCHW' and x.ndim == 4:
+        axes = (0, 2, 3)
+        bshape = (1, -1, 1, 1)
+    elif x.ndim == 4:  # NHWC
+        axes = (0, 1, 2)
+        bshape = (1, 1, 1, -1)
+    else:  # [N, C]
+        axes = (0,)
+        bshape = (1, -1)
+
+    if is_test:
+        use_mean, use_var = mean, variance
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1.0 - momentum) * use_mean
+        new_var = momentum * variance + (1.0 - momentum) * use_var
+        ctx.set_output('MeanOut', jax.lax.stop_gradient(new_mean))
+        ctx.set_output('VarianceOut', jax.lax.stop_gradient(new_var))
+        ctx.set_output('SavedMean', jax.lax.stop_gradient(use_mean))
+        ctx.set_output('SavedVariance', jax.lax.stop_gradient(use_var))
+
+    inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
+    out = (x - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) + \
+        bias.reshape(bshape)
+    ctx.set_output('Y', out)
+
+
+@register('layer_norm')
+def _layer_norm(ctx):
+    x = ctx.input('X')
+    begin = ctx.attr('begin_norm_axis', 1)
+    eps = ctx.attr('epsilon', 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ctx.has_input('Scale'):
+        out = out * ctx.input('Scale').reshape(norm_shape)
+    if ctx.has_input('Bias'):
+        out = out + ctx.input('Bias').reshape(norm_shape)
+    ctx.set_output('Mean', mean.reshape(x.shape[:begin]))
+    ctx.set_output('Variance', var.reshape(x.shape[:begin]))
+    ctx.set_output('Y', out)
+
+
+@register('group_norm')
+def _group_norm(ctx):
+    x = ctx.input('X')  # NCHW
+    groups = ctx.attr('groups', 32)
+    eps = ctx.attr('epsilon', 1e-5)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * len(spatial)
+    if ctx.has_input('Scale'):
+        out = out * ctx.input('Scale').reshape(bshape)
+    if ctx.has_input('Bias'):
+        out = out + ctx.input('Bias').reshape(bshape)
+    ctx.set_output('Y', out)
+
+
+@register('norm')
+def _norm(ctx):
+    """L2 norm along axis (norm_op.cc)."""
+    x = ctx.input('X')
+    axis = ctx.attr('axis', 1)
+    eps = ctx.attr('epsilon', 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    ctx.set_output('Norm', norm)
+    ctx.set_output('Out', x / norm)

@@ -1,0 +1,193 @@
+"""Optimizer update ops.
+
+Reference: paddle/fluid/operators/{sgd_op,momentum_op,adam_op,adagrad_op,
+adamax_op,adadelta_op,decayed_adagrad_op,rmsprop_op,ftrl_op}.cc.
+Each op consumes Param/Grad/LearningRate (+ accumulators) from the traced
+env and writes ParamOut/accumulator-out under the same persistable names,
+so the whole update fuses into the train-step XLA computation with
+donated (in-place) parameter buffers.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _lr(ctx):
+    lr = ctx.input('LearningRate')
+    return lr.reshape(()) if hasattr(lr, 'reshape') else lr
+
+
+@register('sgd')
+def _sgd(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    lr = _lr(ctx)
+    ctx.set_output('ParamOut', (p - lr * g).astype(p.dtype))
+
+
+@register('momentum')
+def _momentum(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    v = ctx.input('Velocity')
+    lr = _lr(ctx)
+    mu = ctx.attr('mu', 0.9)
+    v_out = mu * v + g
+    if ctx.attr('use_nesterov', False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    ctx.set_output('VelocityOut', v_out.astype(v.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('adam')
+def _adam(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    m = ctx.input('Moment1')
+    v = ctx.input('Moment2')
+    beta1_pow = ctx.input('Beta1Pow')
+    beta2_pow = ctx.input('Beta2Pow')
+    lr = _lr(ctx)
+    b1 = ctx.attr('beta1', 0.9)
+    b2 = ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    v_out = b2 * v + (1.0 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1.0 - beta2_pow.reshape(())) / \
+        (1.0 - beta1_pow.reshape(()))
+    p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    ctx.set_output('Moment1Out', m_out.astype(m.dtype))
+    ctx.set_output('Moment2Out', v_out.astype(v.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('adam_beta_pow_update')
+def _adam_beta_pow_update(ctx):
+    b1p = ctx.input('Beta1Pow')
+    b2p = ctx.input('Beta2Pow')
+    ctx.set_output('Beta1PowOut', b1p * ctx.attr('beta1', 0.9))
+    ctx.set_output('Beta2PowOut', b2p * ctx.attr('beta2', 0.999))
+
+
+@register('adagrad')
+def _adagrad(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    m = ctx.input('Moment')
+    lr = _lr(ctx)
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    ctx.set_output('MomentOut', m_out.astype(m.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('adamax')
+def _adamax(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    m = ctx.input('Moment')
+    inf_norm = ctx.input('InfNorm')
+    beta1_pow = ctx.input('Beta1Pow')
+    lr = _lr(ctx)
+    b1 = ctx.attr('beta1', 0.9)
+    b2 = ctx.attr('beta2', 0.999)
+    eps = ctx.attr('epsilon', 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1.0 - beta1_pow.reshape(()))
+    p_out = p - lr_t * m_out / inf_out
+    ctx.set_output('MomentOut', m_out.astype(m.dtype))
+    ctx.set_output('InfNormOut', inf_out.astype(inf_norm.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('beta_pow_update')
+def _beta_pow_update(ctx):
+    bp = ctx.input('BetaPow')
+    ctx.set_output('BetaPowOut', bp * ctx.attr('beta', 0.9))
+
+
+@register('decayed_adagrad')
+def _decayed_adagrad(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    m = ctx.input('Moment')
+    lr = _lr(ctx)
+    decay = ctx.attr('decay', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    m_out = decay * m + (1.0 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    ctx.set_output('MomentOut', m_out.astype(m.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('adadelta')
+def _adadelta(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    avg_sq_grad = ctx.input('AvgSquaredGrad')
+    avg_sq_update = ctx.input('AvgSquaredUpdate')
+    rho = ctx.attr('rho', 0.95)
+    eps = ctx.attr('epsilon', 1e-6)
+    asg_out = rho * avg_sq_grad + (1.0 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_update + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_update + (1.0 - rho) * jnp.square(update)
+    ctx.set_output('AvgSquaredGradOut', asg_out.astype(avg_sq_grad.dtype))
+    ctx.set_output('AvgSquaredUpdateOut', asu_out.astype(avg_sq_update.dtype))
+    ctx.set_output('ParamOut', (p + update).astype(p.dtype))
+
+
+@register('rmsprop')
+def _rmsprop(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    ms = ctx.input('MeanSquare')
+    mom = ctx.input('Moment')
+    lr = _lr(ctx)
+    rho = ctx.attr('decay', 0.9)
+    eps = ctx.attr('epsilon', 1e-10)
+    momentum = ctx.attr('momentum', 0.0)
+    ms_out = rho * ms + (1.0 - rho) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    ctx.set_output('MeanSquareOut', ms_out.astype(ms.dtype))
+    ctx.set_output('MomentOut', mom_out.astype(mom.dtype))
+    ctx.set_output('ParamOut', (p - mom_out).astype(p.dtype))
+
+
+@register('ftrl')
+def _ftrl(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    sq_accum = ctx.input('SquaredAccumulator')
+    lin_accum = ctx.input('LinearAccumulator')
+    lr = _lr(ctx)
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    lr_power = ctx.attr('lr_power', -0.5)
+    new_accum = sq_accum + jnp.square(g)
+    lin_out = lin_accum + g - (
+        jnp.power(new_accum, -lr_power) - jnp.power(sq_accum, -lr_power)
+    ) / lr * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    y = jnp.power(new_accum, -lr_power) / lr + 2.0 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    ctx.set_output('SquaredAccumOut', new_accum.astype(sq_accum.dtype))
+    ctx.set_output('LinearAccumOut', lin_out.astype(lin_accum.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('proximal_gd')
+def _proximal_gd(ctx):
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    lr = _lr(ctx)
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))

@@ -1,0 +1,85 @@
+"""Random ops with TPU-native stateless PRNG.
+
+Reference: paddle/fluid/operators/{uniform_random_op,gaussian_random_op}.cc.
+Each op instance folds the step key with its static op index, so runs are
+reproducible under jit and across replicas without a mutable global state.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _shape_from(ctx):
+    return [int(s) for s in ctx.attr('shape')]
+
+
+@register('uniform_random')
+def _uniform_random(ctx):
+    shape = _shape_from(ctx)
+    lo = ctx.attr('min', -1.0)
+    hi = ctx.attr('max', 1.0)
+    dtype = ctx.out_dtype('Out')
+    seed = ctx.attr('seed', 0)
+    key = ctx.rng_key() if not seed else jax.random.PRNGKey(seed)
+    ctx.set_output('Out', jax.random.uniform(
+        key, shape, dtype=jnp.float32, minval=lo, maxval=hi).astype(dtype))
+
+
+@register('uniform_random_batch_size_like')
+def _uniform_random_bsl(ctx):
+    ref = ctx.input('Input')
+    shape = _shape_from(ctx)
+    shape[ctx.attr('output_dim_idx', 0)] = ref.shape[ctx.attr('input_dim_idx', 0)]
+    ctx.set_output('Out', jax.random.uniform(
+        ctx.rng_key(), shape, dtype=jnp.float32,
+        minval=ctx.attr('min', -1.0),
+        maxval=ctx.attr('max', 1.0)).astype(ctx.out_dtype('Out')))
+
+
+@register('gaussian_random')
+def _gaussian_random(ctx):
+    shape = _shape_from(ctx)
+    mean = ctx.attr('mean', 0.0)
+    std = ctx.attr('std', 1.0)
+    seed = ctx.attr('seed', 0)
+    key = ctx.rng_key() if not seed else jax.random.PRNGKey(seed)
+    out = mean + std * jax.random.normal(key, shape, dtype=jnp.float32)
+    ctx.set_output('Out', out.astype(ctx.out_dtype('Out')))
+
+
+@register('truncated_gaussian_random')
+def _truncated_gaussian_random(ctx):
+    shape = _shape_from(ctx)
+    mean = ctx.attr('mean', 0.0)
+    std = ctx.attr('std', 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        ctx.rng_key(), -2.0, 2.0, shape, dtype=jnp.float32)
+    ctx.set_output('Out', out.astype(ctx.out_dtype('Out')))
+
+
+@register('gaussian_random_batch_size_like')
+def _gaussian_random_bsl(ctx):
+    ref = ctx.input('Input')
+    shape = _shape_from(ctx)
+    shape[ctx.attr('output_dim_idx', 0)] = ref.shape[ctx.attr('input_dim_idx', 0)]
+    out = ctx.attr('mean', 0.0) + ctx.attr('std', 1.0) * jax.random.normal(
+        ctx.rng_key(), shape, dtype=jnp.float32)
+    ctx.set_output('Out', out.astype(ctx.out_dtype('Out')))
+
+
+@register('randint')
+def _randint(ctx):
+    shape = _shape_from(ctx)
+    ctx.set_output('Out', jax.random.randint(
+        ctx.rng_key(), shape, ctx.attr('low', 0), ctx.attr('high', 100),
+        dtype=jnp.int32).astype(ctx.out_dtype('Out', 'int64')))
+
+
+@register('shuffle_batch')
+def _shuffle_batch(ctx):
+    x = ctx.input('X')
+    perm = jax.random.permutation(ctx.rng_key(), x.shape[0])
+    ctx.set_output('Out', x[perm])
+    ctx.set_output('ShuffleIdx', perm)

@@ -1,0 +1,126 @@
+"""Sequence ops over dense padded batches + length masks.
+
+Reference: paddle/fluid/operators/sequence_*_op.cc operate on LoDTensors
+(ragged rows). TPU-native design: sequences are [batch, max_len, ...] dense
+arrays plus an int32 [batch] length vector — static shapes for XLA; masking
+replaces LoD bookkeeping. The 'X_length' auxiliary input carries lengths.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _mask(lengths, max_len, dtype=jnp.float32):
+    return (jnp.arange(max_len)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register('sequence_pool')
+def _sequence_pool(ctx):
+    x = ctx.input('X')  # [b, t, d]
+    pool_type = ctx.attr('pooltype', 'AVERAGE').upper()
+    if ctx.has_input('Length'):
+        lengths = ctx.input('Length').reshape(-1)
+        m = _mask(lengths, x.shape[1], x.dtype)[..., None]
+    else:
+        lengths = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+        m = jnp.ones(x.shape[:2], x.dtype)[..., None]
+    if pool_type == 'AVERAGE':
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            lengths[:, None].astype(x.dtype), 1)
+    elif pool_type == 'SUM':
+        out = jnp.sum(x * m, axis=1)
+    elif pool_type == 'SQRT':
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            lengths[:, None].astype(x.dtype), 1))
+    elif pool_type == 'MAX':
+        neg = jnp.asarray(-1e9, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif pool_type == 'FIRST':
+        out = x[:, 0]
+    elif pool_type == 'LAST':
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  axis=1).squeeze(1)
+    else:
+        raise NotImplementedError('sequence_pool type %r' % pool_type)
+    ctx.set_output('Out', out)
+
+
+@register('sequence_softmax')
+def _sequence_softmax(ctx):
+    x = ctx.input('X')  # [b, t]
+    if ctx.has_input('Length'):
+        lengths = ctx.input('Length').reshape(-1)
+        m = _mask(lengths, x.shape[1], x.dtype)
+        x = jnp.where(m > 0, x, jnp.asarray(-1e9, x.dtype))
+    ctx.set_output('Out', jax.nn.softmax(x, axis=-1))
+
+
+@register('sequence_expand')
+def _sequence_expand(ctx):
+    """Broadcast per-sequence rows across time (simplified dense form)."""
+    x = ctx.input('X')  # [b, d]
+    y = ctx.input('Y')  # [b, t, ...] provides the target time dim
+    t = y.shape[1]
+    ctx.set_output('Out', jnp.broadcast_to(
+        x[:, None, :], (x.shape[0], t, x.shape[-1])))
+
+
+@register('sequence_reshape')
+def _sequence_reshape(ctx):
+    x = ctx.input('X')  # [b, t, d]
+    new_dim = ctx.attr('new_dim')
+    b = x.shape[0]
+    ctx.set_output('Out', x.reshape(b, -1, new_dim))
+
+
+@register('sequence_concat')
+def _sequence_concat(ctx):
+    xs = ctx.input_list('X')
+    ctx.set_output('Out', jnp.concatenate(xs, axis=1))
+
+
+@register('sequence_slice')
+def _sequence_slice(ctx):
+    x = ctx.input('X')
+    offset = ctx.attr('offset', 0)
+    length = ctx.attr('length')
+    ctx.set_output('Out', jax.lax.dynamic_slice_in_dim(x, offset, length,
+                                                       axis=1))
+
+
+@register('sequence_conv')
+def _sequence_conv(ctx):
+    """Context-window conv over time (sequence_conv_op.cc)."""
+    x = ctx.input('X')  # [b, t, d]
+    w = ctx.input('Filter')  # [ctx_len * d, out_d]
+    ctx_len = ctx.attr('contextLength', 3)
+    ctx_start = ctx.attr('contextStart', -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        shift = ctx_start + i
+        if shift < 0:
+            pad = jnp.zeros((b, -shift, d), x.dtype)
+            sl = jnp.concatenate([pad, x[:, :t + shift]], axis=1)
+        elif shift > 0:
+            pad = jnp.zeros((b, shift, d), x.dtype)
+            sl = jnp.concatenate([x[:, shift:], pad], axis=1)
+        else:
+            sl = x
+        cols.append(sl)
+    im2col = jnp.concatenate(cols, axis=-1)  # [b, t, ctx_len*d]
+    ctx.set_output('Out', jnp.einsum('btc,co->bto', im2col, w))
+
+
+@register('sequence_erase')
+def _sequence_erase(ctx):
+    # Token removal needs dynamic shapes; on TPU we mask instead.
+    x = ctx.input('X')
+    tokens = ctx.attr('tokens', [])
+    mask = jnp.ones_like(x, dtype=bool)
+    for tok in tokens:
+        mask = mask & (x != tok)
+    ctx.set_output('Out', jnp.where(mask, x, jnp.zeros_like(x)))

@@ -1,0 +1,268 @@
+"""Tensor creation / manipulation ops.
+
+Reference: paddle/fluid/operators/{fill_constant_op,cast_op,concat_op,
+assign_op,sum_op,split_op,reshape_op,transpose_op,one_hot_op,...}.cc
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('fill_constant')
+def _fill_constant(ctx):
+    shape = [int(s) for s in ctx.attr('shape')]
+    value = ctx.attr('value', 0.0)
+    dtype = ctx.out_dtype('Out')
+    ctx.set_output('Out', jnp.full(shape, value, dtype=dtype))
+
+
+@register('fill_constant_batch_size_like')
+def _fill_constant_bsl(ctx):
+    ref = ctx.input('Input')
+    shape = [int(s) for s in ctx.attr('shape')]
+    in_idx = ctx.attr('input_dim_idx', 0)
+    out_idx = ctx.attr('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = ctx.out_dtype('Out')
+    ctx.set_output('Out', jnp.full(shape, ctx.attr('value', 0.0), dtype=dtype))
+
+
+@register('assign_value')
+def _assign_value(ctx):
+    import numpy as np
+    values = np.asarray(ctx.attr('values'))
+    shape = ctx.attr('shape', None)
+    if shape:
+        values = values.reshape(shape)
+    ctx.set_output('Out', jnp.asarray(values, dtype=ctx.out_dtype('Out')))
+
+
+@register('cast')
+def _cast(ctx):
+    from ..core.dtypes import to_jnp_dtype
+    x = ctx.input('X')
+    ctx.set_output('Out', x.astype(to_jnp_dtype(ctx.attr('out_dtype'))))
+
+
+@register('concat')
+def _concat(ctx):
+    xs = ctx.input_list('X')
+    ctx.set_output('Out', jnp.concatenate(xs, axis=ctx.attr('axis', 0)))
+
+
+@register('assign')
+def _assign(ctx):
+    ctx.set_output('Out', ctx.input('X'))
+
+
+@register('sum')
+def _sum(ctx):
+    xs = ctx.input_list('X')
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output('Out', out)
+
+
+@register('split')
+def _split(ctx):
+    x = ctx.input('X')
+    axis = ctx.attr('axis', 0)
+    sections = ctx.attr('sections', None)
+    num = ctx.attr('num', 0)
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    ctx.set_output_list('Out', outs)
+
+
+@register('reshape')
+def _reshape(ctx):
+    x = ctx.input('X')
+    shape = list(ctx.attr('shape'))
+    # fluid semantics: 0 -> copy dim from x, -1 -> infer
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    ctx.set_output('Out', jnp.reshape(x, shape))
+
+
+@register('transpose')
+def _transpose(ctx):
+    ctx.set_output('Out', jnp.transpose(ctx.input('X'), ctx.attr('axis')))
+
+
+@register('one_hot')
+def _one_hot(ctx):
+    x = ctx.input('X')
+    depth = ctx.attr('depth')
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    ctx.set_output('Out', jax.nn.one_hot(x, depth,
+                                         dtype=ctx.out_dtype('Out')))
+
+
+@register('increment')
+def _increment(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', x + jnp.asarray(ctx.attr('step', 1.0), x.dtype))
+
+
+@register('clip')
+def _clip(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.clip(x, ctx.attr('min'), ctx.attr('max')))
+
+
+@register('clip_by_norm')
+def _clip_by_norm(ctx):
+    x = ctx.input('X')
+    max_norm = ctx.attr('max_norm')
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      jnp.asarray(1.0, x.dtype))
+    ctx.set_output('Out', x * scale.astype(x.dtype))
+
+
+@register('global_norm_clip')
+def _global_norm_clip(ctx):
+    """Fused global-norm gradient clip (reference clip.py builds this from
+    many small ops; one op here so XLA fuses the whole rescale)."""
+    grads = ctx.input_list('X')
+    max_norm = ctx.attr('max_global_norm')
+    total = jnp.asarray(0.0, jnp.float32)
+    for g in grads:
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    ctx.set_output_list('Out', [(g * scale.astype(g.dtype)) for g in grads])
+
+
+@register('top_k')
+def _top_k(ctx):
+    x = ctx.input('X')
+    k = ctx.attr('k', 1)
+    values, indices = jax.lax.top_k(x, k)
+    ctx.set_output('Out', values)
+    ctx.set_output('Indices', indices.astype(jnp.int64)
+                   if ctx.out_var('Indices') is not None and
+                   ctx.out_var('Indices').dtype == 'int64' else indices)
+
+
+@register('cumsum')
+def _cumsum(ctx):
+    x = ctx.input('X')
+    axis = ctx.attr('axis', -1)
+    exclusive = ctx.attr('exclusive', False)
+    reverse = ctx.attr('reverse', False)
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    ctx.set_output('Out', out)
+
+
+@register('expand')
+def _expand(ctx):
+    x = ctx.input('X')
+    times = ctx.attr('expand_times')
+    ctx.set_output('Out', jnp.tile(x, times))
+
+
+@register('stack')
+def _stack(ctx):
+    xs = ctx.input_list('X')
+    ctx.set_output('Out', jnp.stack(xs, axis=ctx.attr('axis', 0)))
+
+
+@register('squeeze')
+def _squeeze(ctx):
+    x = ctx.input('X')
+    axes = ctx.attr('axes', None)
+    ctx.set_output('Out', jnp.squeeze(x, axis=tuple(axes) if axes else None))
+
+
+@register('unsqueeze')
+def _unsqueeze(ctx):
+    x = ctx.input('X')
+    for ax in sorted(ctx.attr('axes')):
+        x = jnp.expand_dims(x, ax)
+    ctx.set_output('Out', x)
+
+
+@register('slice')
+def _slice(ctx):
+    x = ctx.input('X')
+    axes = ctx.attr('axes')
+    starts = ctx.attr('starts')
+    ends = ctx.attr('ends')
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    ctx.set_output('Out', x[tuple(idx)])
+
+
+@register('gather')
+def _gather(ctx):
+    x = ctx.input('X')
+    index = ctx.input('Index')
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index.squeeze(-1)
+    ctx.set_output('Out', jnp.take(x, index, axis=0))
+
+
+@register('scatter')
+def _scatter(ctx):
+    x = ctx.input('X')
+    index = ctx.input('Ids')
+    updates = ctx.input('Updates')
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index.squeeze(-1)
+    ctx.set_output('Out', x.at[index].set(updates))
+
+
+@register('shape')
+def _shape(ctx):
+    x = ctx.input('X')
+    ctx.set_output('Out', jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register('pad')
+def _pad(ctx):
+    x = ctx.input('X')
+    paddings = ctx.attr('paddings')
+    pad_value = ctx.attr('pad_value', 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output('Out', jnp.pad(x, cfg, constant_values=pad_value))
+
+
+@register('reverse')
+def _reverse(ctx):
+    x = ctx.input('X')
+    axes = ctx.attr('axis')
+    if isinstance(axes, int):
+        axes = [axes]
+    for ax in axes:
+        x = jnp.flip(x, axis=ax)
+    ctx.set_output('Out', x)
+
+
+@register('multiplex')
+def _multiplex(ctx):
+    ids = ctx.input('Ids')
+    xs = ctx.input_list('X')
+    stacked = jnp.stack(xs, axis=0)  # [n, batch, ...]
+    ids = ids.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output('Out', stacked[ids, rows])

@@ -1,0 +1,415 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py).
+
+Same class surface as the reference (SGD/Momentum/Adagrad/Adam/Adamax/
+DecayedAdagrad + Adadelta/RMSProp/Ftrl). minimize() appends backward +
+clip + regularization + update ops; the Executor fuses everything into the
+single jitted train step with parameter buffers donated in HBM.
+"""
+
+from .clip import append_gradient_clip_ops
+from .core.backward import append_backward
+from .core.program import Variable, default_main_program
+from .initializer import Constant
+from .layers.helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = ['SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
+           'Adadelta', 'RMSProp', 'Ftrl', 'SGDOptimizer',
+           'MomentumOptimizer', 'AdagradOptimizer', 'AdamOptimizer',
+           'AdamaxOptimizer', 'DecayedAdagradOptimizer',
+           'AdadeltaOptimizer', 'RMSPropOptimizer', 'FtrlOptimizer',
+           'Optimizer']
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        if not isinstance(learning_rate, (float, Variable)):
+            raise TypeError('learning_rate must be float or Variable')
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}
+        self._learning_rate_var = None
+        self.helper = None
+
+    # ---------------------------------------------------------------- lr
+    def _create_global_learning_rate(self):
+        if self._learning_rate_var is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_var = self._learning_rate
+            return
+        from .core import unique_name
+        helper = LayerHelper('learning_rate')
+        name = unique_name.generate('learning_rate')
+        var = helper.main_program.global_block().create_var(
+            name=name, shape=(1,), dtype='float32', persistable=True)
+        var.stop_gradient = True
+        Constant(float(self._learning_rate))(var)
+        self._learning_rate_var = var
+
+    def _global_learning_rate(self):
+        return self._learning_rate_var
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        mult = getattr(param, 'optimize_attr', {}).get('learning_rate', 1.0)
+        if mult == 1.0:
+            return self._learning_rate_var
+        helper = LayerHelper('param_lr')
+        out = helper.create_variable_for_type_inference('float32')
+        out.shape = (1,)
+        out.stop_gradient = True
+        helper.append_op(type='scale',
+                         inputs={'X': [self._learning_rate_var]},
+                         outputs={'Out': [out]}, attrs={'scale': mult})
+        return out
+
+    # ------------------------------------------------------- accumulators
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if (name, param.name) in self._accumulators:
+            raise ValueError('accumulator %s for %s exists' %
+                             (name, param.name))
+        block = default_main_program().global_block()
+        var = block.create_var(
+            name='%s_%s_acc' % (param.name, name),
+            shape=tuple(shape) if shape is not None else param.shape,
+            dtype=dtype or param.dtype, persistable=True)
+        var.stop_gradient = True
+        Constant(float(fill_value))(var)
+        self._accumulators[(name, param.name)] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[(name, param.name)]
+
+    # ----------------------------------------------------------- hooks
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block):
+        pass
+
+    # ----------------------------------------------------------- driver
+    def _create_optimization_pass(self, parameters_and_grads, loss,
+                                  startup_program=None):
+        block = loss.block.program.global_block()
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(block,
+                                  [p for p, _ in parameters_and_grads])
+        optimize_ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None or not param_and_grad[0].trainable:
+                continue
+            optimize_ops.append(
+                self._append_optimize_op(block, param_and_grad))
+        self._finish_update(block)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        # All helper ops (lr var, accumulators, clip, regularizer) must land
+        # in the LOSS's program, not whatever default is current — guard it
+        # (the reference wraps the same way via program_guard).
+        from .core.program import (default_startup_program, program_guard)
+        main_program = loss.block.program
+        if startup_program is None:
+            startup_program = main_program._startup_ref or \
+                default_startup_program()
+        with program_guard(main_program, startup_program):
+            params_grads = append_backward(loss, parameter_list, no_grad_set)
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+            optimize_ops = self._create_optimization_pass(
+                params_grads, loss, startup_program)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type='sgd',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param]})
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = 'velocity'
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 **kwargs):
+        super(MomentumOptimizer, self).__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        return block.append_op(
+            type='momentum',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'Velocity': [velocity],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'VelocityOut': [velocity]},
+            attrs={'mu': self._momentum,
+                   'use_nesterov': self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, epsilon=1e-6, **kwargs):
+        super(AdagradOptimizer, self).__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type='adagrad',
+            inputs={'Param': [param], 'Grad': [grad], 'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'MomentOut': [moment]},
+            attrs={'epsilon': self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = 'moment1'
+    _moment2_acc_str = 'moment2'
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super(AdamOptimizer, self).__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._beta1_pow = None
+        self._beta2_pow = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+        main = default_main_program().global_block()
+        from .core import unique_name
+        self._beta1_pow = main.create_var(
+            name=unique_name.generate('beta1_pow_acc'), shape=(1,),
+            dtype='float32', persistable=True)
+        self._beta1_pow.stop_gradient = True
+        Constant(self._beta1)(self._beta1_pow)
+        self._beta2_pow = main.create_var(
+            name=unique_name.generate('beta2_pow_acc'), shape=(1,),
+            dtype='float32', persistable=True)
+        self._beta2_pow.stop_gradient = True
+        Constant(self._beta2)(self._beta2_pow)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment1 = self._get_accumulator(self._moment1_acc_str, param)
+        moment2 = self._get_accumulator(self._moment2_acc_str, param)
+        return block.append_op(
+            type='adam',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'Moment1': [moment1], 'Moment2': [moment2],
+                    'Beta1Pow': [self._beta1_pow],
+                    'Beta2Pow': [self._beta2_pow],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'Moment1Out': [moment1],
+                     'Moment2Out': [moment2]},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon})
+
+    def _finish_update(self, block):
+        block.append_op(
+            type='adam_beta_pow_update',
+            inputs={'Beta1Pow': [self._beta1_pow],
+                    'Beta2Pow': [self._beta2_pow]},
+            outputs={'Beta1PowOut': [self._beta1_pow],
+                     'Beta2PowOut': [self._beta2_pow]},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2})
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+    _inf_norm_acc_str = 'inf_norm'
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super(AdamaxOptimizer, self).__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._beta1_pow = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+        from .core import unique_name
+        main = default_main_program().global_block()
+        self._beta1_pow = main.create_var(
+            name=unique_name.generate('beta1_pow_acc'), shape=(1,),
+            dtype='float32', persistable=True)
+        self._beta1_pow.stop_gradient = True
+        Constant(self._beta1)(self._beta1_pow)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str, param)
+        return block.append_op(
+            type='adamax',
+            inputs={'Param': [param], 'Grad': [grad], 'Moment': [moment],
+                    'InfNorm': [inf_norm],
+                    'Beta1Pow': [self._beta1_pow],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'MomentOut': [moment],
+                     'InfNormOut': [inf_norm]},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon})
+
+    def _finish_update(self, block):
+        block.append_op(type='beta_pow_update',
+                        inputs={'BetaPow': [self._beta1_pow]},
+                        outputs={'BetaPowOut': [self._beta1_pow]},
+                        attrs={'beta': self._beta1})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super(DecayedAdagradOptimizer, self).__init__(learning_rate,
+                                                      **kwargs)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type='decayed_adagrad',
+            inputs={'Param': [param], 'Grad': [grad], 'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'MomentOut': [moment]},
+            attrs={'decay': self._decay, 'epsilon': self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = '_avg_squared_grad'
+    _avg_squared_update_acc_str = '_avg_squared_update'
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super(AdadeltaOptimizer, self).__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        asg = self._get_accumulator(self._avg_squared_grad_acc_str, param)
+        asu = self._get_accumulator(self._avg_squared_update_acc_str, param)
+        return block.append_op(
+            type='adadelta',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'AvgSquaredGrad': [asg], 'AvgSquaredUpdate': [asu]},
+            outputs={'ParamOut': [param], 'AvgSquaredGradOut': [asg],
+                     'AvgSquaredUpdateOut': [asu]},
+            attrs={'epsilon': self._epsilon, 'rho': self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = 'momentum'
+    _mean_square_acc_str = 'mean_square'
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 **kwargs):
+        super(RMSPropOptimizer, self).__init__(learning_rate, **kwargs)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        momentum = self._get_accumulator(self._momentum_acc_str, param)
+        mean_square = self._get_accumulator(self._mean_square_acc_str, param)
+        return block.append_op(
+            type='rmsprop',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'Moment': [momentum], 'MeanSquare': [mean_square],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'MomentOut': [momentum],
+                     'MeanSquareOut': [mean_square]},
+            attrs={'epsilon': self._epsilon, 'decay': self._rho,
+                   'momentum': self._momentum})
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = 'squared'
+    _linear_acc_str = 'linear'
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super(FtrlOptimizer, self).__init__(learning_rate, **kwargs)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        sq = self._get_accumulator(self._squared_acc_str, param)
+        lin = self._get_accumulator(self._linear_acc_str, param)
+        return block.append_op(
+            type='ftrl',
+            inputs={'Param': [param], 'Grad': [grad],
+                    'SquaredAccumulator': [sq], 'LinearAccumulator': [lin],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'SquaredAccumOut': [sq],
+                     'LinearAccumOut': [lin]},
+            attrs={'l1': self._l1, 'l2': self._l2,
+                   'lr_power': self._lr_power})
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

@@ -1,0 +1,13 @@
+"""SPMD parallelism over a TPU device mesh.
+
+Replaces the reference's parameter-server stack (paddle/pserver, go/pserver,
+fluid DistributeTranspiler, ParallelExecutor + NCCL) with GSPMD: build a
+Mesh, attach PartitionSpecs to program vars, and let XLA insert collectives
+over ICI/DCN (SURVEY.md §2.4).
+"""
+
+from .mesh import make_mesh, MeshConfig  # noqa: F401
+from .transpiler import DistributeTranspiler, ParallelStrategy, transpile  # noqa: F401
+from .collective import (all_gather, all_reduce, all_to_all, broadcast,  # noqa
+                         ppermute, reduce_scatter)
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,42 @@
+"""Functional collectives (reference: paddle/pserver gradient aggregation,
+NCCL allreduce in ParallelExecutor). Thin wrappers over jax.lax for use
+inside shard_map bodies and custom kernels."""
+
+import jax
+
+
+def all_reduce(x, axis_name='dp', op='sum'):
+    if op == 'sum':
+        return jax.lax.psum(x, axis_name)
+    if op == 'mean':
+        return jax.lax.pmean(x, axis_name)
+    if op == 'max':
+        return jax.lax.pmax(x, axis_name)
+    if op == 'min':
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError('unsupported all_reduce op %r' % op)
+
+
+def all_gather(x, axis_name='tp', axis=0):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter(x, axis_name='tp', axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def all_to_all(x, axis_name='sp', split_axis=0, concat_axis=0):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def broadcast(x, axis_name, root=0):
+    import jax.numpy as jnp
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)),
+                        axis_name)

@@ -1,0 +1,110 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py +
+paddle/fluid/platform/profiler.cc).
+
+TPU-native: wraps jax.profiler (XLA trace -> TensorBoard/perfetto) and adds
+host-side per-run wall timing with a sorted summary table, mirroring the
+reference's profiler.start_profiler/stop_profiler/profiler context."""
+
+import contextlib
+import time
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler', 'record_event', 'StepTimer']
+
+_events = []
+_active = False
+_trace_dir = None
+
+
+def reset_profiler():
+    global _events
+    _events = []
+
+
+def start_profiler(state='All', tracer_option=None, trace_dir=None):
+    global _active, _trace_dir
+    _active = True
+    _trace_dir = trace_dir
+    if trace_dir:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key='total', profile_path=None):
+    global _active
+    _active = False
+    if _trace_dir:
+        import jax
+        jax.profiler.stop_trace()
+    summary = summarize(sorted_key)
+    if profile_path:
+        with open(profile_path, 'w') as f:
+            f.write(summary)
+    else:
+        print(summary)
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key='total', profile_path=None,
+             trace_dir=None):
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    # Name kept for reference parity; on TPU this is the XLA trace.
+    with profiler():
+        yield
+
+
+@contextlib.contextmanager
+def record_event(name):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if _active or True:
+            _events.append((name, time.perf_counter() - t0))
+
+
+def summarize(sorted_key='total'):
+    agg = {}
+    for name, dt in _events:
+        total, count = agg.get(name, (0.0, 0))
+        agg[name] = (total + dt, count + 1)
+    rows = [(name, total, count, total / count)
+            for name, (total, count) in agg.items()]
+    rows.sort(key=lambda r: -r[1])
+    lines = ['%-40s %12s %8s %12s' % ('Event', 'Total(s)', 'Calls',
+                                      'Avg(s)')]
+    for name, total, count, avg in rows:
+        lines.append('%-40s %12.6f %8d %12.6f' % (name, total, count, avg))
+    return '\n'.join(lines)
+
+
+class StepTimer(object):
+    """Measures steady-state step time (skips compile/warmup steps)."""
+
+    def __init__(self, skip=2):
+        self.skip = skip
+        self.times = []
+        self._t0 = None
+        self._count = 0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        dt = time.perf_counter() - self._t0
+        self._count += 1
+        if self._count > self.skip:
+            self.times.append(dt)
+        return dt
+
+    @property
+    def mean(self):
+        return sum(self.times) / len(self.times) if self.times else 0.0

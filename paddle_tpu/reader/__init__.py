@@ -1,0 +1,9 @@
+"""Reader framework (reference: python/paddle/v2/reader).
+
+A reader is a nullary callable returning an iterator of examples; the
+decorators compose readers. The native C++ shuffle buffer / recordio reader
+plug in via paddle_tpu.reader.recordio when built.
+"""
+
+from .decorator import (batch, buffered, cache, chain, compose,  # noqa
+                        firstn, map_readers, shuffle, xmap_readers)

@@ -1,0 +1,77 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py).
+
+Appended as ops transforming ``p@GRAD`` between the backward marker and the
+optimizer update — same dataflow as the reference, fused by XLA into the
+train step.
+"""
+
+from .layers.helper import LayerHelper
+
+__all__ = ['append_regularization_ops', 'L1Decay', 'L2Decay',
+           'L1DecayRegularizer', 'L2DecayRegularizer']
+
+
+class WeightDecayRegularizer(object):
+    def append_ops(self, param, grad, helper):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_ops(self, param, grad, helper):
+        decayed = helper.create_variable_for_type_inference(grad.dtype)
+        decayed.shape = grad.shape
+        decayed.stop_gradient = True
+        helper.append_op(type='scale', inputs={'X': [param]},
+                         outputs={'Out': [decayed]},
+                         attrs={'scale': self._coeff})
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        out.shape = grad.shape
+        out.stop_gradient = True
+        helper.append_op(type='elementwise_add',
+                         inputs={'X': [grad], 'Y': [decayed]},
+                         outputs={'Out': [out]}, attrs={'axis': -1})
+        return out
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_ops(self, param, grad, helper):
+        sign = helper.create_variable_for_type_inference(grad.dtype)
+        sign.shape = grad.shape
+        sign.stop_gradient = True
+        helper.append_op(type='sign', inputs={'X': [param]},
+                         outputs={'Out': [sign]})
+        decayed = helper.create_variable_for_type_inference(grad.dtype)
+        decayed.shape = grad.shape
+        decayed.stop_gradient = True
+        helper.append_op(type='scale', inputs={'X': [sign]},
+                         outputs={'Out': [decayed]},
+                         attrs={'scale': self._coeff})
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        out.shape = grad.shape
+        out.stop_gradient = True
+        helper.append_op(type='elementwise_add',
+                         inputs={'X': [grad], 'Y': [decayed]},
+                         outputs={'Out': [out]}, attrs={'axis': -1})
+        return out
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    helper = LayerHelper('regularization')
+    result = []
+    for param, grad in parameters_and_grads:
+        regularizer = getattr(param, 'regularizer', None) or regularization
+        if grad is None or regularizer is None:
+            result.append((param, grad))
+            continue
+        result.append((param, regularizer.append_ops(param, grad, helper)))
+    return result
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

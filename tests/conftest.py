@@ -1,0 +1,20 @@
+"""Test config: force an 8-virtual-device CPU platform BEFORE jax imports
+(SURVEY.md §4), so mesh/sharding tests run without TPU hardware."""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    yield

@@ -1,0 +1,47 @@
+"""End-to-end fit_a_line (reference: book chapter 01 + fluid tests).
+The first of the five BASELINE configs: linear regression trains to low
+loss through the whole stack (layers -> backward -> SGD -> executor)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_converges():
+    np.random.seed(0)
+    true_w = np.random.randn(13, 1).astype('float32')
+
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    sgd = fluid.optimizer.SGD(learning_rate=0.05)
+    sgd.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for step in range(200):
+        xs = np.random.randn(32, 13).astype('float32')
+        ys = xs @ true_w + 0.5
+        out = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[avg_cost])
+        losses.append(float(out[0]))
+    assert losses[-1] < 0.05, 'loss did not converge: %s' % losses[-10:]
+    assert losses[-1] < losses[0]
+
+
+def test_executor_fetch_and_infer():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    out = fluid.layers.fc(input=h, size=2, act='softmax')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.rand(5, 4).astype('float32')
+    res = exe.run(feed={'x': xs}, fetch_list=[out])
+    assert res[0].shape == (5, 2)
+    np.testing.assert_allclose(res[0].sum(axis=1), np.ones(5), rtol=1e-5)
