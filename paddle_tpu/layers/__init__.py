@@ -6,7 +6,10 @@ from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
+from .manip import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 
-from . import io, tensor, ops, nn, sequence, control_flow, detection  # noqa
+from . import (io, tensor, ops, nn, sequence, manip, rnn,  # noqa
+               control_flow, detection)

@@ -1,0 +1,20 @@
+"""Model zoo matching the reference's benchmark configs and book chapters
+(SURVEY.md §2.6): benchmark/paddle image classification suite
+(ResNet/VGG/SE-ResNeXt/MobileNet), recognize_digits LeNet, fit_a_line,
+Transformer NMT, Wide&Deep CTR, word2vec, LSTM sentiment models.
+
+Every builder is pure front-end: it appends ops to the default (or given)
+Program; the Executor compiles the whole model — forward, backward,
+optimizer — into one XLA computation.
+"""
+
+from . import linear  # noqa: F401
+from . import lenet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import resnet  # noqa: F401
+from . import mobilenet  # noqa: F401
+from . import resnext  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import wide_deep  # noqa: F401
+from . import seq_models  # noqa: F401
+from . import transformer  # noqa: F401

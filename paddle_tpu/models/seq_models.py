@@ -1,0 +1,77 @@
+"""Sequence models (reference: understand_sentiment + label_semantic_roles
+book chapters): conv sentiment net, stacked bi-LSTM sentiment net, and a
+stacked-GRU sequence tagger skeleton.
+
+Sequences are padded [B, T] int64 id arrays with a `length` Variable for
+mask-aware pooling/recurrence (the TPU replacement for LoD)."""
+
+from .. import layers, nets
+
+
+def convolution_net(data, label, input_dim, class_dim=2, emb_dim=32,
+                    hid_dim=32, length=None):
+    """Sentiment conv net: embedding -> two sequence_conv_pools -> softmax."""
+    emb = layers.embedding(input=data, size=[input_dim, emb_dim],
+                           dtype='float32')
+    conv_3 = nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                     filter_size=3, act='tanh',
+                                     pool_type='sqrt', length=length)
+    conv_4 = nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                     filter_size=4, act='tanh',
+                                     pool_type='sqrt', length=length)
+    prediction = layers.fc(input=[conv_3, conv_4], size=class_dim,
+                           act='softmax')
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=128,
+                     hid_dim=512, stacked_num=3, length=None):
+    """Stacked alternating-direction LSTM sentiment net (book chapter 06)."""
+    assert stacked_num % 2 == 1
+    emb = layers.embedding(input=data, size=[input_dim, emb_dim],
+                           dtype='float32')
+    fc1 = layers.fc(input=emb, size=hid_dim, num_flatten_dims=2)
+    lstm1, cell1 = layers.dynamic_lstm(input=fc1, size=hid_dim,
+                                       length=length)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = layers.fc(input=inputs, size=hid_dim, num_flatten_dims=2)
+        lstm, cell = layers.dynamic_lstm(input=fc, size=hid_dim,
+                                         is_reverse=(i % 2) == 0,
+                                         length=length)
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(input=inputs[0], pool_type='max',
+                                   length=length)
+    lstm_last = layers.sequence_pool(input=inputs[1], pool_type='max',
+                                     length=length)
+    prediction = layers.fc(input=[fc_last, lstm_last], size=class_dim,
+                           act='softmax')
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def stacked_gru_tagger(word, target, word_dict_len, label_dict_len,
+                       emb_dim=32, hidden_dim=128, depth=2, length=None):
+    """Simplified SRL-style tagger: embedding -> stacked bi-GRU -> per-step
+    softmax over labels (reference label_semantic_roles chapter uses an
+    8-feature crf net; the CRF decode layer lives in layers/decode.py)."""
+    emb = layers.embedding(input=word, size=[word_dict_len, emb_dim],
+                           dtype='float32')
+    hidden = layers.fc(input=emb, size=hidden_dim * 3, num_flatten_dims=2)
+    for i in range(depth):
+        gru = layers.dynamic_gru(input=hidden, size=hidden_dim,
+                                 is_reverse=(i % 2) == 1, length=length)
+        hidden = layers.fc(input=gru, size=hidden_dim * 3,
+                           num_flatten_dims=2)
+    feature = layers.fc(input=hidden, size=label_dict_len,
+                        num_flatten_dims=2, act=None)
+    # per-step cross entropy over the padded grid, masked by length
+    probs = layers.softmax(feature)
+    cost = layers.cross_entropy(input=probs, label=target)
+    avg_cost = layers.mean(cost)
+    return feature, avg_cost

@@ -1,0 +1,217 @@
+"""Transformer NMT (reference: the benchmark Transformer "base" en-de
+config — 6-layer encoder/decoder, d_model 512, 8 heads, label smoothing).
+
+TPU-first differences from the reference build:
+- attention is the fused `fused_attention` IR op (Pallas flash kernel on
+  TPU) instead of a chain of reshape/matmul/softmax ops, and padding
+  masks derive in-graph from a per-example `length` vector — the
+  reference feeds precomputed [B, H, T, T] bias tensors from the host.
+- positional encodings are a non-trainable device-resident table sliced
+  per step, not host-fed.
+- the whole train step (fwd + bwd + Adam + label smoothing) compiles to
+  one XLA program; bf16-friendly (all matmuls hit the MXU).
+"""
+
+import numpy as np
+
+from .. import layers
+from ..initializer import Normal, NumpyArrayInitializer
+from ..param_attr import ParamAttr
+
+
+def position_encoding_table(max_length, d_model):
+    """Sinusoidal position table [max_length, d_model] (host-computed once,
+    lives in HBM as a frozen parameter)."""
+    pos = np.arange(max_length)[:, None].astype('float64')
+    dim = np.arange(0, d_model, 2).astype('float64')
+    inv = 1.0 / np.power(10000.0, dim / d_model)
+    angles = pos * inv[None, :]
+    table = np.zeros((max_length, d_model), dtype='float32')
+    table[:, 0::2] = np.sin(angles)
+    table[:, 1::2] = np.cos(angles)
+    return table
+
+
+def _multi_head_attention(queries, keys, values, d_key, d_value, d_model,
+                          n_head, dropout_rate, causal=False,
+                          key_length=None, name='attn'):
+    q = layers.fc(input=queries, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False,
+                  param_attr=ParamAttr(name=name + '_q.w'))
+    k = layers.fc(input=keys, size=d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False,
+                  param_attr=ParamAttr(name=name + '_k.w'))
+    v = layers.fc(input=values, size=d_value * n_head, num_flatten_dims=2,
+                  bias_attr=False,
+                  param_attr=ParamAttr(name=name + '_v.w'))
+
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper('fused_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    if q.shape is not None:
+        out.shape = (q.shape[0], q.shape[1], d_value * n_head)
+    inputs = {'Q': [q], 'K': [k], 'V': [v]}
+    if key_length is not None:
+        inputs['KeyLength'] = [key_length]
+    helper.append_op(type='fused_attention', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'n_head': n_head, 'causal': causal,
+                            'dropout_rate': dropout_rate})
+    proj = layers.fc(input=out, size=d_model, num_flatten_dims=2,
+                     bias_attr=False,
+                     param_attr=ParamAttr(name=name + '_out.w'))
+    return proj
+
+
+def _ffn(x, d_inner, d_model, dropout_rate, name='ffn'):
+    hidden = layers.fc(input=x, size=d_inner, num_flatten_dims=2,
+                       act='relu', param_attr=ParamAttr(name=name + '_1.w'))
+    if dropout_rate:
+        hidden = layers.dropout(hidden, dropout_prob=dropout_rate)
+    return layers.fc(input=hidden, size=d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=name + '_2.w'))
+
+
+def _post_process(prev, out, dropout_rate):
+    """residual add + layer_norm (+ dropout), the reference's "dan" chain."""
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate)
+    added = layers.elementwise_add(x=out, y=prev)
+    return layers.layer_norm(added, begin_norm_axis=len(added.shape) - 1)
+
+
+def _prepare_input(word_ids, vocab_size, d_model, max_length, dropout_rate,
+                   emb_name, pos_table):
+    emb = layers.embedding(
+        input=word_ids, size=[vocab_size, d_model], dtype='float32',
+        param_attr=ParamAttr(name=emb_name,
+                             initializer=Normal(0., d_model ** -0.5)))
+    emb = layers.scale(x=emb, scale=d_model ** 0.5)
+    seq_len = word_ids.shape[1]
+    pos_enc = layers.create_parameter(
+        shape=[max_length, d_model], dtype='float32',
+        name=emb_name + '_pos_enc',
+        attr=ParamAttr(name=emb_name + '_pos_enc',
+                       initializer=NumpyArrayInitializer(pos_table),
+                       trainable=False))
+    pos_slice = layers.slice(pos_enc, axes=[0], starts=[0], ends=[seq_len])
+    pos_slice = layers.reshape(x=pos_slice, shape=[1, seq_len, d_model])
+    out = layers.elementwise_add(x=emb, y=pos_slice)
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def encoder_layer(x, n_head, d_key, d_value, d_model, d_inner, dropout_rate,
+                  src_length=None, name='enc'):
+    attn = _multi_head_attention(x, x, x, d_key, d_value, d_model, n_head,
+                                 dropout_rate, key_length=src_length,
+                                 name=name + '_slf')
+    x = _post_process(x, attn, dropout_rate)
+    ffn = _ffn(x, d_inner, d_model, dropout_rate, name=name + '_ffn')
+    return _post_process(x, ffn, dropout_rate)
+
+
+def decoder_layer(x, enc_out, n_head, d_key, d_value, d_model, d_inner,
+                  dropout_rate, src_length=None, name='dec'):
+    slf = _multi_head_attention(x, x, x, d_key, d_value, d_model, n_head,
+                                dropout_rate, causal=True,
+                                name=name + '_slf')
+    x = _post_process(x, slf, dropout_rate)
+    cross = _multi_head_attention(x, enc_out, enc_out, d_key, d_value,
+                                  d_model, n_head, dropout_rate,
+                                  key_length=src_length,
+                                  name=name + '_cross')
+    x = _post_process(x, cross, dropout_rate)
+    ffn = _ffn(x, d_inner, d_model, dropout_rate, name=name + '_ffn')
+    return _post_process(x, ffn, dropout_rate)
+
+
+def transformer(src_vocab_size, trg_vocab_size, max_length=256,
+                n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+                d_inner=2048, dropout_rate=0.1, label_smooth_eps=0.1,
+                src_seq_len=None, trg_seq_len=None, batch_size=None,
+                weight_sharing=False):
+    """Build the full training graph. Feeds: src_word [B,S] int64,
+    src_length [B] int64, trg_word [B,T] int64 (decoder input),
+    lbl_word [B,T] int64 (shifted target), lbl_weight [B,T] float32
+    (1 for real tokens, 0 for pads). Returns (avg_cost, logits)."""
+    src_word = layers.data(name='src_word', shape=[src_seq_len],
+                           dtype='int64')
+    src_length = layers.data(name='src_length', shape=[], dtype='int64')
+    trg_word = layers.data(name='trg_word', shape=[trg_seq_len],
+                           dtype='int64')
+    lbl_word = layers.data(name='lbl_word', shape=[trg_seq_len],
+                           dtype='int64')
+    lbl_weight = layers.data(name='lbl_weight', shape=[trg_seq_len],
+                             dtype='float32')
+
+    pos_table = position_encoding_table(max_length, d_model)
+
+    enc_in = _prepare_input(src_word, src_vocab_size, d_model, max_length,
+                            dropout_rate, 'src_emb', pos_table)
+    x = enc_in
+    for i in range(n_layer):
+        x = encoder_layer(x, n_head, d_key, d_value, d_model, d_inner,
+                          dropout_rate, src_length=src_length,
+                          name='enc_%d' % i)
+    enc_out = x
+
+    dec_emb_name = 'src_emb' if weight_sharing else 'trg_emb'
+    dec_in = _prepare_input(trg_word, trg_vocab_size, d_model, max_length,
+                            dropout_rate, dec_emb_name, pos_table)
+    y = dec_in
+    for i in range(n_layer):
+        y = decoder_layer(y, enc_out, n_head, d_key, d_value, d_model,
+                          d_inner, dropout_rate, src_length=src_length,
+                          name='dec_%d' % i)
+
+    logits = layers.fc(input=y, size=trg_vocab_size, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=ParamAttr(name='out_proj.w'))
+
+    # label smoothing + softmax cross entropy, weighted by non-pad mask
+    if label_smooth_eps:
+        smooth = layers.label_smooth(
+            label=layers.one_hot(lbl_word, depth=trg_vocab_size),
+            epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(
+            logits=logits, label=smooth, soft_label=True)
+    else:
+        lbl3 = layers.unsqueeze(lbl_word, axes=[2])
+        cost = layers.softmax_with_cross_entropy(logits=logits, label=lbl3)
+    cost = layers.reshape(x=cost, shape=list(lbl_weight.shape))
+    weighted = layers.elementwise_mul(x=cost, y=lbl_weight)
+    sum_cost = layers.reduce_sum(weighted)
+    token_count = layers.reduce_sum(lbl_weight)
+    avg_cost = layers.elementwise_div(x=sum_cost, y=token_count)
+    return avg_cost, logits
+
+
+def transformer_base(src_vocab_size=32000, trg_vocab_size=32000,
+                     src_seq_len=64, trg_seq_len=64, **overrides):
+    """The reference "base" configuration."""
+    cfg = dict(n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
+               d_inner=2048, dropout_rate=0.1, label_smooth_eps=0.1,
+               src_seq_len=src_seq_len, trg_seq_len=trg_seq_len)
+    cfg.update(overrides)
+    return transformer(src_vocab_size, trg_vocab_size, **cfg)
+
+
+FEED_NAMES = ['src_word', 'src_length', 'trg_word', 'lbl_word', 'lbl_weight']
+
+
+def make_fake_batch(batch_size, src_seq_len, trg_seq_len, src_vocab_size,
+                    trg_vocab_size, seed=0):
+    """Synthetic feed dict for tests/bench (zero-egress environment)."""
+    rng = np.random.RandomState(seed)
+    return {
+        'src_word': rng.randint(1, src_vocab_size,
+                                (batch_size, src_seq_len)).astype('int64'),
+        'src_length': np.full((batch_size,), src_seq_len, dtype='int64'),
+        'trg_word': rng.randint(1, trg_vocab_size,
+                                (batch_size, trg_seq_len)).astype('int64'),
+        'lbl_word': rng.randint(1, trg_vocab_size,
+                                (batch_size, trg_seq_len)).astype('int64'),
+        'lbl_weight': np.ones((batch_size, trg_seq_len), dtype='float32'),
+    }

@@ -13,6 +13,8 @@ from . import norm_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import attention_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401

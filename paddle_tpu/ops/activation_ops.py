@@ -68,11 +68,18 @@ def _softmax(ctx):
 
 @register('log_softmax')
 def _log_softmax(ctx):
-    ctx.set_output('Out', jax.nn.log_softmax(ctx.input('X'), axis=-1))
+    ctx.set_output('Out', jax.nn.log_softmax(ctx.input('X'),
+                                             axis=ctx.attr('axis', -1)))
 
 
 @register('prelu')
 def _prelu(ctx):
     x = ctx.input('X')
     alpha = ctx.input('Alpha')
+    mode = ctx.attr('mode', 'all')
+    if mode == 'channel':
+        # alpha is [C]; broadcast over the channel axis of NC... layouts
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == 'element':
+        alpha = alpha.reshape((1,) + x.shape[1:])
     ctx.set_output('Out', jnp.where(x > 0, x, alpha * x))

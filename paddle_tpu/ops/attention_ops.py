@@ -1,0 +1,112 @@
+"""Fused multi-head attention op.
+
+Reference parity: the reference builds attention from primitive ops
+(fluid/nets.py scaled_dot_product_attention; the transformer model in its
+book/benchmark configs). TPU-native design: attention is ONE IR op so the
+executor can dispatch the whole q·kᵀ→mask→softmax→·v chain to a Pallas
+flash-attention kernel on TPU (ops/pallas/flash_attention.py), falling
+back to a jnp reference everywhere else. Inputs are the head-merged
+projections [B, T, H*D]; masking is computed in-kernel from attrs
+(causal) and an optional per-example KeyLength vector — no giant
+[B, H, T, T] bias tensors cross the feed boundary as they do in the
+reference transformer config.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+_NEG_INF = -1e9
+
+
+def _split_heads(x, n_head):
+    b, t, d = x.shape
+    return x.reshape(b, t, n_head, d // n_head).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    x = x.transpose(0, 2, 1, 3)
+    b, t, h, d = x.shape
+    return x.reshape(b, t, h * d)
+
+
+def reference_attention(q, k, v, causal=False, key_length=None,
+                        query_length=None, scale=None, bias=None):
+    """jnp reference: q,k,v are [B, H, T, D] (already head-split)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q * scale, k)
+    if bias is not None:
+        logits = logits + bias
+    tq, tk = logits.shape[-2], logits.shape[-1]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        logits = jnp.where(causal_mask[None, None], logits, _NEG_INF)
+    if key_length is not None:
+        kmask = jnp.arange(tk)[None, :] < key_length.reshape(-1, 1)
+        logits = jnp.where(kmask[:, None, None, :], logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', weights, v)
+    if query_length is not None:
+        qmask = jnp.arange(tq)[None, :] < query_length.reshape(-1, 1)
+        out = out * qmask[:, None, :, None].astype(out.dtype)
+    return out
+
+
+def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
+                    query_length=None, dropout_rate=0.0, rng=None,
+                    is_test=False):
+    """q3/k3/v3: [B, T, H*D]. Returns [B, Tq, H*Dv].
+
+    Dispatches to the Pallas TPU flash kernel when profitable (no dropout,
+    long sequence, TPU backend); otherwise the XLA-fused jnp reference.
+    """
+    q = _split_heads(q3, n_head)
+    k = _split_heads(k3, n_head)
+    v = _split_heads(v3, n_head)
+
+    use_pallas = False
+    if dropout_rate == 0.0 and key_length is None and \
+            query_length is None and q.shape[-2] >= 512 and \
+            q.shape[-2] % 512 == 0 and k.shape[-2] % 128 == 0 and \
+            q.shape[-1] % 128 == 0:
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = 'cpu'
+        use_pallas = backend in ('tpu', 'axon')
+    if use_pallas:
+        from .pallas.flash_attention import flash_attention
+        out = flash_attention(q, k, v, causal=causal)
+    else:
+        out = reference_attention(q, k, v, causal=causal,
+                                  key_length=key_length,
+                                  query_length=query_length)
+        if dropout_rate and not is_test:
+            # dropout on attention output (weights-dropout would block the
+            # flash path; output-dropout is the TPU-friendly equivalent)
+            keep = 1.0 - dropout_rate
+            mask = jax.random.bernoulli(rng, keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0)
+    return _merge_heads(out)
+
+
+@register('fused_attention')
+def _fused_attention(ctx):
+    q = ctx.input('Q')
+    k = ctx.input('K')
+    v = ctx.input('V')
+    key_length = ctx.input('KeyLength') if ctx.has_input('KeyLength') \
+        else None
+    query_length = ctx.input('QueryLength') \
+        if ctx.has_input('QueryLength') else None
+    n_head = ctx.attr('n_head', 1)
+    causal = ctx.attr('causal', False)
+    dropout_rate = ctx.attr('dropout_rate', 0.0)
+    rng = ctx.rng_key() if dropout_rate else None
+    out = fused_attention(q, k, v, n_head, causal=causal,
+                          key_length=key_length, query_length=query_length,
+                          dropout_rate=dropout_rate, rng=rng,
+                          is_test=ctx.is_test)
+    ctx.set_output('Out', out)

@@ -1,0 +1,3 @@
+"""Hand-written Pallas TPU kernels for the hot ops (flash attention,
+fused normalization). Everything here has a jnp fallback so the same IR
+runs on CPU test meshes."""

@@ -1,0 +1,155 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Replaces the reference's composed matmul→softmax→matmul attention chain
+(which materializes the [B, H, Tq, Tk] score tensor in HBM) with an
+online-softmax kernel that keeps one (block_q, block_k) score tile in VMEM
+at a time — O(T) memory instead of O(T²), and the q·kᵀ / p·v matmuls hit
+the MXU back-to-back without an HBM round-trip.
+
+Design follows the standard flash-attention-v2 recurrence (running max m,
+running denominator l, rescaled accumulator); written against the Pallas
+TPU API per /opt/skills/guides/pallas_guide.md. The backward pass uses a
+rematerializing XLA recompute (custom_vjp) — a Pallas backward kernel is a
+planned optimization.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 512
+BLOCK_K = 128  # = one lane tile; keeps m/l lane-replication trivial
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale, causal, block_q, block_k, num_k_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip key blocks strictly above the diagonal band.
+    if causal:
+        run = (qi * block_q + block_q - 1) >= (ki * block_k)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_scr[:]                     # [bq, 128] lane-replicated
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)                # [bq, 128]
+        alpha = jnp.exp(m_prev - m_next)                   # [bq, 128]
+        p = jnp.exp(s - m_next[:, :1])                     # [bq, bk]
+        l_cur = jnp.sum(p, axis=1, keepdims=True)          # [bq, 1]
+        l_next = alpha * l_prev + l_cur                    # [bq, 128]
+        m_scr[:] = m_next
+        l_scr[:] = l_next
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, d]
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        denom = l_scr[:][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(BLOCK_K, tk)
+    assert tq % block_q == 0 and tk % block_k == 0, \
+        'flash_attention: seq lens must divide block sizes'
+    num_k_blocks = tk // block_k
+
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+
+    grid = (b * h, tq // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=num_k_blocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d)
+
+
+def _reference(q, k, v, causal, sm_scale):
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q * sm_scale, k)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum('bhqk,bhkd->bhqd', w, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q):
+    """q,k,v: [B, H, T, D]. Returns [B, H, Tq, D]."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, causal, scale, block_q)
+
+
+def _vjp_fwd(q, k, v, causal, sm_scale, block_q):
+    return flash_attention(q, k, v, causal, sm_scale, block_q), (q, k, v)
+
+
+def _vjp_bwd(causal, sm_scale, block_q, res, g):
+    # Rematerialized XLA backward; the forward stays flash.
+    q, k, v = res
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal,
+                                                   scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

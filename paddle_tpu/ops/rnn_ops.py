@@ -1,0 +1,253 @@
+"""Recurrent ops: LSTM / GRU over padded dense batches.
+
+Reference: paddle/fluid/operators/{lstm_op,gru_op,lstm_unit_op,gru_unit_op}.cc
+which run a per-sequence CPU/CUDA kernel over LoD batches. TPU-native: one
+`lax.scan` over the time axis of a padded [batch, time, ...] array with an
+optional length vector for masking — the whole recurrence is a single XLA
+while-loop whose per-step matmul rides the MXU, and it differentiates
+through `jax.value_and_grad` like any other traced op.
+
+Gate layouts (documented contract of THIS framework):
+  lstm: projected input/weight hold 4 gates in order [i, f, g(candidate), o].
+  gru : projected input/weight hold [u(update), r(reset), c(candidate)];
+        h_t = u*h_{t-1} + (1-u)*c_t.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _mask_from_length(length, batch, time, dtype):
+    """[B, T] 1/0 mask; None when no length vector was given."""
+    if length is None:
+        return None
+    t = jnp.arange(time, dtype=jnp.int32)[None, :]
+    return (t < length.reshape(batch, 1).astype(jnp.int32)).astype(dtype)
+
+
+def lstm_scan(x_proj, w_h, bias, h0, c0, length=None, gate_act=jax.nn.sigmoid,
+              cell_act=jnp.tanh, cand_act=jnp.tanh, is_reverse=False):
+    """Run an LSTM over x_proj [B, T, 4D]; returns (hidden [B,T,D], cell)."""
+    b, t, d4 = x_proj.shape
+    d = d4 // 4
+    mask = _mask_from_length(length, b, t, x_proj.dtype)
+    if is_reverse:
+        x_proj = jnp.flip(x_proj, axis=1)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
+
+    xs = jnp.swapaxes(x_proj, 0, 1)  # [T, B, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        if ms is None:
+            xt = inp
+            m = None
+        else:
+            xt, m = inp
+        gates = xt + h_prev @ w_h
+        if bias is not None:
+            gates = gates + bias.reshape(1, -1)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        g = cand_act(g)
+        c = f * c_prev + i * g
+        h = o * cell_act(c)
+        if m is not None:
+            h = m * h + (1 - m) * h_prev
+            c = m * c + (1 - m) * c_prev
+        return (h, c), (h, c)
+
+    inputs = xs if ms is None else (xs, ms)
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), inputs)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hidden = jnp.flip(hidden, axis=1)
+        cell = jnp.flip(cell, axis=1)
+    return hidden, cell
+
+
+def gru_scan(x_proj, w_h, bias, h0, length=None, gate_act=jax.nn.sigmoid,
+             cand_act=jnp.tanh, is_reverse=False):
+    """Run a GRU over x_proj [B, T, 3D]; returns hidden [B, T, D].
+
+    Weight layout matches the reference gru_op: w_h[:, :2D] are the
+    update/reset recurrent weights, w_h[:, 2D:] (shape [D, D]) the
+    candidate recurrent weights applied to (r * h_prev).
+    """
+    b, t, d3 = x_proj.shape
+    d = d3 // 3
+    w_ur = w_h[:, :2 * d]
+    w_c = w_h[:, 2 * d:]
+    mask = _mask_from_length(length, b, t, x_proj.dtype)
+    if is_reverse:
+        x_proj = jnp.flip(x_proj, axis=1)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
+    xs = jnp.swapaxes(x_proj, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(h_prev, inp):
+        if ms is None:
+            xt = inp
+            m = None
+        else:
+            xt, m = inp
+        if bias is not None:
+            xt = xt + bias.reshape(1, -1)
+        x_ur, x_c = xt[:, :2 * d], xt[:, 2 * d:]
+        ur = gate_act(x_ur + h_prev @ w_ur)
+        u, r = ur[:, :d], ur[:, d:]
+        c = cand_act(x_c + (r * h_prev) @ w_c)
+        h = u * h_prev + (1 - u) * c
+        if m is not None:
+            h = m * h + (1 - m) * h_prev
+        return h, h
+
+    inputs = xs if ms is None else (xs, ms)
+    _, hs = jax.lax.scan(step, h0, inputs)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hidden = jnp.flip(hidden, axis=1)
+    return hidden
+
+
+_ACTS = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh, 'relu': jax.nn.relu,
+         'identity': (lambda x: x)}
+
+
+@register('lstm')
+def _lstm(ctx):
+    x = ctx.input('Input')          # [B, T, 4D]
+    w = ctx.input('Weight')         # [D, 4D]
+    bias = ctx.input('Bias') if ctx.has_input('Bias') else None
+    length = ctx.input('Length') if ctx.has_input('Length') else None
+    b = x.shape[0]
+    d = w.shape[0]
+    h0 = ctx.input('H0') if ctx.has_input('H0') else \
+        jnp.zeros((b, d), x.dtype)
+    c0 = ctx.input('C0') if ctx.has_input('C0') else \
+        jnp.zeros((b, d), x.dtype)
+    hidden, cell = lstm_scan(
+        x, w, bias, h0, c0, length,
+        gate_act=_ACTS[ctx.attr('gate_activation', 'sigmoid')],
+        cell_act=_ACTS[ctx.attr('cell_activation', 'tanh')],
+        cand_act=_ACTS[ctx.attr('candidate_activation', 'tanh')],
+        is_reverse=ctx.attr('is_reverse', False))
+    ctx.set_output('Hidden', hidden)
+    ctx.set_output('Cell', cell)
+
+
+@register('lstmp')
+def _lstmp(ctx):
+    """LSTM with recurrent projection (lstmp_op.cc): h = proj(o * act(c))."""
+    x = ctx.input('Input')          # [B, T, 4D]
+    w = ctx.input('Weight')         # [P, 4D] (recurrent over projected h)
+    w_proj = ctx.input('ProjWeight')  # [D, P]
+    bias = ctx.input('Bias') if ctx.has_input('Bias') else None
+    length = ctx.input('Length') if ctx.has_input('Length') else None
+    b = x.shape[0]
+    d = w_proj.shape[0]
+    p = w_proj.shape[1]
+    gate_act = _ACTS[ctx.attr('gate_activation', 'sigmoid')]
+    cell_act = _ACTS[ctx.attr('cell_activation', 'tanh')]
+    cand_act = _ACTS[ctx.attr('candidate_activation', 'tanh')]
+    proj_act = _ACTS[ctx.attr('proj_activation', 'tanh')]
+    is_reverse = ctx.attr('is_reverse', False)
+    t = x.shape[1]
+    mask = _mask_from_length(length, b, t, x.dtype)
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        if ms is None:
+            xt, m = inp, None
+        else:
+            xt, m = inp
+        gates = xt + r_prev @ w
+        if bias is not None:
+            gates = gates + bias.reshape(1, -1)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c = f * c_prev + i * cand_act(g)
+        h = o * cell_act(c)
+        r = proj_act(h @ w_proj)
+        if m is not None:
+            r = m * r + (1 - m) * r_prev
+            c = m * c + (1 - m) * c_prev
+        return (r, c), (r, c)
+
+    r0 = jnp.zeros((b, p), x.dtype)
+    c0 = jnp.zeros((b, d), x.dtype)
+    inputs = xs if ms is None else (xs, ms)
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), inputs)
+    proj_seq = jnp.swapaxes(rs, 0, 1)
+    cell_seq = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        proj_seq = jnp.flip(proj_seq, axis=1)
+        cell_seq = jnp.flip(cell_seq, axis=1)
+    ctx.set_output('Projection', proj_seq)
+    ctx.set_output('Cell', cell_seq)
+
+
+@register('gru')
+def _gru(ctx):
+    x = ctx.input('Input')          # [B, T, 3D]
+    w = ctx.input('Weight')         # [D, 3D]
+    bias = ctx.input('Bias') if ctx.has_input('Bias') else None
+    length = ctx.input('Length') if ctx.has_input('Length') else None
+    b = x.shape[0]
+    d = w.shape[0]
+    h0 = ctx.input('H0') if ctx.has_input('H0') else \
+        jnp.zeros((b, d), x.dtype)
+    hidden = gru_scan(
+        x, w, bias, h0, length,
+        gate_act=_ACTS[ctx.attr('gate_activation', 'sigmoid')],
+        cand_act=_ACTS[ctx.attr('activation', 'tanh')],
+        is_reverse=ctx.attr('is_reverse', False))
+    ctx.set_output('Hidden', hidden)
+
+
+@register('lstm_unit')
+def _lstm_unit(ctx):
+    """Single LSTM step (lstm_unit_op.cc): inputs are pre-projected gates."""
+    gates = ctx.input('X')          # [B, 4D]
+    c_prev = ctx.input('C_prev')    # [B, D]
+    forget_bias = ctx.attr('forget_bias', 0.0)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    ctx.set_output('C', c)
+    ctx.set_output('H', h)
+
+
+@register('gru_unit')
+def _gru_unit(ctx):
+    """Single GRU step (gru_unit_op.cc)."""
+    x = ctx.input('Input')          # [B, 3D] pre-projected
+    h_prev = ctx.input('HiddenPrev')
+    w = ctx.input('Weight')         # [D, 3D]
+    bias = ctx.input('Bias') if ctx.has_input('Bias') else None
+    d = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    gate_act = _ACTS[ctx.attr('gate_activation', 'sigmoid')]
+    cand_act = _ACTS[ctx.attr('activation', 'tanh')]
+    x_ur, x_c = x[:, :2 * d], x[:, 2 * d:]
+    ur = gate_act(x_ur + h_prev @ w[:, :2 * d])
+    u, r = ur[:, :d], ur[:, d:]
+    c = cand_act(x_c + (r * h_prev) @ w[:, 2 * d:])
+    h = u * h_prev + (1 - u) * c
+    ctx.set_output('Gate', jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_output('ResetHiddenPrev', r * h_prev)
+    ctx.set_output('Hidden', h)

@@ -203,7 +203,8 @@ def _unsqueeze(ctx):
 
 @register('slice')
 def _slice(ctx):
-    x = ctx.input('X')
+    # the reference slice_op names its input slot 'Input'
+    x = ctx.input('Input') if ctx.has_input('Input') else ctx.input('X')
     axes = ctx.attr('axes')
     starts = ctx.attr('starts')
     ends = ctx.attr('ends')
