@@ -1,0 +1,95 @@
+"""Headline benchmark (SURVEY.md §5). Trains the two BASELINE workloads on
+the real chip and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baselines (BASELINE.json, reference-era P100 fp32 batch 64):
+ResNet-50 ~200 img/s, Transformer base ~4500 tok/s. The headline metric is
+the geometric-mean speedup over both; `value` is Transformer tok/s.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASE_RESNET_IMG_S = 200.0
+BASE_TRANSFORMER_TOK_S = 4500.0
+
+
+def _fresh():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    return fluid
+
+
+def _time_steps(run_step, warmup=3, iters=10):
+    for _ in range(warmup):
+        run_step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_step()
+    np.asarray(out[0]).block_until_ready() if hasattr(
+        np.asarray(out[0]), 'block_until_ready') else None
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_transformer(batch=64, seq=64, vocab=32000):
+    fluid = _fresh()
+    from paddle_tpu.models import transformer as T
+    avg_cost, _ = T.transformer_base(
+        src_vocab_size=vocab, trg_vocab_size=vocab,
+        src_seq_len=seq, trg_seq_len=seq, dropout_rate=0.1)
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    feed = T.make_fake_batch(batch, seq, seq, vocab, vocab)
+
+    def step():
+        return exe.run(feed=feed, fetch_list=[avg_cost], return_numpy=False)
+
+    dt = _time_steps(step)
+    return batch * seq / dt
+
+
+def bench_resnet50(batch=64):
+    fluid = _fresh()
+    from paddle_tpu.models.resnet import resnet50_with_loss
+    _, avg_cost, _ = resnet50_with_loss()
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+        avg_cost)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {'image': rng.rand(batch, 3, 224, 224).astype('float32'),
+            'label': rng.randint(0, 1000, (batch, 1)).astype('int64')}
+
+    def step():
+        return exe.run(feed=feed, fetch_list=[avg_cost], return_numpy=False)
+
+    dt = _time_steps(step)
+    return batch / dt
+
+
+def main():
+    tok_s = bench_transformer()
+    img_s = bench_resnet50()
+    speedup = ((tok_s / BASE_TRANSFORMER_TOK_S) *
+               (img_s / BASE_RESNET_IMG_S)) ** 0.5
+    print(json.dumps({
+        'metric': 'transformer_base_train_tokens_per_sec',
+        'value': round(tok_s, 1),
+        'unit': 'tokens/s',
+        'vs_baseline': round(speedup, 3),
+        'detail': {'resnet50_img_per_sec': round(img_s, 1),
+                   'transformer_tok_per_sec': round(tok_s, 1),
+                   'baseline': {'resnet50': BASE_RESNET_IMG_S,
+                                'transformer': BASE_TRANSFORMER_TOK_S}},
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -24,12 +24,13 @@ def _ensure_ops_imported():
 
 
 class _Compiled(object):
-    __slots__ = ('fn', 'scope_in_names', 'scope_out_names', 'feed_names',
-                 'fetch_names')
+    __slots__ = ('fn', 'raw_fn', 'scope_in_names', 'scope_out_names',
+                 'feed_names', 'fetch_names')
 
-    def __init__(self, fn, scope_in_names, scope_out_names, feed_names,
-                 fetch_names):
+    def __init__(self, fn, raw_fn, scope_in_names, scope_out_names,
+                 feed_names, fetch_names):
         self.fn = fn
+        self.raw_fn = raw_fn  # un-jitted step function (jittable, no donation)
         self.scope_in_names = scope_in_names
         self.scope_out_names = scope_out_names
         self.feed_names = feed_names
@@ -109,7 +110,8 @@ class Executor(object):
 
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
-        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        key = (id(program), program._version, program.amp, feed_sig,
+               tuple(fetch_names))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, sorted(feed_vals), fetch_names)
@@ -200,6 +202,7 @@ class Executor(object):
         seed = program.random_seed if program.random_seed is not None else 0
         mesh = program.mesh
         shardings = program.var_shardings
+        amp = program.amp
 
         def run_ops(op_list, env, base_key, start_index=0):
             import jax as _jax
@@ -208,7 +211,8 @@ class Executor(object):
                 ctx = LoweringContext(env, op, block, start_index + i,
                                       base_key,
                                       is_test=bool(op.attrs.get('is_test',
-                                                                False)))
+                                                                False)),
+                                      amp=amp)
                 try:
                     get_lowering(op.type)(ctx)
                 except KeyError as e:
@@ -269,5 +273,41 @@ class Executor(object):
             return fetches, new_scope
 
         jit_fn = jax.jit(step_fn, donate_argnums=(0,))
-        return _Compiled(jit_fn, scope_in, scope_out_all, needed_feeds,
-                         fetch_names)
+        return _Compiled(jit_fn, step_fn, scope_in, scope_out_all,
+                         needed_feeds, fetch_names)
+
+    def compile_step(self, program=None, feed=None, fetch_list=None,
+                     scope=None):
+        """AOT path: compile a (program, feed-spec) pair and return
+        ``(step_fn, scope_vals, feed_vals)`` where ``step_fn(scope_vals,
+        feed_vals, step_i)`` is a pure jittable function returning
+        ``(fetches, new_scope)``. Used by bench/__graft_entry__ and the
+        inference predictor; ``Executor.run`` callers never need this."""
+        _ensure_ops_imported()
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+        feed_vals = {}
+        for name, value in (feed or {}).items():
+            var = block._find_var_recursive(name)
+            dtype = to_jnp_dtype(var.dtype) if var is not None else None
+            arr = np.asarray(value)
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            feed_vals[name] = arr
+        compiled = self._compile(program, sorted(feed_vals), fetch_names)
+        scope_vals = {}
+        for name in compiled.scope_in_names:
+            value = scope.find(name)
+            if value is None:
+                raise RuntimeError(
+                    'Variable %r not initialized; run startup program first.'
+                    % name)
+            scope_vals[name] = value
+        mesh = program.mesh
+        if mesh is not None:
+            scope_vals = self._shard_values(program, mesh, scope_vals)
+            feed_vals = self._shard_values(program, mesh, feed_vals)
+        return compiled.raw_fn, scope_vals, feed_vals

@@ -233,6 +233,11 @@ class Program(object):
         # var name -> jax.sharding.PartitionSpec (or None)
         self.var_shardings = {}
         self.mesh = None
+        # Mixed precision: None (full fp32) or 'bf16' — matmul/conv-class
+        # ops autocast inputs to bfloat16 (MXU-native) while params,
+        # grads, optimizer state and loss-class ops stay fp32
+        # (master-weight AMP; reference analog: fluid's float16 lists).
+        self.amp = None
 
     def _bump_version(self):
         self._version += 1

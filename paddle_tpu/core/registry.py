@@ -28,6 +28,21 @@ def get_lowering(op_type):
     return fn
 
 
+# AMP 'bf16' dtype policy: whitelist ops compute in bfloat16 (MXU),
+# blacklist ops are numerically sensitive and force fp32; all others run
+# in whatever dtype arrives (jnp promotion resolves mixes).
+AMP_WHITELIST = {
+    'mul', 'matmul', 'conv2d', 'conv2d_transpose', 'fused_attention',
+    'sequence_conv', 'row_conv',
+}
+AMP_BLACKLIST = {
+    'softmax', 'softmax_with_cross_entropy', 'cross_entropy',
+    'layer_norm', 'batch_norm', 'mean', 'reduce_sum', 'reduce_mean',
+    'exp', 'log', 'square_error_cost', 'l2_normalize', 'cos_sim',
+    'clip_by_norm', 'linear_chain_crf', 'nce',
+}
+
+
 class LoweringContext(object):
     """Execution context handed to each op lowering.
 
@@ -35,25 +50,40 @@ class LoweringContext(object):
     op       : the Operator being lowered
     block    : Block for var metadata lookups
     rng      : per-op PRNG key factory (stable across steps given base key)
+    amp      : None or 'bf16' — input() autocasts per the policy above
     """
 
-    def __init__(self, env, op, block, op_index, base_key, is_test=False):
+    def __init__(self, env, op, block, op_index, base_key, is_test=False,
+                 amp=None):
         self.env = env
         self.op = op
         self.block = block
         self.op_index = op_index
         self._base_key = base_key
         self.is_test = is_test
+        self.amp = amp
+
+    def _autocast(self, value):
+        if self.amp != 'bf16' or value is None:
+            return value
+        import jax.numpy as jnp
+        dtype = getattr(value, 'dtype', None)
+        if self.op.type in AMP_WHITELIST and dtype == jnp.float32:
+            return value.astype(jnp.bfloat16)
+        if self.op.type in AMP_BLACKLIST and dtype == jnp.bfloat16:
+            return value.astype(jnp.float32)
+        return value
 
     # ---- inputs / outputs ----
     def input(self, slot):
         name = self.op.input(slot)
         if name is None:
             return None
-        return self.env[name]
+        return self._autocast(self.env[name])
 
     def input_list(self, slot):
-        return [self.env[n] for n in self.op.inputs.get(slot, [])]
+        return [self._autocast(self.env[n])
+                for n in self.op.inputs.get(slot, [])]
 
     def has_input(self, slot):
         names = self.op.inputs.get(slot, [])

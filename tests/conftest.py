@@ -9,6 +9,13 @@ if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
 
+import jax  # noqa: E402
+
+# The hosted-TPU sitecustomize calls jax.config.update('jax_platforms',
+# 'axon,cpu') at interpreter boot, which overrides the env var — force it
+# back so tests really run on the 8-virtual-device CPU platform.
+jax.config.update('jax_platforms', 'cpu')
+
 import pytest  # noqa: E402
 
 
