@@ -47,11 +47,19 @@ def canonical_dtype(dtype):
 
 
 def to_jnp_dtype(dtype):
+    """Canonical string -> the dtype JAX will actually use on device.
+
+    Runs through jax.dtypes.canonicalize_dtype so 64-bit declarations map
+    to their 32-bit device dtypes under the default x64-disabled mode —
+    comparing/casting against the uncanonicalized dtype would re-cast (and
+    warn) on every executor step without ever matching.
+    """
+    import jax
     import jax.numpy as jnp
     name = canonical_dtype(dtype)
     if name == 'bfloat16':
         return jnp.bfloat16
-    return np.dtype(name)
+    return jax.dtypes.canonicalize_dtype(np.dtype(name))
 
 
 def is_float_dtype(dtype):

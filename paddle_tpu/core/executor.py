@@ -98,12 +98,15 @@ class Executor(object):
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
 
-        # Normalize feed values to arrays with the declared dtype.
+        # Normalize feed values to arrays with the declared (canonicalized)
+        # dtype. Values already on device (jax Arrays) are passed through
+        # untouched — np.asarray would round-trip them through host memory.
         feed_vals = {}
         for name, value in feed.items():
             var = block._find_var_recursive(name)
             dtype = to_jnp_dtype(var.dtype) if var is not None else None
-            arr = np.asarray(value)
+            arr = value if isinstance(value, jax.Array) \
+                else np.asarray(value)
             if dtype is not None and arr.dtype != dtype:
                 arr = arr.astype(dtype)
             feed_vals[name] = arr

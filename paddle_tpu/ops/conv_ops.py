@@ -31,17 +31,28 @@ def _conv2d(ctx):
 
 @register('conv2d_transpose')
 def _conv2d_transpose(ctx):
+    """Fractionally-strided conv: lhs_dilation=stride + flipped kernel,
+    the gradient-of-conv formulation XLA lowers best on TPU.
+    out = (in-1)*stride - 2*pad + dilation*(k-1) + 1 (conv_transpose_op.cc).
+    """
     x = ctx.input('Input')  # NCHW
-    w = ctx.input('Filter')  # IOHW in paddle (in_channels first)
+    w = ctx.input('Filter')  # paddle layout [Cin, Cout/groups, kh, kw]
     strides = tuple(ctx.attr('strides', [1, 1]))
     pads = ctx.attr('paddings', [0, 0])
     dilations = tuple(ctx.attr('dilations', [1, 1]))
-    padding = [(pads[0], pads[0]), (pads[1], pads[1])]
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=padding,
-        rhs_dilation=dilations,
-        dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
-        transpose_kernel=True)
+    groups = ctx.attr('groups', 1)
+    cin, cout_g, kh, kw = w.shape
+    # -> [Cout, Cin/groups, kh, kw], spatially flipped
+    w_t = w.reshape(groups, cin // groups, cout_g, kh, kw)
+    w_t = w_t.swapaxes(1, 2).reshape(groups * cout_g, cin // groups, kh, kw)
+    w_t = jnp.flip(w_t, axis=(2, 3))
+    padding = [(dilations[i] * ([kh, kw][i] - 1) - pads[i],) * 2
+               for i in range(2)]
+    out = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=padding,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
     ctx.set_output('Output', out)
 
 

@@ -58,9 +58,11 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
         kv_idx = jax.lax.ppermute(kv_idx, axis_name, perm)
         return (o_acc, m_new, l_acc, kv_k, kv_v, kv_idx), None
 
+    # Derive accumulators from q so they carry q's varying ('sp') manual
+    # axis — fresh constants would be unvarying and break the scan carry.
     o0 = jnp.zeros_like(q)
-    m0 = jnp.full(q.shape[:-1] + (1,), -1e30, q.dtype)
-    l0 = jnp.zeros(q.shape[:-1] + (1,), q.dtype)
+    m0 = jnp.full_like(q[..., :1], -1e30)
+    l0 = jnp.zeros_like(q[..., :1])
     carry = (o0, m0, l0, k, v, my_idx)
     (o, m, l, _, _, _), _ = jax.lax.scan(step, carry, None, length=n)
     return o / jnp.maximum(l, 1e-20)

@@ -1,0 +1,137 @@
+"""Executor semantics: feed/fetch, compile cache, pruning, errors
+(reference: fluid/tests/test_executor_and_mul.py + framework/prune.cc
+tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import rand
+
+
+def test_missing_feed_raises():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError):
+        exe.run(feed={}, fetch_list=[out])
+
+
+def test_uninitialized_scope_raises():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(RuntimeError):
+        exe.run(feed={'x': rand(2, 4)}, fetch_list=[out])
+
+
+def test_compile_cache_reused_across_steps():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': rand(2, 4)}, fetch_list=[out])
+    n_compiled = len(exe._cache)
+    for _ in range(3):
+        exe.run(feed={'x': rand(2, 4)}, fetch_list=[out])
+    assert len(exe._cache) == n_compiled  # same shapes: no re-compile
+    exe.run(feed={'x': rand(5, 4)}, fetch_list=[out])
+    assert len(exe._cache) == n_compiled + 1  # new batch size: new entry
+
+
+def test_prune_skips_unrelated_branches():
+    """Fetching one branch must not require feeds of the other."""
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[3], dtype='float32')
+    out_x = fluid.layers.fc(input=x, size=2)
+    out_y = fluid.layers.fc(input=y, size=2)  # noqa: F841
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    res = exe.run(feed={'x': rand(2, 4)}, fetch_list=[out_x])
+    assert res[0].shape == (2, 2)
+
+
+def test_fetch_intermediate_and_multiple():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    out = fluid.layers.fc(input=h, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    res = exe.run(feed={'x': rand(2, 4)}, fetch_list=[h, out, 'x'])
+    assert res[0].shape == (2, 8)
+    assert res[1].shape == (2, 2)
+    assert res[2].shape == (2, 4)
+
+
+def test_return_numpy_false_returns_device_arrays():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    res = exe.run(feed={'x': rand(2, 4)}, fetch_list=[out],
+                  return_numpy=False)
+    assert hasattr(res[0], 'devices') or hasattr(res[0], 'device')
+
+
+def test_two_programs_independent():
+    prog_a = fluid.Program()
+    prog_b = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog_a, startup):
+        xa = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out_a = fluid.layers.fc(input=xa, size=2)
+    with fluid.program_guard(prog_b, startup):
+        xb = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out_b = fluid.layers.fc(input=xb, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ra = exe.run(program=prog_a, feed={'x': rand(2, 4)}, fetch_list=[out_a])
+    rb = exe.run(program=prog_b, feed={'x': rand(2, 4)}, fetch_list=[out_b])
+    assert ra[0].shape == (2, 2)
+    assert rb[0].shape == (2, 3)
+
+
+def test_program_random_seed_reproducible():
+    prog = fluid.default_main_program()
+    prog.random_seed = 42
+    u = fluid.layers.uniform_random(shape=[8], min=0., max=1.)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    a = exe.run(feed={}, fetch_list=[u])[0]
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())  # same step sequence as exe
+    b = exe2.run(feed={}, fetch_list=[u])[0]
+    np.testing.assert_allclose(a, b)  # same seed, same step index
+    c = exe2.run(feed={}, fetch_list=[u])[0]
+    assert not np.allclose(a, c)  # next step: different draw
+
+
+def test_startup_initializers():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    fluid.layers.fc(input=x, size=3,
+                    param_attr=fluid.ParamAttr(
+                        name='w_const',
+                        initializer=fluid.initializer.Constant(0.5)),
+                    bias_attr=fluid.ParamAttr(
+                        name='b_const',
+                        initializer=fluid.initializer.Constant(-1.0)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w = np.asarray(fluid.global_scope().find('w_const'))
+    b = np.asarray(fluid.global_scope().find('b_const'))
+    np.testing.assert_allclose(w, np.full((4, 3), 0.5))
+    np.testing.assert_allclose(b, np.full((3,), -1.0))
+
+
+def test_scope_guard_isolates_state():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    my_scope = fluid.Scope()
+    with fluid.scope_guard(my_scope):
+        exe.run(fluid.default_startup_program())
+        res = exe.run(feed={'x': rand(2, 4)}, fetch_list=[out],
+                      scope=my_scope)
+    assert res[0].shape == (2, 2)
+    assert len(list(fluid.global_scope().keys())) == 0

@@ -1,0 +1,78 @@
+"""save/load roundtrip + inference model + checkpoint (reference:
+fluid/tests/unittests/test_io_save_load*, book chapters' save/load)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from util import rand
+
+
+def _build_and_train(exe, steps=2):
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name='w'),
+                           bias_attr=fluid.ParamAttr(name='b'))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe.run(fluid.default_startup_program())
+    xs, ys = rand(8, 4), rand(8, 1)
+    for _ in range(steps):
+        exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+    return pred, loss
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe)
+    w0 = np.asarray(fluid.global_scope().find('w'))
+    fluid.io.save_params(exe, str(tmp_path))
+    # clobber then restore
+    fluid.global_scope().set('w', np.zeros_like(w0))
+    fluid.io.load_params(exe, str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find('w')), w0)
+
+
+def test_save_load_persistables_includes_opt_state(tmp_path):
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe)
+    moments = [n for n in fluid.global_scope().keys() if 'moment' in n]
+    assert moments, 'Adam accumulators should be persistable'
+    m0 = np.asarray(fluid.global_scope().find(moments[0]))
+    fluid.io.save_persistables(exe, str(tmp_path))
+    fluid.global_scope().set(moments[0], np.zeros_like(m0))
+    fluid.io.load_persistables(exe, str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find(moments[0])), m0)
+
+
+def test_save_load_inference_model(tmp_path):
+    exe = fluid.Executor(fluid.CPUPlace())
+    pred, _ = _build_and_train(exe)
+    xs = rand(3, 4)
+    infer_prog = fluid.io.get_inference_program([pred])
+    expect = exe.run(program=infer_prog, feed={'x': xs},
+                     fetch_list=[pred])[0]
+    fluid.io.save_inference_model(str(tmp_path), ['x'], [pred], exe)
+
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+        str(tmp_path), exe2)
+    assert feed_names == ['x']
+    got = exe2.run(program=prog, feed={'x': xs}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got[0], expect, rtol=1e-5)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe, steps=3)
+    w0 = np.asarray(fluid.global_scope().find('w'))
+    fluid.io.save_checkpoint(exe, str(tmp_path), step=3)
+    fluid.global_scope().set('w', np.zeros_like(w0))
+    step = fluid.io.load_checkpoint(exe, str(tmp_path))
+    assert step == 3
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find('w')), w0)

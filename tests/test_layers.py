@@ -1,0 +1,260 @@
+"""Every major layer builds + runs + takes gradients (reference:
+fluid/tests/unittests/test_layers.py, which only checks graph build; we
+additionally execute and, for trainables, train one step)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+def _trains(loss):
+    """Append SGD and check one step runs and the loss is finite."""
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return loss
+
+
+def test_fc_shapes_and_grads():
+    x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    out = fluid.layers.fc(input=[h, h], size=3)
+    loss = _trains(fluid.layers.mean(out))
+    got = run_startup_and({'x': rand(4, 6)}, [out, loss])
+    assert got[0].shape == (4, 3)
+    assert np.isfinite(got[1]).all()
+
+
+def test_fc_num_flatten_dims():
+    x = fluid.layers.data(name='x', shape=[5, 6], dtype='float32')
+    out = fluid.layers.fc(input=x, size=7, num_flatten_dims=2)
+    got = run_startup_and({'x': rand(2, 5, 6)}, [out])
+    assert got[0].shape == (2, 5, 7)
+
+
+def test_embedding():
+    ids = fluid.layers.data(name='ids', shape=[3], dtype='int64')
+    emb = fluid.layers.embedding(input=ids, size=[10, 4])
+    loss = _trains(fluid.layers.mean(emb))
+    got = run_startup_and(
+        {'ids': rand(2, 3, dtype='int64', high=10)}, [emb, loss])
+    assert got[0].shape == (2, 3, 4)
+
+
+def test_conv2d_pool2d():
+    img = fluid.layers.data(name='img', shape=[3, 16, 16], dtype='float32')
+    c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                            padding=1, act='relu')
+    p = fluid.layers.pool2d(input=c, pool_size=2, pool_type='max',
+                            pool_stride=2)
+    g = fluid.layers.pool2d(input=c, pool_type='avg', global_pooling=True)
+    got = run_startup_and({'img': rand(2, 3, 16, 16)}, [c, p, g])
+    assert got[0].shape == (2, 8, 16, 16)
+    assert got[1].shape == (2, 8, 8, 8)
+    assert got[2].shape[:2] == (2, 8)
+
+
+def test_conv2d_groups_stride():
+    img = fluid.layers.data(name='img', shape=[4, 8, 8], dtype='float32')
+    c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                            stride=2, padding=1, groups=2)
+    got = run_startup_and({'img': rand(1, 4, 8, 8)}, [c])
+    assert got[0].shape == (1, 8, 4, 4)
+
+
+def test_conv2d_transpose():
+    img = fluid.layers.data(name='img', shape=[4, 5, 5], dtype='float32')
+    c = fluid.layers.conv2d_transpose(input=img, num_filters=3,
+                                      filter_size=4, stride=2, padding=1)
+    got = run_startup_and({'img': rand(2, 4, 5, 5)}, [c])
+    assert got[0].shape == (2, 3, 10, 10)
+
+
+def test_batch_norm_train_vs_test():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    bn = fluid.layers.batch_norm(input=x)
+    xs = rand(8, 4, seed=3)
+    got = run_startup_and({'x': xs}, [bn])[0]
+    # train mode: normalized by batch stats
+    np.testing.assert_allclose(got.mean(0), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(got.std(0), np.ones(4), atol=1e-2)
+
+
+def test_batch_norm_updates_running_stats():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    bn = fluid.layers.batch_norm(input=x, momentum=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rand(16, 4, seed=4) + 3.0
+    for _ in range(8):
+        exe.run(feed={'x': xs}, fetch_list=[bn])
+    scope = fluid.global_scope()
+    mean_name = [n for n in scope.keys() if 'mean' in n][0]
+    running_mean = np.asarray(scope.find(mean_name))
+    np.testing.assert_allclose(running_mean, xs.mean(0), atol=0.1)
+
+
+def test_layer_norm():
+    x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    ln = fluid.layers.layer_norm(x)
+    xs = rand(3, 6, seed=5)
+    got = run_startup_and({'x': xs}, [ln])[0]
+    np.testing.assert_allclose(got.mean(1), np.zeros(3), atol=1e-5)
+
+
+def test_dropout_train_and_test():
+    x = fluid.layers.data(name='x', shape=[100], dtype='float32')
+    d_train = fluid.layers.dropout(x, dropout_prob=0.5)
+    d_test = fluid.layers.dropout(x, dropout_prob=0.5, is_test=True)
+    xs = np.ones((4, 100), dtype='float32')
+    got = run_startup_and({'x': xs}, [d_train, d_test])
+    zeros_frac = (got[0] == 0).mean()
+    assert 0.2 < zeros_frac < 0.8
+    # surviving values are NOT upscaled in train; inference multiplies by
+    # (1 - p) — the reference dropout_op.cc "downgrade_in_infer" semantics
+    kept = got[0][got[0] != 0]
+    np.testing.assert_allclose(kept, np.ones_like(kept))
+    np.testing.assert_allclose(got[1], xs * 0.5)
+
+
+def test_cross_entropy_and_softmax_ce():
+    logits = fluid.layers.data(name='l', shape=[5], dtype='float32')
+    label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    prob = fluid.layers.softmax(logits)
+    ce = fluid.layers.cross_entropy(input=prob, label=label)
+    sce = fluid.layers.softmax_with_cross_entropy(logits=logits, label=label)
+    ls = rand(3, 5, seed=6)
+    ys = np.array([[0], [2], [4]], dtype='int64')
+    got = run_startup_and({'l': ls, 'y': ys}, [ce, sce])
+    e = np.exp(ls - ls.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expect = -np.log(p[np.arange(3), ys[:, 0]])
+    np.testing.assert_allclose(got[0].ravel(), expect, rtol=1e-5)
+    np.testing.assert_allclose(got[1].ravel(), expect, rtol=1e-5)
+
+
+def test_square_error_cost_smooth_l1_cos_sim():
+    a = fluid.layers.data(name='a', shape=[4], dtype='float32')
+    b = fluid.layers.data(name='b', shape=[4], dtype='float32')
+    sec = fluid.layers.square_error_cost(input=a, label=b)
+    cs = fluid.layers.cos_sim(X=a, Y=b)
+    av, bv = rand(3, 4, seed=7), rand(3, 4, seed=8)
+    got = run_startup_and({'a': av, 'b': bv}, [sec, cs])
+    np.testing.assert_allclose(got[0], (av - bv) ** 2, rtol=1e-5)
+    expect_cs = (av * bv).sum(1) / (
+        np.linalg.norm(av, axis=1) * np.linalg.norm(bv, axis=1))
+    np.testing.assert_allclose(got[1].ravel(), expect_cs, rtol=1e-5)
+
+
+def test_l2_normalize():
+    a = fluid.layers.data(name='a', shape=[4], dtype='float32')
+    out = fluid.layers.l2_normalize(a, axis=1)
+    av = rand(3, 4, seed=9)
+    got = run_startup_and({'a': av}, [out])[0]
+    np.testing.assert_allclose(
+        got, av / np.linalg.norm(av, axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_accuracy_and_auc():
+    prob = fluid.layers.data(name='p', shape=[4], dtype='float32')
+    label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    acc = fluid.layers.accuracy(input=prob, label=label)
+    ps = np.array([[0.1, 0.7, 0.1, 0.1],
+                   [0.6, 0.2, 0.1, 0.1],
+                   [0.2, 0.2, 0.5, 0.1]], dtype='float32')
+    ys = np.array([[1], [2], [2]], dtype='int64')
+    got = run_startup_and({'p': ps, 'y': ys}, [acc])
+    np.testing.assert_allclose(got[0], 2.0 / 3.0, rtol=1e-6)
+
+
+def test_one_hot_multiplex():
+    a = fluid.layers.data(name='a', shape=[3], dtype='float32')
+    b = fluid.layers.data(name='b', shape=[3], dtype='float32')
+    idx = fluid.layers.data(name='i', shape=[1], dtype='int64')
+    out = fluid.layers.multiplex(inputs=[a, b], index=idx)
+    av, bv = rand(4, 3, seed=10), rand(4, 3, seed=11)
+    iv = np.array([[0], [1], [1], [0]], dtype='int64')
+    got = run_startup_and({'a': av, 'b': bv, 'i': iv}, [out])[0]
+    expect = np.where(iv == 0, av, bv)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_nets_img_conv_pool_and_glu():
+    img = fluid.layers.data(name='img', shape=[1, 8, 8], dtype='float32')
+    out = fluid.nets.simple_img_conv_pool(
+        input=img, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+        act='relu')
+    x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    glu = fluid.nets.glu(input=x, dim=1)
+    got = run_startup_and({'img': rand(2, 1, 8, 8), 'x': rand(2, 6)},
+                          [out, glu])
+    assert got[0].shape[0] == 2
+    assert got[1].shape == (2, 3)
+
+
+def test_scaled_dot_product_attention_net():
+    q = fluid.layers.data(name='q', shape=[4, 8], dtype='float32')
+    k = fluid.layers.data(name='k', shape=[6, 8], dtype='float32')
+    v = fluid.layers.data(name='v', shape=[6, 8], dtype='float32')
+    ctx = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=2)
+    got = run_startup_and(
+        {'q': rand(2, 4, 8), 'k': rand(2, 6, 8), 'v': rand(2, 6, 8)}, [ctx])
+    assert got[0].shape == (2, 4, 8)
+
+
+def test_nce_builds_and_trains():
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    cost = fluid.layers.nce(input=x, label=y, num_total_classes=20,
+                            num_neg_samples=4)
+    loss = _trains(fluid.layers.mean(cost))
+    got = run_startup_and(
+        {'x': rand(4, 8), 'y': rand(4, 1, dtype='int64', high=20)}, [loss])
+    assert np.isfinite(got[0]).all()
+
+
+def test_im2sequence():
+    img = fluid.layers.data(name='img', shape=[1, 4, 4], dtype='float32')
+    seq = fluid.layers.im2sequence(input=img, filter_size=2, stride=2)
+    got = run_startup_and({'img': rand(2, 1, 4, 4)}, [seq])[0]
+    assert got.shape[-1] == 4  # 2x2 patches flattened
+
+
+def test_bilinear_tensor_product_maxout_prelu():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[5], dtype='float32')
+    btp = fluid.layers.bilinear_tensor_product(x=x, y=y, size=3)
+    got = run_startup_and({'x': rand(2, 4), 'y': rand(2, 5)}, [btp])
+    assert got[0].shape == (2, 3)
+
+
+def test_row_conv_like_sequence_conv():
+    x = fluid.layers.data(name='x', shape=[5, 4], dtype='float32')
+    sc = fluid.layers.sequence_conv(input=x, num_filters=6, filter_size=3)
+    got = run_startup_and({'x': rand(2, 5, 4)}, [sc])
+    assert got[0].shape == (2, 5, 6)
+
+
+def test_pad_reverse_expand():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    pd = fluid.layers.pad(x, paddings=[0, 0, 1, 2], pad_value=9.0)
+    rv = fluid.layers.reverse(x, axis=1)
+    ex = fluid.layers.expand(x, expand_times=[2, 1])
+    xs = rand(2, 3, seed=12)
+    got = run_startup_and({'x': xs}, [pd, rv, ex])
+    assert got[0].shape == (2, 6)
+    np.testing.assert_allclose(got[0][:, 1:4], xs)
+    np.testing.assert_allclose(got[1], xs[:, ::-1])
+    np.testing.assert_allclose(got[2], np.tile(xs, (2, 1)))
+
+
+def test_smooth_l1():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[4], dtype='float32')
+    out = fluid.layers.smooth_l1(x=x, y=y)
+    xs, ys = rand(3, 4, seed=13), rand(3, 4, seed=14)
+    got = run_startup_and({'x': xs, 'y': ys}, [out])[0]
+    d = xs - ys
+    expect = np.where(np.abs(d) < 1.0, 0.5 * d * d,
+                      np.abs(d) - 0.5).sum(1, keepdims=True)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)

@@ -1,0 +1,165 @@
+"""End-to-end: loss decreases on synthetic data for every §2.6 model
+(reference: the book chapters' train loops + benchmark configs, shrunk to
+seconds on CPU)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _train(loss, feeder, steps=12, opt=None):
+    (opt or fluid.optimizer.Adam(learning_rate=1e-3)).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for i in range(steps):
+        out = exe.run(feed=feeder(i), fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    return losses
+
+
+def test_linear_fit_a_line():
+    from paddle_tpu.models.linear import fit_a_line
+    _pred, loss = fit_a_line(feature_dim=13)
+    rng = np.random.RandomState(7)
+    w = rng.randn(13, 1).astype('float32')
+    xs = rng.randn(32, 13).astype('float32')
+    ys = xs @ w
+    _train(loss, lambda i: {'x': xs, 'y': ys},
+           opt=fluid.optimizer.SGD(learning_rate=0.05), steps=20)
+
+
+def test_lenet_mnist():
+    from paddle_tpu.models.lenet import convolutional_neural_network
+    _predict, loss, _acc = convolutional_neural_network()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 1, 28, 28).astype('float32')
+    ys = rng.randint(0, 10, (16, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=8)
+
+
+def test_mlp_mnist():
+    from paddle_tpu.models.lenet import multilayer_perceptron
+    _predict, loss, _acc = multilayer_perceptron()
+    rng = np.random.RandomState(8)
+    xs = rng.rand(16, 1, 28, 28).astype('float32')
+    ys = rng.randint(0, 10, (16, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys})
+
+
+def test_word2vec_imikolov():
+    from paddle_tpu.models.word2vec import train_program
+    loss, feeds = train_program(dict_size=100)
+    rng = np.random.RandomState(1)
+    feed = {n: rng.randint(0, 100, (32, 1)).astype('int64') for n in feeds}
+    _train(loss, lambda i: feed)
+
+
+def test_resnet_cifar_tiny():
+    from paddle_tpu.models.resnet import resnet_cifar10
+    img = fluid.layers.data(name='img', shape=[3, 8, 8], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = resnet_cifar10(img, depth=8, class_dim=10)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(2)
+    xs = rng.rand(8, 3, 8, 8).astype('float32')
+    ys = rng.randint(0, 10, (8, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys})
+
+
+def test_wide_deep_ctr():
+    from paddle_tpu.models.wide_deep import build
+    _predict, loss, _acc, feeds = build(num_slots=4, vocab_size=100,
+                                        dense_dim=8, embed_size=8)
+    rng = np.random.RandomState(3)
+    feed = {}
+    for n in feeds:
+        if n == 'dense':
+            feed[n] = rng.rand(16, 8).astype('float32')
+        elif n == 'label':
+            feed[n] = rng.randint(0, 2, (16, 1)).astype('int64')
+        else:
+            feed[n] = rng.randint(0, 100, (16, 1)).astype('int64')
+    _train(loss, lambda i: feed)
+
+
+def test_transformer_tiny():
+    from paddle_tpu.models import transformer as T
+    avg_cost, _ = T.transformer_base(
+        src_vocab_size=64, trg_vocab_size=64, src_seq_len=8, trg_seq_len=8,
+        n_layer=1, d_model=32, d_inner=64, d_key=8, d_value=8,
+        dropout_rate=0.0)
+    feed = T.make_fake_batch(4, 8, 8, 64, 64)
+    _train(avg_cost, lambda i: feed)
+
+
+def test_vgg_tiny():
+    from paddle_tpu.models.vgg import vgg_bn_drop
+    img = fluid.layers.data(name='img', shape=[3, 32, 32], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = vgg_bn_drop(img, class_dim=10)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(4)
+    xs = rng.rand(4, 3, 32, 32).astype('float32')
+    ys = rng.randint(0, 10, (4, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=6)
+
+
+def test_sentiment_conv_net():
+    from paddle_tpu.models.seq_models import convolution_net
+    data = fluid.layers.data(name='words', shape=[12], dtype='int64')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    length = fluid.layers.data(name='length', shape=[], dtype='int64')
+    _pred, loss, _acc = convolution_net(data, label, input_dim=200,
+                                        emb_dim=16, hid_dim=16,
+                                        length=length)
+    rng = np.random.RandomState(5)
+    feed = {'words': rng.randint(1, 200, (8, 12)).astype('int64'),
+            'length': np.full((8,), 12, dtype='int64'),
+            'label': rng.randint(0, 2, (8, 1)).astype('int64')}
+    _train(loss, lambda i: feed)
+
+
+def test_stacked_lstm_sentiment():
+    from paddle_tpu.models.seq_models import stacked_lstm_net
+    data = fluid.layers.data(name='words', shape=[10], dtype='int64')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    length = fluid.layers.data(name='length', shape=[], dtype='int64')
+    _pred, loss, _acc = stacked_lstm_net(data, label, input_dim=100,
+                                         emb_dim=16, hid_dim=16,
+                                         stacked_num=3, length=length)
+    rng = np.random.RandomState(6)
+    feed = {'words': rng.randint(1, 100, (4, 10)).astype('int64'),
+            'length': np.full((4,), 10, dtype='int64'),
+            'label': rng.randint(0, 2, (4, 1)).astype('int64')}
+    _train(loss, lambda i: feed, steps=8)
+
+
+def test_mobilenet_tiny():
+    from paddle_tpu.models.mobilenet import mobile_net
+    img = fluid.layers.data(name='img', shape=[3, 32, 32], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = mobile_net(img, class_dim=10, scale=0.25)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(6)
+    xs = rng.rand(4, 3, 32, 32).astype('float32')
+    ys = rng.randint(0, 10, (4, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=6)
+
+
+def test_resnext_tiny():
+    from paddle_tpu.models.resnext import se_resnext
+    img = fluid.layers.data(name='img', shape=[3, 32, 32], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = se_resnext(img, class_dim=10, depth=50, cardinality=8)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(9)
+    xs = rng.rand(2, 3, 32, 32).astype('float32')
+    ys = rng.randint(0, 10, (2, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=4)

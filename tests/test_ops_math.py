@@ -1,0 +1,237 @@
+"""Per-op numerical checks vs numpy (reference: fluid/tests/unittests
+test_activation_op.py, test_elementwise_*_op.py, test_reduce_op.py —
+check_output analog)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+X = rand(3, 4, seed=1, low=0.1, high=2.0)  # positive, for log/sqrt domains
+XS = rand(3, 4, seed=2)                    # signed
+
+
+def _unary(layer_fn, x, **kwargs):
+    inp = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out = layer_fn(inp, **kwargs)
+    return run_startup_and({'x': x}, [out])[0]
+
+
+ACTIVATIONS = [
+    ('sigmoid', lambda x: 1 / (1 + np.exp(-x)), XS),
+    ('logsigmoid', lambda x: np.log(1 / (1 + np.exp(-x))), XS),
+    ('exp', np.exp, XS),
+    ('relu', lambda x: np.maximum(x, 0), XS),
+    ('tanh', np.tanh, XS),
+    ('sqrt', np.sqrt, X),
+    ('abs', np.abs, XS),
+    ('ceil', np.ceil, XS),
+    ('floor', np.floor, XS),
+    ('round', np.round, XS),
+    ('reciprocal', lambda x: 1 / x, X),
+    ('log', np.log, X),
+    ('square', np.square, XS),
+    ('softplus', lambda x: np.log1p(np.exp(x)), XS),
+    ('softsign', lambda x: x / (1 + np.abs(x)), XS),
+    ('leaky_relu', lambda x: np.where(x > 0, x, 0.02 * x), XS),
+    ('elu', lambda x: np.where(x > 0, x, np.expm1(x)), XS),
+    ('relu6', lambda x: np.clip(x, 0, 6), XS),
+    ('softshrink', lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0)), XS),
+    ('hard_shrink', lambda x: np.where(np.abs(x) > 0.5, x, 0), XS),
+    ('hard_sigmoid', lambda x: np.clip(0.2 * x + 0.5, 0, 1), XS),
+    ('swish', lambda x: x / (1 + np.exp(-x)), XS),
+    ('stanh', lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x), XS),
+    ('soft_relu', lambda x: np.log1p(np.exp(np.clip(x, -40, 40))), XS),
+    ('brelu', lambda x: np.clip(x, 0, 24), XS),
+    ('thresholded_relu', lambda x: np.where(x > 1.0, x, 0), XS),
+    ('sin', np.sin, XS),
+    ('cos', np.cos, XS),
+    ('rsqrt', lambda x: 1 / np.sqrt(x), X),
+]
+
+
+@pytest.mark.parametrize('name,ref,x', ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation(name, ref, x):
+    out = _unary(getattr(fluid.layers, name), x)
+    np.testing.assert_allclose(out, ref(x.astype('float64')), rtol=2e-5,
+                               atol=1e-6)
+
+
+ELEMENTWISE = [
+    ('elementwise_add', np.add), ('elementwise_sub', np.subtract),
+    ('elementwise_mul', np.multiply), ('elementwise_div', np.divide),
+    ('elementwise_max', np.maximum), ('elementwise_min', np.minimum),
+    ('elementwise_pow', np.power),
+]
+
+
+@pytest.mark.parametrize('name,ref', ELEMENTWISE,
+                         ids=[e[0] for e in ELEMENTWISE])
+def test_elementwise(name, ref):
+    a, b = rand(3, 4, seed=3, low=0.5, high=2.0), \
+        rand(3, 4, seed=4, low=0.5, high=2.0)
+    xa = fluid.layers.data(name='a', shape=[4], dtype='float32')
+    xb = fluid.layers.data(name='b', shape=[4], dtype='float32')
+    out = getattr(fluid.layers, name)(x=xa, y=xb)
+    got = run_startup_and({'a': a, 'b': b}, [out])[0]
+    np.testing.assert_allclose(got, ref(a, b), rtol=1e-5)
+
+
+def test_elementwise_broadcast_axis():
+    """Paddle-style broadcast: y's shape aligns to x at `axis`."""
+    a = rand(2, 3, 4, seed=5)
+    b = rand(3, seed=6)
+    xa = fluid.layers.data(name='a', shape=[3, 4], dtype='float32')
+    xb = fluid.layers.data(name='b', shape=[], dtype='float32')
+    xb.shape = (3,)
+    out = fluid.layers.elementwise_add(x=xa, y=xb, axis=1)
+    got = run_startup_and({'a': a, 'b': b}, [out])[0]
+    np.testing.assert_allclose(got, a + b[None, :, None], rtol=1e-6)
+
+
+REDUCES = [('reduce_sum', np.sum), ('reduce_mean', np.mean),
+           ('reduce_max', np.max), ('reduce_min', np.min),
+           ('reduce_prod', np.prod)]
+
+
+@pytest.mark.parametrize('name,ref', REDUCES, ids=[r[0] for r in REDUCES])
+def test_reduce(name, ref):
+    x = rand(3, 4, seed=7)
+    inp = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    out_all = getattr(fluid.layers, name)(inp)
+    out_d1 = getattr(fluid.layers, name)(inp, dim=1, keep_dim=True)
+    got = run_startup_and({'x': x}, [out_all, out_d1])
+    np.testing.assert_allclose(got[0], ref(x), rtol=1e-5)
+    np.testing.assert_allclose(got[1], ref(x, axis=1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_matmul_and_transpose():
+    a, b = rand(2, 3, 4, seed=8), rand(2, 4, 5, seed=9)
+    xa = fluid.layers.data(name='a', shape=[3, 4], dtype='float32')
+    xb = fluid.layers.data(name='b', shape=[4, 5], dtype='float32')
+    mm = fluid.layers.matmul(xa, xb)
+    tr = fluid.layers.transpose(xa, perm=[0, 2, 1])
+    got = run_startup_and({'a': a, 'b': b}, [mm, tr])
+    np.testing.assert_allclose(got[0], a @ b, rtol=1e-5)
+    np.testing.assert_allclose(got[1], a.transpose(0, 2, 1))
+
+
+def test_softmax_log_softmax_clip_cumsum():
+    x = rand(3, 5, seed=10)
+    inp = fluid.layers.data(name='x', shape=[5], dtype='float32')
+    sm = fluid.layers.softmax(inp)
+    cl = fluid.layers.clip(inp, min=-0.5, max=0.5)
+    cs = fluid.layers.cumsum(inp, axis=1)
+    got = run_startup_and({'x': x}, [sm, cl, cs])
+    e = np.exp(x - x.max(1, keepdims=True))
+    np.testing.assert_allclose(got[0], e / e.sum(1, keepdims=True),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[1], np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(got[2], np.cumsum(x, axis=1), rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a, b = rand(2, 3, seed=11), rand(2, 3, seed=12)
+    xa = fluid.layers.data(name='a', shape=[3], dtype='float32')
+    xb = fluid.layers.data(name='b', shape=[3], dtype='float32')
+    cc = fluid.layers.concat([xa, xb], axis=1)
+    st = fluid.layers.stack([xa, xb], axis=0)
+    parts = fluid.layers.split(xa, num_or_sections=3, dim=1)
+    got = run_startup_and({'a': a, 'b': b}, [cc, st] + list(parts))
+    np.testing.assert_allclose(got[0], np.concatenate([a, b], 1))
+    np.testing.assert_allclose(got[1], np.stack([a, b], 0))
+    for i in range(3):
+        np.testing.assert_allclose(got[2 + i], a[:, i:i + 1])
+
+
+def test_logical_and_compare():
+    a = np.array([[True, False], [True, True]])
+    b = np.array([[True, True], [False, True]])
+    xa = fluid.layers.data(name='a', shape=[2], dtype='bool')
+    xb = fluid.layers.data(name='b', shape=[2], dtype='bool')
+    ops = [fluid.layers.logical_and(xa, xb), fluid.layers.logical_or(xa, xb),
+           fluid.layers.logical_xor(xa, xb), fluid.layers.logical_not(xa)]
+    got = run_startup_and({'a': a, 'b': b}, ops)
+    np.testing.assert_array_equal(got[0], a & b)
+    np.testing.assert_array_equal(got[1], a | b)
+    np.testing.assert_array_equal(got[2], a ^ b)
+    np.testing.assert_array_equal(got[3], ~a)
+
+
+def test_less_than_equal():
+    a, b = rand(4, seed=13), rand(4, seed=13)
+    b2 = b.copy()
+    b2[0] += 1.0
+    xa = fluid.layers.data(name='a', shape=[], dtype='float32')
+    xb = fluid.layers.data(name='b', shape=[], dtype='float32')
+    xa.shape, xb.shape = (4,), (4,)
+    lt = fluid.layers.less_than(x=xa, y=xb)
+    eq = fluid.layers.equal(x=xa, y=xb)
+    got = run_startup_and({'a': a, 'b': b2}, [lt, eq])
+    np.testing.assert_array_equal(got[0], a < b2)
+    np.testing.assert_array_equal(got[1], a == b2)
+
+
+def test_cast_one_hot_label_smooth():
+    ids = np.array([[1], [3], [0]], dtype='int64')
+    inp = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+    oh = fluid.layers.one_hot(inp, depth=4)
+    ls = fluid.layers.label_smooth(label=oh, epsilon=0.1)
+    ct = fluid.layers.cast(inp, dtype='float32')
+    got = run_startup_and({'ids': ids}, [oh, ls, ct])
+    expect = np.zeros((3, 4), dtype='float32')
+    expect[np.arange(3), ids[:, 0]] = 1
+    np.testing.assert_allclose(got[0].reshape(3, 4), expect)
+    np.testing.assert_allclose(got[1].reshape(3, 4),
+                               expect * 0.9 + 0.1 / 4, rtol=1e-5)
+    np.testing.assert_allclose(got[2], ids.astype('float32'))
+
+
+def test_topk_argsort_argmax():
+    x = rand(3, 6, seed=14)
+    inp = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    vals, idx = fluid.layers.topk(inp, k=2)
+    am = fluid.layers.argmax(inp, axis=1)
+    got = run_startup_and({'x': x}, [vals, idx, am])
+    ref_idx = np.argsort(-x, axis=1)[:, :2]
+    np.testing.assert_allclose(got[0], np.take_along_axis(x, ref_idx, 1),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(got[1], ref_idx)
+    np.testing.assert_array_equal(got[2], np.argmax(x, 1))
+
+
+def test_gather_scatter_where():
+    x = rand(5, 3, seed=15)
+    idx = np.array([0, 2, 4], dtype='int64')
+    xi = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    xi.shape = (5, 3)
+    ii = fluid.layers.data(name='i', shape=[], dtype='int64')
+    ii.shape = (3,)
+    g = fluid.layers.gather(xi, ii)
+    got = run_startup_and({'x': x, 'i': idx}, [g])
+    np.testing.assert_allclose(got[0], x[idx])
+
+
+def test_uniform_gaussian_random_shapes():
+    u = fluid.layers.uniform_random(shape=[4, 5], min=-2.0, max=3.0)
+    g = fluid.layers.gaussian_random(shape=[4, 5], mean=1.0, std=0.5)
+    got = run_startup_and({}, [u, g])
+    assert got[0].shape == (4, 5) and got[1].shape == (4, 5)
+    assert got[0].min() >= -2.0 and got[0].max() <= 3.0
+    assert abs(got[1].mean() - 1.0) < 0.5
+
+
+def test_fill_ones_zeros_shape_range():
+    fc = fluid.layers.fill_constant(shape=[2, 3], dtype='float32', value=7.0)
+    on = fluid.layers.ones(shape=[2, 2], dtype='float32')
+    ze = fluid.layers.zeros(shape=[3], dtype='int64')
+    rg = fluid.layers.range(0, 10, 2, 'int64')
+    got = run_startup_and({}, [fc, on, ze, rg])
+    np.testing.assert_allclose(got[0], np.full((2, 3), 7.0))
+    np.testing.assert_allclose(got[1], np.ones((2, 2)))
+    np.testing.assert_allclose(got[2], np.zeros(3))
+    np.testing.assert_array_equal(got[3], np.arange(0, 10, 2))

@@ -1,0 +1,208 @@
+"""Each optimizer's update vs a hand-computed numpy reference (reference:
+fluid/tests/unittests/test_sgd_op.py, test_adam_op.py, ... check_output).
+
+Setup: single parameter p (init p0), loss = reduce_sum(p * x) so
+dL/dp = x exactly — every rule below is verified analytically.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+P0 = np.array([1.0, -2.0, 3.0, 0.5], dtype='float32')
+X = np.array([0.5, -1.0, 2.0, 0.25], dtype='float32')
+LR = 0.1
+
+
+def _run_steps(make_opt, n_steps=3):
+    p = fluid.layers.create_parameter(
+        shape=[4], dtype='float32', name='p',
+        default_initializer=fluid.initializer.NumpyArrayInitializer(P0))
+    x = fluid.layers.data(name='x', shape=[], dtype='float32')
+    x.shape = (4,)
+    loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(x=p, y=x))
+    make_opt().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(n_steps):
+        exe.run(feed={'x': X}, fetch_list=[loss])
+    return np.asarray(fluid.global_scope().find('p'))
+
+
+def test_sgd():
+    got = _run_steps(lambda: fluid.optimizer.SGD(learning_rate=LR))
+    expect = P0 - 3 * LR * X
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_momentum():
+    got = _run_steps(lambda: fluid.optimizer.Momentum(learning_rate=LR,
+                                                      momentum=0.9))
+    p, v = P0.copy(), np.zeros_like(P0)
+    for _ in range(3):
+        v = 0.9 * v + X
+        p = p - LR * v
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_momentum_nesterov():
+    got = _run_steps(lambda: fluid.optimizer.Momentum(
+        learning_rate=LR, momentum=0.9, use_nesterov=True))
+    p, v = P0.copy(), np.zeros_like(P0)
+    for _ in range(3):
+        v = 0.9 * v + X
+        p = p - LR * (X + 0.9 * v)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_adagrad():
+    got = _run_steps(lambda: fluid.optimizer.Adagrad(learning_rate=LR,
+                                                     epsilon=1e-6))
+    p, m = P0.copy(), np.zeros_like(P0)
+    for _ in range(3):
+        m = m + X * X
+        p = p - LR * X / (np.sqrt(m) + 1e-6)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_adam():
+    got = _run_steps(lambda: fluid.optimizer.Adam(
+        learning_rate=LR, beta1=0.9, beta2=0.999, epsilon=1e-8))
+    p = P0.copy().astype('float64')
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    b1p, b2p = 1.0, 1.0
+    for _ in range(3):
+        m = 0.9 * m + 0.1 * X
+        v = 0.999 * v + 0.001 * X * X
+        b1p *= 0.9
+        b2p *= 0.999
+        lr_t = LR * np.sqrt(1 - b2p) / (1 - b1p)
+        p = p - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+def test_adamax():
+    got = _run_steps(lambda: fluid.optimizer.Adamax(
+        learning_rate=LR, beta1=0.9, beta2=0.999, epsilon=1e-8))
+    p = P0.copy().astype('float64')
+    m = np.zeros_like(p)
+    u = np.zeros_like(p)
+    b1p = 1.0
+    for _ in range(3):
+        m = 0.9 * m + 0.1 * X
+        u = np.maximum(0.999 * u, np.abs(X))
+        b1p *= 0.9
+        p = p - (LR / (1 - b1p)) * m / (u + 1e-8)
+    np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+def test_decayed_adagrad():
+    got = _run_steps(lambda: fluid.optimizer.DecayedAdagrad(
+        learning_rate=LR, decay=0.95, epsilon=1e-6))
+    p, m = P0.copy(), np.zeros_like(P0)
+    for _ in range(3):
+        m = 0.95 * m + 0.05 * X * X
+        p = p - LR * X / (np.sqrt(m) + 1e-6)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_adadelta():
+    got = _run_steps(lambda: fluid.optimizer.Adadelta(
+        learning_rate=LR, rho=0.95, epsilon=1e-6))
+    p = P0.copy().astype('float64')
+    g_acc = np.zeros_like(p)
+    u_acc = np.zeros_like(p)
+    for _ in range(3):
+        g_acc = 0.95 * g_acc + 0.05 * X * X
+        upd = np.sqrt(u_acc + 1e-6) / np.sqrt(g_acc + 1e-6) * X
+        u_acc = 0.95 * u_acc + 0.05 * upd * upd
+        p = p - upd
+    np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+def test_rmsprop():
+    got = _run_steps(lambda: fluid.optimizer.RMSProp(
+        learning_rate=LR, rho=0.95, epsilon=1e-6, momentum=0.9))
+    p = P0.copy().astype('float64')
+    ms = np.zeros_like(p)
+    mom = np.zeros_like(p)
+    for _ in range(3):
+        ms = 0.95 * ms + 0.05 * X * X
+        mom = 0.9 * mom + LR * X / np.sqrt(ms + 1e-6)
+        p = p - mom
+    np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+def test_ftrl():
+    got = _run_steps(lambda: fluid.optimizer.Ftrl(
+        learning_rate=LR, l1=0.0, l2=0.0, lr_power=-0.5))
+    p = P0.copy().astype('float64')
+    sq = np.zeros_like(p)
+    lin = np.zeros_like(p)
+    for _ in range(3):
+        new_sq = sq + X * X
+        sigma = (new_sq ** 0.5 - sq ** 0.5) / LR
+        lin = lin + X - sigma * p
+        sq = new_sq
+        p = -lin / (sq ** 0.5 / LR)  # l1=l2=0 closed form
+    np.testing.assert_allclose(got, p, rtol=1e-4)
+
+
+def test_global_step_lr_decay():
+    p = fluid.layers.create_parameter(
+        shape=[4], dtype='float32', name='p',
+        default_initializer=fluid.initializer.NumpyArrayInitializer(P0))
+    x = fluid.layers.data(name='x', shape=[], dtype='float32')
+    x.shape = (4,)
+    loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(x=p, y=x))
+    lr = fluid.learning_rate_decay.exponential_decay(
+        learning_rate=LR, decay_steps=1, decay_rate=0.5, staircase=True)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(3):
+        exe.run(feed={'x': X}, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find('p'))
+    # steps 0,1,2 -> lr = LR, LR/2, LR/4
+    expect = P0 - (LR + LR / 2 + LR / 4) * X
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_regularizer_l2():
+    p = fluid.layers.create_parameter(
+        shape=[4], dtype='float32', name='p',
+        default_initializer=fluid.initializer.NumpyArrayInitializer(P0))
+    x = fluid.layers.data(name='x', shape=[], dtype='float32')
+    x.shape = (4,)
+    loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(x=p, y=x))
+    fluid.optimizer.SGD(
+        learning_rate=LR,
+        regularization=fluid.regularizer.L2Decay(0.5)).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': X}, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find('p'))
+    expect = P0 - LR * (X + 0.5 * P0)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    p = fluid.layers.create_parameter(
+        shape=[4], dtype='float32', name='p',
+        default_initializer=fluid.initializer.NumpyArrayInitializer(P0))
+    x = fluid.layers.data(name='x', shape=[], dtype='float32')
+    x.shape = (4,)
+    loss = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(x=p, y=x))
+    fluid.clip.set_gradient_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': X}, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find('p'))
+    gnorm = np.linalg.norm(X)
+    scaled = X * min(1.0, 1.0 / gnorm)
+    expect = P0 - LR * scaled
+    np.testing.assert_allclose(got, expect, rtol=1e-5)

@@ -1,0 +1,153 @@
+"""SPMD correctness on the 8-virtual-device CPU mesh (SURVEY.md §4):
+data-parallel grads == single-device, tensor-parallel == unsharded,
+ring attention == full attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
+from util import rand
+
+
+def _build_mlp_loss():
+    x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    h = fluid.layers.fc(input=x, size=16, act='relu',
+                        param_attr=fluid.ParamAttr(name='w1'),
+                        bias_attr=fluid.ParamAttr(name='b1'))
+    out = fluid.layers.fc(input=h, size=4, act='softmax',
+                          param_attr=fluid.ParamAttr(name='w2'),
+                          bias_attr=fluid.ParamAttr(name='b2'))
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=out, label=y))
+    return loss
+
+
+def _train_k_steps(mesh=None, strategy=None, steps=3, seed=0):
+    """Build + train the MLP; returns (final loss, final w1)."""
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    loss = _build_mlp_loss()
+    fluid.default_main_program().random_seed = 7
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    if mesh is not None:
+        transpile(fluid.default_main_program(), mesh, strategy)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(16, 6).astype('float32')
+    ys = rng.randint(0, 4, (16, 1)).astype('int64')
+    final = None
+    for _ in range(steps):
+        final = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+    w1 = np.asarray(fluid.global_scope().find('w1'))
+    return float(np.asarray(final[0])), w1
+
+
+def test_data_parallel_matches_single_device():
+    loss_1, w1_1 = _train_k_steps(mesh=None)
+    mesh = make_mesh(dp=8)
+    loss_dp, w1_dp = _train_k_steps(
+        mesh=mesh, strategy=ParallelStrategy(data_parallel=True))
+    assert abs(loss_1 - loss_dp) < 1e-4
+    np.testing.assert_allclose(w1_1, w1_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_parallel_matches_unsharded():
+    loss_1, w1_1 = _train_k_steps(mesh=None)
+    mesh = make_mesh(dp=2, tp=4)
+    strategy = ParallelStrategy(
+        data_parallel=True, tensor_parallel=True,
+        tp_rules=[('w1', 1), ('w2', 0)])  # column then row split
+    loss_tp, w1_tp = _train_k_steps(mesh=mesh, strategy=strategy)
+    assert abs(loss_1 - loss_tp) < 1e-4
+    np.testing.assert_allclose(w1_1, w1_tp, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_equals_full_attention():
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    b, h, t, d, n_shards = 2, 2, 32, 8, 8
+    rng = np.random.RandomState(0)
+    q = rng.randn(b, h, t, d).astype('float32')
+    k = rng.randn(b, h, t, d).astype('float32')
+    v = rng.randn(b, h, t, d).astype('float32')
+
+    # full attention reference
+    def full(q, k, v, causal):
+        s = np.einsum('bhqd,bhkd->bhqk', q * d ** -0.5, k)
+        if causal:
+            mask = np.tril(np.ones((t, t), dtype=bool))
+            s = np.where(mask[None, None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum('bhqk,bhkd->bhqd', p, v)
+
+    mesh = Mesh(np.array(jax.devices()[:n_shards]).reshape(n_shards),
+                ('sp',))
+    spec = P(None, None, 'sp', None)
+
+    for causal in (False, True):
+        ring = shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name='sp',
+                                           causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        got = np.asarray(jax.jit(ring)(q, k, v))
+        np.testing.assert_allclose(got, full(q, k, v, causal),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg='causal=%s' % causal)
+
+
+def test_collectives_roundtrip():
+    from paddle_tpu.parallel import collective
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ('dp',))
+    x = np.arange(8, dtype='float32').reshape(4, 2)
+
+    f = shard_map(lambda a: collective.all_reduce(a, 'dp'),
+                  mesh=mesh, in_specs=(P('dp', None),),
+                  out_specs=P('dp', None))
+    got = np.asarray(jax.jit(f)(x))
+    expect = np.tile(x.sum(0, keepdims=True), (4, 1))
+    np.testing.assert_allclose(got, expect)
+
+    g = shard_map(
+        lambda a: collective.all_gather(a, 'dp', axis=0)[None],
+        mesh=mesh, in_specs=(P('dp', None),), out_specs=P('dp', None),
+        check_vma=False)
+    got_g = np.asarray(jax.jit(g)(x))  # each shard returns the full gather
+    np.testing.assert_allclose(got_g.reshape(4, 4, 2)[0], x)
+
+
+def test_transpiler_attaches_shardings():
+    loss = _build_mlp_loss()
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    mesh = make_mesh(dp=4, tp=2)
+    strategy = ParallelStrategy(data_parallel=True, tensor_parallel=True,
+                                tp_rules=[('w1', 1), ('w2', 0)])
+    prog = transpile(fluid.default_main_program(), mesh, strategy)
+    sh = prog.var_shardings
+    assert sh['x'][0] == 'dp'
+    assert sh['w1'] == ('tp',) or sh['w1'][1] == 'tp'
+    assert sh['w2'][0] == 'tp'
+    # Adam moments follow the param sharding
+    moment_names = [n for n in sh if 'w1' in n and 'moment' in n]
+    assert moment_names
+    for n in moment_names:
+        assert sh[n] == sh['w1']
+
+
+def test_dryrun_multichip_entrypoint():
+    import importlib
+    import __graft_entry__
+    importlib.reload(__graft_entry__)
+    __graft_entry__.dryrun_multichip(8)

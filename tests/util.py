@@ -1,0 +1,20 @@
+"""Shared helpers for the test suite."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def run_startup_and(feed, fetch_list, place=None):
+    exe = fluid.Executor(place or fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch_list)
+
+
+def rand(*shape, dtype='float32', seed=None, low=None, high=None):
+    rng = np.random.RandomState(seed if seed is not None else 0)
+    if dtype.startswith('int'):
+        return rng.randint(low or 0, high or 10, shape).astype(dtype)
+    return rng.uniform(low if low is not None else -1.0,
+                       high if high is not None else 1.0,
+                       shape).astype(dtype)
