@@ -1,0 +1,3 @@
+"""Inference runtime (reference: paddle/fluid/inference)."""
+
+from .predictor import Predictor, create_predictor  # noqa: F401

@@ -1,0 +1,46 @@
+"""AOT inference predictor (reference: paddle/fluid/inference/io.{h,cc} +
+the C++ predictor in inference/tests).
+
+The reference deserializes a ProgramDesc and interprets it per request;
+here the loaded inference program is compiled ONCE per input signature
+into an XLA executable with frozen (device-resident) weights, bf16
+optionally applied — repeated predict() calls are pure device dispatches.
+"""
+
+import numpy as np
+
+
+class Predictor(object):
+    def __init__(self, dirname, place=None, bf16=False,
+                 model_filename=None, params_filename=None):
+        import paddle_tpu as fluid
+        self._fluid = fluid
+        self.place = place if place is not None else fluid.TPUPlace(0)
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor(self.place)
+        with fluid.scope_guard(self.scope):
+            (self.program, self.feed_names,
+             self.fetch_targets) = fluid.io.load_inference_model(
+                dirname, self.exe, model_filename=model_filename,
+                params_filename=params_filename)
+        if bf16:
+            self.program.amp = 'bf16'
+        self._compiled = {}
+
+    def predict(self, feed):
+        """feed: dict name -> array. Returns list of numpy arrays."""
+        fluid = self._fluid
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError('predict: missing feeds %s' % missing)
+        with fluid.scope_guard(self.scope):
+            return self.exe.run(program=self.program, feed=feed,
+                                fetch_list=self.fetch_targets,
+                                scope=self.scope)
+
+    def __call__(self, feed):
+        return self.predict(feed)
+
+
+def create_predictor(dirname, **kwargs):
+    return Predictor(dirname, **kwargs)

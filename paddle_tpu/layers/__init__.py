@@ -10,6 +10,7 @@ from .manip import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .decode import *  # noqa: F401,F403
 
 from . import (io, tensor, ops, nn, sequence, manip, rnn,  # noqa
-               control_flow, detection)
+               control_flow, detection, decode)

@@ -539,12 +539,16 @@ def cos_sim(X, Y):
     return out
 
 
-def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
+              last_dim_only=False):
+    """last_dim_only=True sums over only the trailing axis (per-box loss
+    for [B, N, 4] detection targets) instead of all non-batch axes."""
     helper = LayerHelper('smooth_l1_loss')
     diff = helper.create_variable_for_type_inference(x.dtype)
     loss = helper.create_variable_for_type_inference(x.dtype)
     if x.shape is not None:
-        loss.shape = (x.shape[0], 1)
+        loss.shape = tuple(x.shape[:-1]) if last_dim_only \
+            else (x.shape[0], 1)
     inputs = {'X': [x], 'Y': [y]}
     if inside_weight is not None:
         inputs['InsideWeight'] = [inside_weight]
@@ -552,7 +556,8 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
         inputs['OutsideWeight'] = [outside_weight]
     helper.append_op(type='smooth_l1_loss', inputs=inputs,
                      outputs={'Diff': [diff], 'Out': [loss]},
-                     attrs={'sigma': sigma if sigma is not None else 1.0})
+                     attrs={'sigma': sigma if sigma is not None else 1.0,
+                            'last_dim_only': last_dim_only})
     return loss
 
 

@@ -1,0 +1,55 @@
+"""Native (C++) runtime pieces, bound via ctypes.
+
+Reference analog: paddle/fluid/pybind + the C++ data pipeline. pybind11
+is not available in this image, so the shared library exposes a plain C
+ABI and is compiled on first use with g++ (cached next to the sources).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build_lib():
+    src = os.path.join(_HERE, 'recordio.cpp')
+    out = os.path.join(_HERE, 'librecordio.so')
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-pthread',
+           src, '-o', out + '.tmp']
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(out + '.tmp', out)
+    return out
+
+
+def load_library():
+    """Compile (if needed) and load the native library; thread-safe."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        lib = ctypes.CDLL(_build_lib())
+        lib.recordio_writer_open.restype = ctypes.c_void_p
+        lib.recordio_writer_open.argtypes = [ctypes.c_char_p]
+        lib.recordio_writer_write.restype = ctypes.c_int
+        lib.recordio_writer_write.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint32]
+        lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.recordio_reader_open.restype = ctypes.c_void_p
+        lib.recordio_reader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64]
+        lib.recordio_reader_next.restype = ctypes.c_int64
+        lib.recordio_reader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.recordio_reader_error.restype = ctypes.c_char_p
+        lib.recordio_reader_error.argtypes = [ctypes.c_void_p]
+        lib.recordio_reader_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib

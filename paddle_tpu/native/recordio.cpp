@@ -1,0 +1,222 @@
+// Native data pipeline: recordio file format + shuffling prefetch reader.
+//
+// Reference analog: the reference's C++ DataProvider/recordio stack
+// (python/paddle/v2/reader + paddle/fluid recordio readers) feeds the
+// trainer from worker threads. Same role here: a background std::thread
+// decodes records into a bounded ring with reservoir-style shuffling so
+// the Python feed loop (and the TPU h2d stage behind it) never stalls on
+// disk I/O. Exposed through a plain C ABI for ctypes (no pybind11 in the
+// image — see paddle_tpu/native/__init__.py).
+//
+// File format (little-endian):
+//   magic "PTRC" u32 | then per record: u32 len | u32 crc32(payload) | bytes
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 recordio.cpp -o librecordio.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x43525450;  // "PTRC"
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f;
+};
+
+struct Record {
+  std::vector<uint8_t> data;
+};
+
+// Bounded ring with background producer; optional shuffle pool.
+struct Reader {
+  std::vector<std::string> paths;
+  size_t shuffle_buf;
+  uint64_t seed;
+  size_t capacity;
+
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<Record> ring;
+  bool done = false;
+  bool stop = false;
+  std::string error;
+
+  Record current;
+
+  void produce(Record&& r) {
+    std::unique_lock<std::mutex> lk(mu);
+    not_full.wait(lk, [&] { return ring.size() < capacity || stop; });
+    if (stop) return;
+    ring.push_back(std::move(r));
+    not_empty.notify_one();
+  }
+
+  void run() {
+    std::mt19937_64 rng(seed);
+    std::vector<Record> pool;  // reservoir for shuffling
+    for (const auto& path : paths) {
+      FILE* f = fopen(path.c_str(), "rb");
+      if (!f) {
+        std::lock_guard<std::mutex> lk(mu);
+        error = "recordio: cannot open " + path;
+        break;
+      }
+      uint32_t magic = 0;
+      if (fread(&magic, 4, 1, f) != 1 || magic != kMagic) {
+        fclose(f);
+        std::lock_guard<std::mutex> lk(mu);
+        error = "recordio: bad magic in " + path;
+        break;
+      }
+      for (;;) {
+        uint32_t hdr[2];
+        if (fread(hdr, 4, 2, f) != 2) break;  // EOF
+        Record r;
+        r.data.resize(hdr[0]);
+        if (fread(r.data.data(), 1, hdr[0], f) != hdr[0]) break;
+        if (crc32(r.data.data(), r.data.size()) != hdr[1]) {
+          std::lock_guard<std::mutex> lk(mu);
+          error = "recordio: crc mismatch in " + path;
+          fclose(f);
+          goto out;
+        }
+        if (shuffle_buf > 1) {
+          if (pool.size() < shuffle_buf) {
+            pool.push_back(std::move(r));
+          } else {
+            size_t j = rng() % pool.size();
+            std::swap(pool[j], r);
+            produce(std::move(r));
+          }
+        } else {
+          produce(std::move(r));
+        }
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          if (stop) { fclose(f); goto out; }
+        }
+      }
+      fclose(f);
+    }
+    // drain shuffle pool in random order
+    {
+      std::mt19937_64 rng2(seed ^ 0x9E3779B97F4A7C15ull);
+      for (size_t i = pool.size(); i > 1; i--)
+        std::swap(pool[i - 1], pool[rng2() % i]);
+    }
+    for (auto& r : pool) {
+      produce(std::move(r));
+      std::lock_guard<std::mutex> lk(mu);
+      if (stop) break;
+    }
+  out:
+    std::lock_guard<std::mutex> lk(mu);
+    done = true;
+    not_empty.notify_all();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* recordio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  uint32_t magic = kMagic;
+  fwrite(&magic, 4, 1, f);
+  return new Writer{f};
+}
+
+int recordio_writer_write(void* w, const uint8_t* data, uint32_t len) {
+  auto* writer = static_cast<Writer*>(w);
+  uint32_t hdr[2] = {len, crc32(data, len)};
+  if (fwrite(hdr, 4, 2, writer->f) != 2) return -1;
+  if (fwrite(data, 1, len, writer->f) != len) return -1;
+  return 0;
+}
+
+void recordio_writer_close(void* w) {
+  auto* writer = static_cast<Writer*>(w);
+  fclose(writer->f);
+  delete writer;
+}
+
+// paths: '\n'-joined file list. shuffle_buf<=1 disables shuffling.
+void* recordio_reader_open(const char* paths, uint64_t shuffle_buf,
+                           uint64_t seed, uint64_t prefetch_capacity) {
+  auto* r = new Reader();
+  const char* p = paths;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) { r->paths.emplace_back(p); break; }
+    r->paths.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  r->shuffle_buf = shuffle_buf;
+  r->seed = seed;
+  r->capacity = prefetch_capacity ? prefetch_capacity : 256;
+  r->worker = std::thread([r] { r->run(); });
+  return r;
+}
+
+// Returns length of next record (0 = end of data, -1 = error).
+// The record stays owned by the reader until the next call.
+int64_t recordio_reader_next(void* h, const uint8_t** out) {
+  auto* r = static_cast<Reader*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] { return !r->ring.empty() || r->done; });
+  if (!r->error.empty()) return -1;
+  if (r->ring.empty()) return 0;
+  r->current = std::move(r->ring.front());
+  r->ring.pop_front();
+  r->not_full.notify_one();
+  *out = r->current.data.data();
+  return static_cast<int64_t>(r->current.data.size());
+}
+
+const char* recordio_reader_error(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->error.c_str();
+}
+
+void recordio_reader_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+    r->not_full.notify_all();
+    r->not_empty.notify_all();
+  }
+  if (r->worker.joinable()) r->worker.join();
+  delete r;
+}
+
+}  // extern "C"

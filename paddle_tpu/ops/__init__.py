@@ -19,4 +19,5 @@ from . import misc_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import decode_ops  # noqa: F401
 from . import lr_ops  # noqa: F401

@@ -1,0 +1,268 @@
+"""Structured-prediction / decode ops: CTC, linear-chain CRF, beam search.
+
+Reference: paddle/fluid/operators/{warpctc_op,ctc_align_op,
+linear_chain_crf_op,crf_decoding_op,beam_search_op,
+beam_search_decode_op}.{cc,h}. The reference couples these to LoD tensors
+and (for warpctc) an external CUDA library; here every op is a log-domain
+`lax.scan` recursion over the padded time axis with per-example length
+masks — static shapes, fully jittable, differentiable where the reference
+is (CTC/CRF losses), so XLA fuses them into the surrounding step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _i64():
+    """Canonical device dtype for an int64-declared IR var (int32 under
+    the default x64-disabled mode — avoids per-trace truncation warnings,
+    matches core.dtypes.to_jnp_dtype)."""
+    from ..core.dtypes import to_jnp_dtype
+    return to_jnp_dtype('int64')
+
+_NEG = -1e30
+
+
+def _log_softmax(x):
+    return x - jax.scipy.special.logsumexp(x, axis=-1, keepdims=True)
+
+
+# --------------------------------------------------------------------- CTC
+def ctc_loss_single(log_probs, label, t_len, l_len, blank):
+    """CTC -log p(label|logits) for one example.
+    log_probs: [T, C]; label: [L] int; t_len, l_len: scalars."""
+    T, C = log_probs.shape
+    L = label.shape[0]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((S,), blank, dtype=label.dtype)
+    ext = ext.at[1::2].set(label)
+    pos = jnp.arange(S)
+    s_valid = pos < 2 * l_len + 1
+    # allowed skip (s-2 -> s): only onto a non-blank differing from ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, ext.dtype), ext[:-2]])
+    can_skip = (pos % 2 == 1) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(log_probs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(l_len > 0, log_probs[0, ext[1]],
+                                        _NEG))
+    alpha0 = jnp.where(s_valid, alpha0, _NEG)
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.array([_NEG]), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.array([_NEG, _NEG]), alpha[:-2]])
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + log_probs[t, ext]
+        new = jnp.where(s_valid, new, _NEG)
+        new = jnp.where(t < t_len, new, alpha)  # freeze past the true end
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha[jnp.maximum(2 * l_len, 0)]
+    end2 = jnp.where(l_len > 0, alpha[jnp.maximum(2 * l_len - 1, 0)], _NEG)
+    return -jnp.logaddexp(end1, end2)
+
+
+@register('warpctc')
+def _warpctc(ctx):
+    logits = ctx.input('Logits')        # [B, T, C]
+    label = ctx.input('Label')          # [B, L] int
+    blank = ctx.attr('blank', 0)
+    b, t, _c = logits.shape
+    t_len = ctx.input('LogitsLength').reshape(-1).astype(jnp.int32) if \
+        ctx.has_input('LogitsLength') else jnp.full((b,), t, jnp.int32)
+    l_len = ctx.input('LabelLength').reshape(-1).astype(jnp.int32) if \
+        ctx.has_input('LabelLength') else \
+        jnp.full((b,), label.shape[1], jnp.int32)
+    lp = _log_softmax(logits.astype(jnp.float32))
+    loss = jax.vmap(ctc_loss_single, in_axes=(0, 0, 0, 0, None))(
+        lp, label, t_len, l_len, blank)
+    if ctx.attr('norm_by_times', False):
+        loss = loss / jnp.maximum(t_len.astype(loss.dtype), 1.0)
+    ctx.set_output('Loss', loss.reshape(b, 1))
+
+
+@register('ctc_align')
+def _ctc_align(ctx):
+    """Greedy CTC decode: collapse repeats then drop blanks, left-packed
+    into a padded [B, T] output (pad = -1) + OutLength."""
+    ids = ctx.input('Input')            # [B, T] int (already argmaxed)
+    blank = ctx.attr('blank', 0)
+    b, t = ids.shape
+    t_len = ctx.input('Length').reshape(-1).astype(jnp.int32) if \
+        ctx.has_input('Length') else jnp.full((b,), t, jnp.int32)
+
+    def decode_one(row, n):
+        prev = jnp.concatenate([jnp.array([-1], row.dtype), row[:-1]])
+        keep = (row != blank) & (row != prev) & (jnp.arange(t) < n)
+        pos = jnp.cumsum(keep) - 1
+        out = jnp.full((t,), -1, row.dtype)
+        out = out.at[jnp.where(keep, pos, t)].set(row, mode='drop')
+        return out, keep.sum().astype(_i64())
+
+    out, out_len = jax.vmap(decode_one)(ids, t_len)
+    ctx.set_output('Output', out)
+    ctx.set_output('OutputLength', out_len.reshape(b, 1))
+
+
+# --------------------------------------------------------------------- CRF
+def _crf_forward_single(emission, transition, label, length):
+    """Negative log-likelihood of `label` under a linear-chain CRF.
+    emission: [T, C]; transition: [C+2, C] (row0 start, row1 stop,
+    rows 2+: from-tag i to-tag j) — the linear_chain_crf_op.cc layout."""
+    T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    alpha0 = start + emission[0]
+
+    def step(alpha, t):
+        scores = alpha[:, None] + trans + emission[t][None, :]
+        new = jax.scipy.special.logsumexp(scores, axis=0)
+        new = jnp.where(t < length, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    log_z = jax.scipy.special.logsumexp(alpha + stop)
+
+    # gold path score
+    t_idx = jnp.arange(T)
+    em_score = jnp.sum(jnp.where(t_idx < length,
+                                 emission[t_idx, label], 0.0))
+    prev_lab = label[:-1]
+    next_lab = label[1:]
+    tr_score = jnp.sum(jnp.where(t_idx[1:] < length,
+                                 trans[prev_lab, next_lab], 0.0))
+    last = label[jnp.maximum(length - 1, 0)]
+    path = start[label[0]] + em_score + tr_score + stop[last]
+    return log_z - path
+
+
+@register('linear_chain_crf')
+def _linear_chain_crf(ctx):
+    emission = ctx.input('Emission')    # [B, T, C]
+    transition = ctx.input('Transition')  # [C+2, C]
+    label = ctx.input('Label')          # [B, T] int
+    b, t, _c = emission.shape
+    if label.ndim == 3:
+        label = label.reshape(b, t)
+    length = ctx.input('Length').reshape(-1).astype(jnp.int32) if \
+        ctx.has_input('Length') else jnp.full((b,), t, jnp.int32)
+    nll = jax.vmap(_crf_forward_single, in_axes=(0, None, 0, 0))(
+        emission.astype(jnp.float32), transition.astype(jnp.float32),
+        label, length)
+    ctx.set_output('LogLikelihood', nll.reshape(b, 1))
+
+
+def _viterbi_single(emission, transition, length):
+    T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    delta0 = start + emission[0]
+
+    def step(delta, t):
+        scores = delta[:, None] + trans + emission[t][None, :]
+        best_prev = jnp.argmax(scores, axis=0)
+        new = jnp.max(scores, axis=0)
+        new = jnp.where(t < length, new, delta)
+        best_prev = jnp.where(t < length, best_prev,
+                              jnp.arange(C))  # identity past the end
+        return new, best_prev
+
+    delta, back = jax.lax.scan(step, delta0, jnp.arange(1, T))
+    last_tag = jnp.argmax(delta + stop)
+
+    def back_step(tag, bp):
+        return bp[tag], tag
+
+    # back[i] maps the tag at t=i+1 to the best tag at t=i, so the
+    # reverse scan emits tags 1..T-1 and its final carry is tag 0.
+    tag0, path_tail = jax.lax.scan(back_step, last_tag, back, reverse=True)
+    path = jnp.concatenate([tag0[None], path_tail])
+    return jnp.where(jnp.arange(T) < length, path, 0).astype(_i64())
+
+
+@register('crf_decoding')
+def _crf_decoding(ctx):
+    emission = ctx.input('Emission')
+    transition = ctx.input('Transition')
+    b, t, _c = emission.shape
+    length = ctx.input('Length').reshape(-1).astype(jnp.int32) if \
+        ctx.has_input('Length') else jnp.full((b,), t, jnp.int32)
+    path = jax.vmap(_viterbi_single, in_axes=(0, None, 0))(
+        emission.astype(jnp.float32), transition.astype(jnp.float32),
+        length)
+    if ctx.has_input('Label'):
+        label = ctx.input('Label')
+        if label.ndim == 3:
+            label = label.reshape(b, t)
+        # with Label: emit per-position correctness (crf_decoding_op.h)
+        ok = (path == label) & (jnp.arange(t)[None, :] < length[:, None])
+        ctx.set_output('ViterbiPath', ok.astype(_i64()))
+    else:
+        ctx.set_output('ViterbiPath', path)
+
+
+# -------------------------------------------------------------- beam search
+@register('beam_search')
+def _beam_search(ctx):
+    """One decode step: expand each live beam's top-K candidates and keep
+    the best `beam_size` per example. Static [B, beam] layout (the
+    reference walks LoD levels; beam_search_op.cc)."""
+    pre_ids = ctx.input('pre_ids')          # [B, beam] int
+    pre_scores = ctx.input('pre_scores')    # [B, beam] f32
+    ids = ctx.input('ids')                  # [B, beam, K] int candidates
+    scores = ctx.input('scores')            # [B, beam, K] f32 log-probs
+    beam_size = ctx.attr('beam_size')
+    end_id = ctx.attr('end_id')
+
+    b, beam, k = ids.shape
+    finished = pre_ids == end_id
+    # finished beams contribute exactly one candidate: end_id at their
+    # frozen score; live beams add candidate log-probs.
+    total = pre_scores[:, :, None] + jnp.where(finished[:, :, None],
+                                               0.0, scores)
+    cand_ids = jnp.where(finished[:, :, None], end_id, ids)
+    # suppress duplicate candidates of finished beams (keep slot 0)
+    dup_mask = finished[:, :, None] & (jnp.arange(k) > 0)[None, None, :]
+    total = jnp.where(dup_mask, _NEG, total)
+
+    flat_scores = total.reshape(b, beam * k)
+    flat_ids = cand_ids.reshape(b, beam * k)
+    top_scores, top_pos = jax.lax.top_k(flat_scores, beam_size)
+    sel_ids = jnp.take_along_axis(flat_ids, top_pos, axis=1)
+    parent = (top_pos // k).astype(_i64())
+    ctx.set_output('selected_ids', sel_ids.astype(_i64()))
+    ctx.set_output('selected_scores', top_scores)
+    ctx.set_output('parent_idx', parent)
+
+
+@register('beam_search_decode')
+def _beam_search_decode(ctx):
+    """Backtrack stacked per-step (ids, parents) into full sequences.
+    StepIds/StepParents: [T, B, beam]; outputs SentenceIds [B, beam, T]
+    (end_id-padded) and SentenceScores passthrough of the final scores."""
+    step_ids = ctx.input('StepIds')
+    step_parents = ctx.input('StepParents')
+    end_id = ctx.attr('end_id')
+    t, b, beam = step_ids.shape
+
+    def back(carry, xs):
+        beam_idx = carry                      # [B, beam] current slot
+        ids_t, par_t = xs                     # [T-step] slices
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return nxt.astype(beam_idx.dtype), tok
+
+    init = jnp.tile(jnp.arange(beam)[None, :], (b, 1))
+    _, toks = jax.lax.scan(back, init, (step_ids, step_parents),
+                           reverse=True)
+    seq = jnp.moveaxis(toks, 0, -1)          # [B, beam, T]
+    # everything after the first end_id becomes end_id
+    seen_end = jnp.cumsum((seq == end_id).astype(jnp.int32), axis=-1)
+    seq = jnp.where((seen_end >= 1) & (seq != end_id), end_id, seq)
+    ctx.set_output('SentenceIds', seq.astype(_i64()))
+    if ctx.has_input('FinalScores'):
+        ctx.set_output('SentenceScores', ctx.input('FinalScores'))

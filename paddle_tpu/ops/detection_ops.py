@@ -7,10 +7,15 @@ import numpy as np
 from ..core.registry import register
 
 
-@register('iou_similarity')
-def _iou_similarity(ctx):
-    x = ctx.input('X')  # [n, 4] xmin ymin xmax ymax
-    y = ctx.input('Y')  # [m, 4]
+def _i64():
+    """Canonical device dtype for an int64-declared IR var (int32 under
+    the default x64-disabled mode — avoids per-trace truncation warnings,
+    matches core.dtypes.to_jnp_dtype)."""
+    from ..core.dtypes import to_jnp_dtype
+    return to_jnp_dtype('int64')
+
+
+def _iou_matrix(x, y):
     area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
     area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
     lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
@@ -18,13 +23,26 @@ def _iou_similarity(ctx):
     wh = jnp.maximum(rb - lt, 0.0)
     inter = wh[..., 0] * wh[..., 1]
     union = area_x[:, None] + area_y[None, :] - inter
-    ctx.set_output('Out', inter / jnp.maximum(union, 1e-10))
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register('iou_similarity')
+def _iou_similarity(ctx):
+    import jax
+    x = ctx.input('X')  # [n, 4] or batched [b, n, 4], corners
+    y = ctx.input('Y')  # [m, 4]
+    if x.ndim == 3:
+        ctx.set_output('Out', jax.vmap(_iou_matrix, in_axes=(0, None))(x, y))
+    else:
+        ctx.set_output('Out', _iou_matrix(x, y))
 
 
 @register('box_coder')
 def _box_coder(ctx):
     prior = ctx.input('PriorBox')        # [m, 4]
-    prior_var = ctx.input('PriorBoxVar')  # [m, 4]
+    prior_var = ctx.input('PriorBoxVar') if ctx.has_input('PriorBoxVar') \
+        else jnp.tile(jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32),
+                      (prior.shape[0], 1))
     target = ctx.input('TargetBox')
     code_type = ctx.attr('code_type', 'encode_center_size')
     pw = prior[:, 2] - prior[:, 0]
@@ -41,6 +59,19 @@ def _box_coder(ctx):
             (tcy[:, None] - pcy[None, :]) / ph[None, :] / prior_var[:, 1],
             jnp.log(tw[:, None] / pw[None, :]) / prior_var[:, 2],
             jnp.log(th[:, None] / ph[None, :]) / prior_var[:, 3],
+        ], axis=-1)
+    elif code_type == 'encode_aligned':
+        # target [..., N, 4] already aligned one-to-one with the N priors
+        # (ssd_loss loc targets); encode each against its own prior.
+        tw = jnp.maximum(target[..., 2] - target[..., 0], 1e-6)
+        th = jnp.maximum(target[..., 3] - target[..., 1], 1e-6)
+        tcx = target[..., 0] + 0.5 * tw
+        tcy = target[..., 1] + 0.5 * th
+        out = jnp.stack([
+            (tcx - pcx) / pw / prior_var[:, 0],
+            (tcy - pcy) / ph / prior_var[:, 1],
+            jnp.log(tw / pw) / prior_var[:, 2],
+            jnp.log(th / ph) / prior_var[:, 3],
         ], axis=-1)
     else:  # decode_center_size
         t = target  # [n, m, 4] or [m, 4]
@@ -96,3 +127,154 @@ def _prior_box(ctx):
                   (fh, fw, num_priors, 1))
     ctx.set_output('Boxes', jnp.asarray(out, dtype=jnp.float32))
     ctx.set_output('Variances', jnp.asarray(var, dtype=jnp.float32))
+
+
+@register('bipartite_match')
+def _bipartite_match(ctx):
+    """Greedy bipartite matching (bipartite_match_op.cc). DistMat:
+    [B, M_gt, N_prior] similarity (padded gt rows must be all-zero).
+    Outputs per prior: ColToRowMatchIndices [B, N] (gt idx or -1) and
+    ColToRowMatchDist [B, N]."""
+    import jax
+    dist = ctx.input('DistMat')
+    match_type = ctx.attr('match_type', 'bipartite')
+    overlap_threshold = ctx.attr('dist_threshold', 0.5)
+    b, m, n = dist.shape
+
+    def match_one(d):
+        def body(i, carry):
+            remaining, row_idx, row_dist = carry
+            flat = jnp.argmax(remaining)
+            r, c = flat // n, flat % n
+            best = remaining[r, c]
+            do = best > 0.0
+            row_idx = jnp.where(do, row_idx.at[c].set(r), row_idx)
+            row_dist = jnp.where(do, row_dist.at[c].set(best), row_dist)
+            remaining = jnp.where(
+                do,
+                remaining.at[r, :].set(-1.0).at[:, c].set(-1.0),
+                remaining)
+            return remaining, row_idx, row_dist
+
+        init = (d, jnp.full((n,), -1, _i64()), jnp.zeros((n,)))
+        _, row_idx, row_dist = jax.lax.fori_loop(0, m, body, init)
+        return row_idx, row_dist
+
+    idx, dval = jax.vmap(match_one)(dist.astype(jnp.float32))
+
+    if match_type == 'per_prediction':
+        # unmatched priors take their argmax gt when overlap clears the bar
+        best_gt = jnp.argmax(dist, axis=1)                     # [B, N]
+        best_val = jnp.max(dist, axis=1)
+        extra = (idx < 0) & (best_val > overlap_threshold)
+        idx = jnp.where(extra, best_gt.astype(_i64()), idx)
+        dval = jnp.where(extra, best_val, dval)
+    ctx.set_output('ColToRowMatchIndices', idx)
+    ctx.set_output('ColToRowMatchDist', dval.astype(jnp.float32))
+
+
+@register('target_assign')
+def _target_assign(ctx):
+    """Gather per-prior targets by match indices (target_assign_op.cc).
+    X: [B, M, K] per-gt values; MatchIndices: [B, N]. Out: [B, N, K];
+    OutWeight: [B, N, 1] — 1 where matched (or mismatch_value filled)."""
+    x = ctx.input('X')
+    match = ctx.input('MatchIndices')
+    mismatch_value = ctx.attr('mismatch_value', 0)
+    b, m, k = x.shape
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch_value, x.dtype))
+    ctx.set_output('Out', out)
+    ctx.set_output('OutWeight',
+                   matched.astype(jnp.float32))
+
+
+@register('mine_hard_examples')
+def _mine_hard_examples(ctx):
+    """Hard-negative mining (mine_hard_examples_op.cc, max_negative mode).
+    ClsLoss: [B, N]; MatchIndices: [B, N]. Emits UpdatedMatchIndices where
+    kept hard negatives stay -1 and ignored negatives become -2."""
+    cls_loss = ctx.input('ClsLoss')
+    match = ctx.input('MatchIndices')
+    neg_pos_ratio = ctx.attr('neg_pos_ratio', 3.0)
+    b, n = cls_loss.shape
+    is_pos = match >= 0
+    num_pos = is_pos.sum(axis=1)                              # [B]
+    num_neg = jnp.minimum((num_pos * neg_pos_ratio).astype(jnp.int32),
+                          n - num_pos.astype(jnp.int32))
+    neg_loss = jnp.where(is_pos, -jnp.inf, cls_loss)          # rank negs
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)                         # rank per prior
+    keep_neg = (~is_pos) & (rank < num_neg[:, None])
+    updated = jnp.where(is_pos, match,
+                        jnp.where(keep_neg, -1, -2)).astype(_i64())
+    ctx.set_output('UpdatedMatchIndices', updated)
+    ctx.set_output('NegIndicesMask', keep_neg.astype(_i64()))
+
+
+@register('multiclass_nms')
+def _multiclass_nms(ctx):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc). BBoxes:
+    [B, N, 4]; Scores: [B, C, N]. Out: [B, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), padded with label -1."""
+    import jax
+    boxes = ctx.input('BBoxes')
+    scores = ctx.input('Scores')
+    score_threshold = ctx.attr('score_threshold', 0.0)
+    nms_threshold = ctx.attr('nms_threshold', 0.3)
+    nms_top_k = ctx.attr('nms_top_k', 64)
+    keep_top_k = ctx.attr('keep_top_k', 16)
+    background_label = ctx.attr('background_label', 0)
+    b, c, n = scores.shape
+    k = min(nms_top_k, n)
+
+    def iou(bb):
+        area = jnp.maximum(bb[:, 2] - bb[:, 0], 0) * \
+            jnp.maximum(bb[:, 3] - bb[:, 1], 0)
+        lt = jnp.maximum(bb[:, None, :2], bb[None, :, :2])
+        rb = jnp.minimum(bb[:, None, 2:], bb[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                   1e-10)
+
+    def nms_class(cls_scores, bb):
+        top_s, top_i = jax.lax.top_k(cls_scores, k)
+        top_b = bb[top_i]
+        mat = iou(top_b)
+
+        def body(i, keep):
+            alive = keep[i] & (top_s[i] > score_threshold)
+            sup = (mat[i] > nms_threshold) & (jnp.arange(k) > i)
+            return jnp.where(alive, keep & ~sup, keep)
+
+        keep = jax.lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+        keep = keep & (top_s > score_threshold)
+        return jnp.where(keep, top_s, -1.0), top_b
+
+    def per_example(bb, sc):
+        cls_scores, cls_boxes = jax.vmap(nms_class, in_axes=(0, None))(
+            sc, bb)                                  # [C, k], [C, k, 4]
+        labels = jnp.tile(jnp.arange(c)[:, None], (1, k))
+        flat_s = cls_scores.reshape(-1)
+        flat_s = jnp.where(labels.reshape(-1) == background_label, -1.0,
+                           flat_s)
+        flat_b = cls_boxes.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        kk = min(keep_top_k, flat_s.shape[0])
+        top_s, top_i = jax.lax.top_k(flat_s, kk)
+        sel_b = flat_b[top_i]
+        sel_l = jnp.where(top_s > 0, flat_l[top_i], -1)
+        return jnp.concatenate(
+            [sel_l[:, None].astype(bb.dtype), top_s[:, None], sel_b],
+            axis=-1)
+
+    ctx.set_output('Out', jax.vmap(per_example)(boxes, scores))
+
+
+@register('match_pos_mask')
+def _match_pos_mask(ctx):
+    match = ctx.input('MatchIndices')
+    ctx.set_output('Out', (match >= 0).astype(jnp.float32))

@@ -97,9 +97,13 @@ def _smooth_l1(ctx):
     if ctx.has_input('OutsideWeight'):
         loss = loss * ctx.input('OutsideWeight')
     ctx.set_output('Diff', diff)
-    ctx.set_output('Out', jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
-                                  keepdims=False)[..., None]
-                   if loss.ndim > 1 else loss)
+    if ctx.attr('last_dim_only', False):
+        ctx.set_output('Out', jnp.sum(loss, axis=-1))
+    else:
+        ctx.set_output('Out', jnp.sum(loss,
+                                      axis=tuple(range(1, loss.ndim)),
+                                      keepdims=False)[..., None]
+                       if loss.ndim > 1 else loss)
 
 
 @register('dropout')

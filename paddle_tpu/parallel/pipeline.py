@@ -1,0 +1,88 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the 'pp'
+mesh axis.
+
+Reference analog: the pserver-era reference has no pipeline engine; this
+is the TPU-native design the transpiler targets (SURVEY.md §2.4): stage
+parameters are stacked on a leading stage dim sharded over 'pp', every
+device runs the SAME stage_fn (SPMD), and activations hop stage→stage via
+`ppermute` while microbatches stream in — the classic bubble schedule
+(n_micro + n_stages - 1 ticks). Differentiable end-to-end: ppermute's
+transpose is the reverse permute, so jax.grad recovers the usual
+backward pipeline.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline(stage_fn, stage_params, microbatches, axis_name='pp'):
+    """Run inside shard_map over `axis_name`.
+
+    stage_fn(params, x) -> y           one pipeline stage (same shape in/out)
+    stage_params: pytree whose leaves are this device's stage params
+                  (leading stage dim already stripped by shard_map)
+    microbatches: [n_micro, mb, ...]   replicated input microbatches
+    Returns [n_micro, mb, ...] final-stage outputs (valid on the LAST
+    stage; other stages hold garbage — combine with out_specs that index
+    the last shard, or psum-mask as convenient).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    total = n_micro + n_stages - 1
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(buf, t):
+        # stage 0 ingests microbatch t (clamped; masked later)
+        mb = microbatches[jnp.clip(t, 0, n_micro - 1)]
+        x = jnp.where(stage == 0, mb, buf)
+        y = stage_fn(stage_params, x)
+        nxt = jax.lax.ppermute(y, axis_name, fwd_perm)
+        return nxt, y
+
+    # mark the carry varying over pp (ppermute outputs are varying; an
+    # unvarying init would make the scan carry types mismatch)
+    buf0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
+    _, ys = jax.lax.scan(tick, buf0, jnp.arange(total))
+    # last stage emits microbatch m at tick m + n_stages - 1
+    out = jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, n_micro, axis=0)
+    return out
+
+
+def pipelined_apply(stage_fn, stacked_params, x, n_micro, mesh,
+                    axis_name='pp'):
+    """Host-level convenience: shard_map-wrap `pipeline` over `mesh`.
+
+    stacked_params: pytree with leading dim n_stages (will shard on pp).
+    x: [batch, ...] global input; split into n_micro microbatches.
+    Returns [batch, ...] output of the whole stage stack.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    assert batch % n_micro == 0, 'batch must divide into microbatches'
+    mb_x = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda _: P(*((axis_name,) + (None,) * (_.ndim - 1))),
+        stacked_params)
+    mb_axes = (None,) * (mb_x.ndim)
+
+    def inner(params, mb):
+        # shard_map keeps the sharded stage dim as size 1 — strip it
+        params = jax.tree.map(lambda p: p[0], params)
+        out = pipeline(stage_fn, params, mb, axis_name)
+        # emit only the last stage's result; zeros elsewhere so a psum
+        # over pp reconstructs the true output on every device.
+        is_last = jax.lax.axis_index(axis_name) == \
+            jax.lax.axis_size(axis_name) - 1
+        out = jnp.where(is_last, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis_name)
+
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(param_specs, P(*mb_axes)),
+        out_specs=P(*mb_axes), check_vma=False)
+    out = mapped(jax.tree.map(jnp.asarray, stacked_params), mb_x)
+    return out.reshape((batch,) + out.shape[2:])

@@ -6,6 +6,7 @@ from queue import Queue
 from threading import Thread
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'prefetch_to_device',
            'firstn', 'xmap_readers', 'cache', 'batch']
 
 
@@ -173,3 +174,47 @@ def batch(reader, batch_size, drop_last=True):
         if b and not drop_last:
             yield b
     return batch_reader
+
+
+def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
+    """Overlap host->HBM transfer with compute: device_put the next
+    batch(es) while the current one trains (the flax prefetch pattern —
+    the TPU analog of the reference's pinned-memory double buffering).
+
+    reader yields dicts (or tuples zipped with feed_names); yields dicts
+    of device arrays. `place` (a paddle place or jax device) selects the
+    target device; default is jax's default device.
+    """
+    import jax
+
+    device = None
+    if place is not None:
+        if hasattr(place, 'device_id'):  # a paddle_tpu Place
+            device = jax.devices()[place.device_id]
+        else:
+            device = place
+
+    def device_reader():
+        import collections
+        queue = collections.deque()
+
+        def put(item):
+            if feed_names is not None and not isinstance(item, dict):
+                item = dict(zip(feed_names, item))
+            queue.append({k: jax.device_put(v, device)
+                          for k, v in item.items()})
+
+        it = iter(reader())
+        try:
+            for _ in range(buffer_size):
+                put(next(it))
+        except StopIteration:
+            pass
+        for item in it:
+            out = queue.popleft()
+            put(item)  # transfer of the NEXT batch is now in flight
+            yield out
+        while queue:
+            yield queue.popleft()
+
+    return device_reader

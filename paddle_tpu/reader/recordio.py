@@ -1,0 +1,78 @@
+"""Python interface to the native recordio pipeline.
+
+Reference: the reference trains from recordio files through C++
+DataProviders with background decode threads; same architecture here
+(paddle_tpu/native/recordio.cpp) with a reader()-decorator-compatible
+surface: records are pickled Python items, decode/shuffle/prefetch run
+off the main thread in C++.
+"""
+
+import ctypes
+import pickle
+
+from ..native import load_library
+
+__all__ = ['RecordIOWriter', 'write_recordio', 'recordio_reader']
+
+
+class RecordIOWriter(object):
+    def __init__(self, path):
+        self._lib = load_library()
+        self._h = self._lib.recordio_writer_open(path.encode())
+        if not self._h:
+            raise IOError('cannot open %s for writing' % path)
+
+    def write(self, obj):
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        if self._lib.recordio_writer_write(self._h, buf, len(data)) != 0:
+            raise IOError('recordio write failed')
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_recordio(path, items):
+    """Serialize an iterable of picklable items to a recordio file."""
+    with RecordIOWriter(path) as w:
+        n = 0
+        for item in items:
+            w.write(item)
+            n += 1
+    return n
+
+
+def recordio_reader(paths, shuffle_buf=0, seed=0, prefetch=256):
+    """Returns a v2-style reader() generator factory over recordio files.
+    Decode + shuffle + prefetch happen in the native worker thread."""
+    if isinstance(paths, str):
+        paths = [paths]
+    joined = '\n'.join(paths).encode()
+
+    def reader():
+        lib = load_library()
+        h = lib.recordio_reader_open(joined, shuffle_buf, seed, prefetch)
+        if not h:
+            raise IOError('cannot open recordio reader')
+        try:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                n = lib.recordio_reader_next(h, ctypes.byref(out))
+                if n == 0:
+                    break
+                if n < 0:
+                    raise IOError(lib.recordio_reader_error(h).decode())
+                data = ctypes.string_at(out, n)
+                yield pickle.loads(data)
+        finally:
+            lib.recordio_reader_close(h)
+
+    return reader

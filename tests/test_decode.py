@@ -1,0 +1,253 @@
+"""CTC / CRF / beam search vs brute-force numpy references (reference:
+fluid/tests/unittests/test_warpctc_op.py, test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_beam_search_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+# ---------------------------------------------------------------- references
+def ctc_loss_brute(log_probs, label, blank=0):
+    """Sum over all alignments (exponential — only for tiny T)."""
+    T, C = log_probs.shape
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse path
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        if out == list(label):
+            lp = sum(log_probs[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def crf_nll_brute(emission, transition, label):
+    """Enumerate all tag paths."""
+    T, C = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    def score(path):
+        s = start[path[0]] + emission[0, path[0]] + stop[path[-1]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        return s
+
+    logz = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        logz = np.logaddexp(logz, score(path))
+    return logz - score(label)
+
+
+def viterbi_brute(emission, transition):
+    T, C = emission.shape
+    best, best_path = -np.inf, None
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for path in itertools.product(range(C), repeat=T):
+        s = start[path[0]] + emission[0, path[0]] + stop[path[-1]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        if s > best:
+            best, best_path = s, path
+    return list(best_path)
+
+
+# --------------------------------------------------------------------- tests
+def test_warpctc_matches_bruteforce():
+    T, C, L = 4, 3, 2
+    rng = np.random.RandomState(0)
+    logits_np = rng.randn(2, T, C).astype('float32')
+    labels_np = np.array([[1, 2], [2, 1]], dtype='int64')
+
+    logits = fluid.layers.data(name='logits', shape=[T, C], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[L], dtype='int64')
+    loss = fluid.layers.warpctc(input=logits, label=label, blank=0)
+    got = run_startup_and({'logits': logits_np, 'label': labels_np},
+                          [loss])[0]
+    lp = logits_np - np.log(np.exp(logits_np).sum(-1, keepdims=True))
+    for b in range(2):
+        expect = ctc_loss_brute(lp[b], labels_np[b])
+        np.testing.assert_allclose(got[b, 0], expect, rtol=1e-4)
+
+
+def test_warpctc_variable_lengths_and_grad():
+    T, C, L = 6, 4, 3
+    rng = np.random.RandomState(1)
+    logits_np = rng.randn(2, T, C).astype('float32')
+    labels_np = np.array([[1, 2, 3], [2, 1, 0]], dtype='int64')
+    tl = np.array([6, 4], dtype='int64')
+    ll = np.array([3, 2], dtype='int64')
+
+    logits = fluid.layers.data(name='logits', shape=[T, C], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[L], dtype='int64')
+    tlen = fluid.layers.data(name='tlen', shape=[], dtype='int64')
+    llen = fluid.layers.data(name='llen', shape=[], dtype='int64')
+    loss = fluid.layers.warpctc(input=logits, label=label, blank=0,
+                                input_length=tlen, label_length=llen)
+    mean = fluid.layers.mean(loss)
+    got = run_startup_and({'logits': logits_np, 'label': labels_np,
+                           'tlen': tl, 'llen': ll}, [loss, mean])
+    lp = logits_np - np.log(np.exp(logits_np).sum(-1, keepdims=True))
+    # example 1 truncated to T=4, L=2
+    expect0 = ctc_loss_brute(lp[0], labels_np[0])
+    expect1 = ctc_loss_brute(lp[1, :4], labels_np[1, :2])
+    np.testing.assert_allclose(got[0][0, 0], expect0, rtol=1e-4)
+    np.testing.assert_allclose(got[0][1, 0], expect1, rtol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    # probs argmax sequence: [blank a a blank b b] -> [a b]
+    C = 3
+    seq = np.array([0, 1, 1, 0, 2, 2])
+    probs_np = np.eye(C, dtype='float32')[seq][None]  # [1, 6, 3]
+    probs = fluid.layers.data(name='p', shape=[6, C], dtype='float32')
+    out, out_len = fluid.layers.ctc_greedy_decoder(probs, blank=0)
+    got, got_len = run_startup_and({'p': probs_np}, [out, out_len])
+    assert got_len[0, 0] == 2
+    np.testing.assert_array_equal(got[0, :2], [1, 2])
+    assert (got[0, 2:] == -1).all()
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    T, C = 3, 3
+    rng = np.random.RandomState(2)
+    em_np = rng.randn(2, T, C).astype('float32')
+    trans_np = rng.randn(C + 2, C).astype('float32')
+    label_np = np.array([[0, 1, 2], [2, 2, 0]], dtype='int64')
+
+    em = fluid.layers.data(name='em', shape=[T, C], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[T], dtype='int64')
+    nll = fluid.layers.linear_chain_crf(
+        input=em, label=label,
+        param_attr=fluid.ParamAttr(
+            name='crf_w',
+            initializer=fluid.initializer.NumpyArrayInitializer(trans_np)))
+    got = run_startup_and({'em': em_np, 'label': label_np}, [nll])[0]
+    for b in range(2):
+        expect = crf_nll_brute(em_np[b].astype('float64'),
+                               trans_np.astype('float64'), label_np[b])
+        np.testing.assert_allclose(got[b, 0], expect, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    T, C = 4, 3
+    rng = np.random.RandomState(3)
+    em_np = rng.randn(2, T, C).astype('float32')
+    trans_np = rng.randn(C + 2, C).astype('float32')
+
+    em = fluid.layers.data(name='em', shape=[T, C], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[T], dtype='int64')
+    attr = fluid.ParamAttr(
+        name='crf_w2',
+        initializer=fluid.initializer.NumpyArrayInitializer(trans_np))
+    nll = fluid.layers.linear_chain_crf(input=em, label=label,
+                                        param_attr=attr)
+    path = fluid.layers.crf_decoding(input=em, param_attr=attr)
+    label_np = np.zeros((2, T), dtype='int64')
+    got = run_startup_and({'em': em_np, 'label': label_np}, [path, nll])[0]
+    for b in range(2):
+        expect = viterbi_brute(em_np[b].astype('float64'),
+                               trans_np.astype('float64'))
+        np.testing.assert_array_equal(got[b], expect)
+
+
+def test_crf_trains():
+    """CRF as a loss: nll decreases when transitions+emissions learn."""
+    T, C = 5, 4
+    words = fluid.layers.data(name='w', shape=[T], dtype='int64')
+    label = fluid.layers.data(name='y', shape=[T], dtype='int64')
+    emb = fluid.layers.embedding(input=words, size=[20, 8])
+    em = fluid.layers.fc(input=emb, size=C, num_flatten_dims=2)
+    nll = fluid.layers.linear_chain_crf(
+        input=em, label=label, param_attr=fluid.ParamAttr(name='crf_w3'))
+    loss = fluid.layers.mean(nll)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(4)
+    ws = rng.randint(0, 20, (8, T)).astype('int64')
+    ys = (ws % C).astype('int64')
+    losses = [float(np.asarray(exe.run(feed={'w': ws, 'y': ys},
+                                       fetch_list=[loss])[0]))
+              for _ in range(15)]
+    assert losses[-1] < losses[0]
+
+
+def test_beam_search_step():
+    B, beam, K = 1, 2, 3
+    pre_ids_np = np.array([[3, 5]], dtype='int64')  # no end yet
+    pre_scores_np = np.array([[-1.0, -2.0]], dtype='float32')
+    ids_np = np.array([[[10, 11, 12], [20, 21, 22]]], dtype='int64')
+    scores_np = np.log(np.array(
+        [[[0.6, 0.3, 0.1], [0.7, 0.2, 0.1]]], dtype='float32'))
+
+    pre_ids = fluid.layers.data(name='pi', shape=[beam], dtype='int64')
+    pre_scores = fluid.layers.data(name='ps', shape=[beam],
+                                   dtype='float32')
+    ids = fluid.layers.data(name='ids', shape=[beam, K], dtype='int64')
+    scores = fluid.layers.data(name='sc', shape=[beam, K], dtype='float32')
+    sel_ids, sel_scores, parent = fluid.layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=beam, end_id=0)
+    got_ids, got_scores, got_parent = run_startup_and(
+        {'pi': pre_ids_np, 'ps': pre_scores_np, 'ids': ids_np,
+         'sc': scores_np}, [sel_ids, sel_scores, parent])
+    # candidates: beam0: -1+log .6/.3/.1 ; beam1: -2+log .7/.2/.1
+    all_scores = np.concatenate(
+        [pre_scores_np[0, 0] + scores_np[0, 0],
+         pre_scores_np[0, 1] + scores_np[0, 1]])
+    order = np.argsort(-all_scores)[:beam]
+    np.testing.assert_allclose(got_scores[0], all_scores[order], rtol=1e-6)
+    np.testing.assert_array_equal(got_parent[0], order // K)
+    np.testing.assert_array_equal(
+        got_ids[0], np.array([10, 11, 12, 20, 21, 22])[order])
+
+
+def test_beam_search_finished_beam_frozen():
+    pre_ids_np = np.array([[0, 5]], dtype='int64')  # beam 0 hit end_id=0
+    pre_scores_np = np.array([[-0.5, -3.0]], dtype='float32')
+    ids_np = np.array([[[10, 11], [20, 21]]], dtype='int64')
+    scores_np = np.full((1, 2, 2), -0.1, dtype='float32')
+
+    pre_ids = fluid.layers.data(name='pi', shape=[2], dtype='int64')
+    pre_scores = fluid.layers.data(name='ps', shape=[2], dtype='float32')
+    ids = fluid.layers.data(name='ids', shape=[2, 2], dtype='int64')
+    scores = fluid.layers.data(name='sc', shape=[2, 2], dtype='float32')
+    sel_ids, sel_scores, parent = fluid.layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0)
+    got_ids, got_scores, got_parent = run_startup_and(
+        {'pi': pre_ids_np, 'ps': pre_scores_np, 'ids': ids_np,
+         'sc': scores_np}, [sel_ids, sel_scores, parent])
+    # finished beam keeps score -0.5 and emits end_id exactly once
+    assert got_scores[0, 0] == pytest.approx(-0.5)
+    assert got_ids[0, 0] == 0
+    assert (got_ids[0] == 0).sum() == 1
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, B=1, beam=2; parents chain: step2 beam0 <- step1 beam1 <- step0 b0
+    step_ids_np = np.array(
+        [[[1, 2]], [[3, 4]], [[5, 6]]], dtype='int64')  # [T,B,beam]... wait
+    step_ids_np = np.transpose(step_ids_np, (0, 1, 2))
+    step_parents_np = np.array(
+        [[[0, 1]], [[1, 0]], [[1, 0]]], dtype='int64')
+    step_ids = fluid.layers.data(name='si', shape=[1, 2], dtype='int64')
+    step_ids.shape = (3, 1, 2)
+    step_parents = fluid.layers.data(name='sp', shape=[1, 2], dtype='int64')
+    step_parents.shape = (3, 1, 2)
+    sent, _ = fluid.layers.beam_search_decode(step_ids, step_parents,
+                                              end_id=0)
+    got = run_startup_and({'si': step_ids_np, 'sp': step_parents_np},
+                          [sent])[0]
+    # final slot 0: token 5 at t2, parent=1 -> t1 token 4 (slot1),
+    # its parent=0 -> t0 token 1
+    np.testing.assert_array_equal(got[0, 0], [1, 4, 5])
+    # final slot 1: token 6, parent 0 -> t1 token 3, parent 1 -> t0 token 2
+    np.testing.assert_array_equal(got[0, 1], [2, 3, 6])

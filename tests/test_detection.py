@@ -1,0 +1,187 @@
+"""Detection suite: matching, target assign, hard mining, NMS, SSD loss
+(reference: fluid/tests/unittests/test_bipartite_match_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_multiclass_nms_op.py, test_ssd_loss...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+def test_iou_similarity_batched():
+    gt = np.array([[[0., 0., 2., 2.], [1., 1., 3., 3.]]], dtype='float32')
+    pr = np.array([[0., 0., 2., 2.], [2., 2., 4., 4.]], dtype='float32')
+    x = fluid.layers.data(name='x', shape=[2, 4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[4], dtype='float32')
+    y.shape = (2, 4)
+    out = fluid.layers.iou_similarity(x, y)
+    got = run_startup_and({'x': gt, 'y': pr}, [out])[0]
+    np.testing.assert_allclose(got[0, 0], [1.0, 0.0], atol=1e-6)
+    # gt[1] vs pr[0]: inter 1, union 7; vs pr[1]: inter 1, union 7
+    np.testing.assert_allclose(got[0, 1], [1 / 7, 1 / 7], rtol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # 2 gt x 3 priors; global best 0.9 at (0,1); then (1,2)=0.6
+    dist_np = np.array([[[0.5, 0.9, 0.3],
+                         [0.4, 0.8, 0.6]]], dtype='float32')
+    d = fluid.layers.data(name='d', shape=[2, 3], dtype='float32')
+    idx, dval = fluid.layers.bipartite_match(d)
+    gi, gd = run_startup_and({'d': dist_np}, [idx, dval])
+    np.testing.assert_array_equal(gi[0], [-1, 0, 1])
+    np.testing.assert_allclose(gd[0], [0.0, 0.9, 0.6], rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist_np = np.array([[[0.5, 0.9, 0.3],
+                         [0.4, 0.8, 0.6]]], dtype='float32')
+    d = fluid.layers.data(name='d', shape=[2, 3], dtype='float32')
+    idx, _ = fluid.layers.bipartite_match(d, match_type='per_prediction',
+                                          dist_threshold=0.45)
+    gi, = run_startup_and({'d': dist_np}, [idx])
+    # prior 0 unmatched by bipartite; best gt is 0 (0.5 > 0.45)
+    np.testing.assert_array_equal(gi[0], [0, 0, 1])
+
+
+def test_target_assign():
+    x_np = np.arange(12, dtype='float32').reshape(1, 3, 4)  # 3 gts
+    match_np = np.array([[1, -1, 0, 2]], dtype='int64')
+    x = fluid.layers.data(name='x', shape=[3, 4], dtype='float32')
+    m = fluid.layers.data(name='m', shape=[4], dtype='int64')
+    out, w = fluid.layers.target_assign(x, m, mismatch_value=0)
+    go, gw = run_startup_and({'x': x_np, 'm': match_np}, [out, w])
+    np.testing.assert_allclose(go[0, 0], x_np[0, 1])
+    np.testing.assert_allclose(go[0, 1], np.zeros(4))
+    np.testing.assert_allclose(go[0, 2], x_np[0, 0])
+    np.testing.assert_allclose(gw[0].ravel(), [1, 0, 1, 1])
+
+
+def test_mine_hard_examples():
+    # 1 positive, 4 negatives, ratio 2 -> keep top-2 loss negatives
+    loss_np = np.array([[0.1, 0.9, 0.3, 0.7, 0.5]], dtype='float32')
+    match_np = np.array([[0, -1, -1, -1, -1]], dtype='int64')
+    lo = fluid.layers.data(name='l', shape=[5], dtype='float32')
+    m = fluid.layers.data(name='m', shape=[5], dtype='int64')
+    upd, neg = fluid.layers.mine_hard_examples(lo, m, neg_pos_ratio=2.0)
+    gu, gn = run_startup_and({'l': loss_np, 'm': match_np}, [upd, neg])
+    np.testing.assert_array_equal(gu[0], [0, -1, -2, -1, -2])
+    np.testing.assert_array_equal(gn[0], [0, 1, 0, 1, 0])
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes_np = np.array([[[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 7, 7]]],
+                        dtype='float32')
+    # class 0 = background; class 1 scores
+    scores_np = np.zeros((1, 2, 3), dtype='float32')
+    scores_np[0, 1] = [0.9, 0.8, 0.7]
+    b = fluid.layers.data(name='b', shape=[3, 4], dtype='float32')
+    s = fluid.layers.data(name='s', shape=[2, 3], dtype='float32')
+    out = fluid.layers.multiclass_nms(b, s, score_threshold=0.1,
+                                      nms_threshold=0.5, keep_top_k=4)
+    got = run_startup_and({'b': boxes_np, 's': scores_np}, [out])[0]
+    kept = got[0][got[0][:, 0] >= 0]
+    assert len(kept) == 2  # the near-duplicate box suppressed
+    np.testing.assert_allclose(kept[0, 1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], [0, 0, 2, 2], atol=1e-6)
+    np.testing.assert_allclose(kept[1, 2:], [5, 5, 7, 7], atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    priors_np = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.8]],
+                         dtype='float32')
+    var_np = np.tile(np.array([0.1, 0.1, 0.2, 0.2], dtype='float32'),
+                     (2, 1))
+    gt_np = np.array([[0.15, 0.12, 0.48, 0.55]], dtype='float32')
+    p = fluid.layers.data(name='p', shape=[4], dtype='float32')
+    p.shape = (2, 4)
+    v = fluid.layers.data(name='v', shape=[4], dtype='float32')
+    v.shape = (2, 4)
+    t = fluid.layers.data(name='t', shape=[4], dtype='float32')
+    t.shape = (1, 4)
+    enc = fluid.layers.box_coder(p, v, t, code_type='encode_center_size')
+    dec = fluid.layers.box_coder(p, v, enc[0] if False else enc,
+                                 code_type='decode_center_size')
+    ge, = run_startup_and({'p': priors_np, 'v': var_np, 't': gt_np}, [enc])
+    assert ge.shape == (1, 2, 4)
+
+
+def test_ssd_loss_end_to_end_trains():
+    B, N, M, C = 2, 8, 2, 3
+    rng = np.random.RandomState(1)
+    priors_np = rng.uniform(0.0, 0.8, (N, 4)).astype('float32')
+    priors_np[:, 2:] = priors_np[:, :2] + 0.2
+    gt_box_np = priors_np[:M].copy()[None].repeat(B, 0)
+    gt_lbl_np = np.array([[1, 2], [2, 1]], dtype='int64')
+
+    loc = fluid.layers.data(name='loc', shape=[N, 4], dtype='float32')
+    conf = fluid.layers.data(name='conf', shape=[N, C], dtype='float32')
+    gtb = fluid.layers.data(name='gtb', shape=[M, 4], dtype='float32')
+    gtl = fluid.layers.data(name='gtl', shape=[M], dtype='int64')
+    pb = fluid.layers.data(name='pb', shape=[4], dtype='float32')
+    pb.shape = (N, 4)
+
+    # trainable head on top of fed features so the loss can decrease
+    feat = fluid.layers.data(name='feat', shape=[N, 8], dtype='float32')
+    loc_pred = fluid.layers.fc(input=feat, size=4, num_flatten_dims=2)
+    conf_pred = fluid.layers.fc(input=feat, size=C, num_flatten_dims=2)
+    loss = fluid.layers.ssd_loss(loc_pred, conf_pred, gtb, gtl, pb)
+    avg = fluid.layers.mean(loss)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {'feat': rng.randn(B, N, 8).astype('float32'),
+            'gtb': gt_box_np, 'gtl': gt_lbl_np, 'pb': priors_np}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+              for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_multi_box_head_shapes():
+    img = fluid.layers.data(name='img', shape=[3, 32, 32], dtype='float32')
+    f1 = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                             stride=4, padding=1)
+    f2 = fluid.layers.conv2d(input=f1, num_filters=8, filter_size=3,
+                             stride=2, padding=1)
+    locs, confs, boxes, vars_ = fluid.layers.multi_box_head(
+        inputs=[f1, f2], image=img, num_classes=4,
+        min_sizes=[8.0, 16.0], aspect_ratios=[[1.0], [1.0, 2.0]],
+        flip=True)
+    got = run_startup_and({'img': rand(2, 3, 32, 32)},
+                          [locs, confs, boxes, vars_])
+    n_priors = got[2].shape[0]
+    assert got[0].shape == (2, n_priors, 4)
+    assert got[1].shape == (2, n_priors, 4)
+    assert got[3].shape == (n_priors, 4)
+
+
+def test_ssd_model_trains_and_infers():
+    from paddle_tpu.models.ssd import ssd_train
+    avg, feeds = ssd_train(num_classes=4, image_shape=(3, 64, 64),
+                           max_gt=3)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    gt = rng.uniform(0.1, 0.5, (2, 3, 4)).astype('float32')
+    gt[:, :, 2:] = gt[:, :, :2] + 0.3
+    feed = {'image': rng.rand(2, 3, 64, 64).astype('float32'),
+            'gt_box': gt,
+            'gt_label': rng.randint(1, 4, (2, 3)).astype('int64')}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+              for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_ssd_detection_output_shape():
+    from paddle_tpu.models.ssd import ssd_infer
+    out, feeds = ssd_infer(num_classes=4, image_shape=(3, 64, 64),
+                           keep_top_k=8)
+    rng = np.random.RandomState(3)
+    got = run_startup_and({'image': rng.rand(2, 3, 64, 64)
+                           .astype('float32')}, [out])[0]
+    assert got.shape == (2, 8, 6)

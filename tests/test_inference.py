@@ -1,0 +1,44 @@
+"""Predictor: load saved inference model, repeated predicts reuse the
+compile cache (reference: inference/tests/test_helper.h flows)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from util import rand
+
+
+def _save_model(tmp_path):
+    x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='relu')
+    out = fluid.layers.fc(input=h, size=3, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rand(4, 6)
+    expect = exe.run(feed={'x': xs}, fetch_list=[out])[0]
+    fluid.io.save_inference_model(str(tmp_path), ['x'], [out], exe)
+    return xs, expect
+
+
+def test_predictor_matches_training_graph(tmp_path):
+    from paddle_tpu.inference import create_predictor
+    xs, expect = _save_model(tmp_path)
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    pred = create_predictor(str(tmp_path))
+    got = pred({'x': xs})
+    np.testing.assert_allclose(got[0], expect, rtol=1e-5)
+    # cache reused across calls; new batch size recompiles transparently
+    got2 = pred({'x': rand(7, 6, seed=9)})
+    assert got2[0].shape == (7, 3)
+    np.testing.assert_allclose(got2[0].sum(1), np.ones(7), rtol=1e-5)
+
+
+def test_predictor_isolated_scope(tmp_path):
+    from paddle_tpu.inference import create_predictor
+    xs, expect = _save_model(tmp_path)
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    pred = create_predictor(str(tmp_path))
+    assert len(list(fluid.global_scope().keys())) == 0  # no leakage
+    got = pred({'x': xs})
+    np.testing.assert_allclose(got[0], expect, rtol=1e-5)
