@@ -30,6 +30,12 @@ from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from . import backward  # noqa: F401
+from . import debug  # noqa: F401
+from . import memory_optimize as _memory_optimize_mod  # noqa: F401
+from .memory_optimize import memory_optimize, release_memory  # noqa: F401
+from .core.errors import EnforceError, enforce  # noqa: F401
+from .core.flags import init_flags  # noqa: F401
+from .core.lod import create_lod_tensor, pad_sequences  # noqa: F401
 from . import parallel  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401

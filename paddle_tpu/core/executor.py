@@ -23,6 +23,15 @@ def _ensure_ops_imported():
     from .. import ops as _ops  # noqa: F401  (registers lowerings)
 
 
+def _remat_policy(name):
+    import jax
+    if name in ('full', 'nothing_saveable'):
+        return jax.checkpoint_policies.nothing_saveable
+    if name == 'dots_saveable':
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError('unknown remat policy %r' % name)
+
+
 class _Compiled(object):
     __slots__ = ('fn', 'raw_fn', 'scope_in_names', 'scope_out_names',
                  'feed_names', 'fetch_names')
@@ -113,8 +122,8 @@ class Executor(object):
 
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
-        key = (id(program), program._version, program.amp, feed_sig,
-               tuple(fetch_names))
+        key = (id(program), program._version, program.amp,
+               program.remat_policy, feed_sig, tuple(fetch_names))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, sorted(feed_vals), fetch_names)
@@ -248,16 +257,40 @@ class Executor(object):
                             if k not in set(param_names)}
                 params = {n: env[n] for n in param_names}
 
+                # Only values consumed after the backward boundary may
+                # escape the forward — anything else would be saved as a
+                # checkpoint output and defeat rematerialization.
+                needed_after = set(fetch_names) | set(scope_out_all)
+                needed_after.add(loss_name)
+
+                def collect_reads(op_list, blocks_seen=None):
+                    for op in op_list:
+                        needed_after.update(op.input_names())
+                        for attr in ('sub_block', 'true_block',
+                                     'false_block'):
+                            idx = op.attrs.get(attr)
+                            if idx is not None:
+                                collect_reads(
+                                    program.block(idx).ops)
+
+                collect_reads(post)
+
                 def fwd(p):
                     e = dict(base_env)
                     e.update(p)
                     e = run_ops(pre, e, base_key)
                     loss = e[loss_name].sum()
-                    return loss, e
+                    keep = {k: v for k, v in e.items()
+                            if k in needed_after}
+                    return loss, keep
 
-                (_, env2), grads = jax.value_and_grad(
+                if program.remat_policy:
+                    fwd = jax.checkpoint(
+                        fwd, policy=_remat_policy(program.remat_policy))
+
+                (_, kept), grads = jax.value_and_grad(
                     fwd, has_aux=True)(params)
-                env = env2
+                env.update(kept)
                 for pn, gn in zip(param_names, grad_names):
                     env[gn] = grads[pn]
                 env = run_ops(post, env, base_key,

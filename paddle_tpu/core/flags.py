@@ -1,0 +1,56 @@
+"""Global flags (reference: python/paddle/fluid/__init__.py
+__bootstrap__'s gflags — fraction_of_gpu_memory_to_use etc.).
+
+TPU-native flags control the XLA/executor path instead of CUDA knobs.
+Values are read from the environment (PADDLE_TPU_<NAME>) at first access,
+overridable via init_flags / set_flag.
+"""
+
+import os
+
+__all__ = ['init_flags', 'set_flag', 'get_flag', 'FLAGS']
+
+_DEFAULTS = {
+    # executor
+    'benchmark': False,            # sync + time every executor step
+    'use_bf16': False,             # default Program.amp for new programs
+    'compile_cache': True,
+    # data pipeline
+    'reader_prefetch': 256,
+    # logging
+    'v': 0,                        # verbosity (GLOG_v analog)
+}
+
+FLAGS = {}
+
+
+def _coerce(default, raw):
+    if isinstance(default, bool):
+        return raw.lower() in ('1', 'true', 'yes', 'on')
+    return type(default)(raw)
+
+
+def init_flags(overrides=None):
+    """(Re)load flags from defaults + environment + overrides."""
+    FLAGS.clear()
+    for name, default in _DEFAULTS.items():
+        env = os.environ.get('PADDLE_TPU_' + name.upper())
+        FLAGS[name] = _coerce(default, env) if env is not None else default
+    for name, value in (overrides or {}).items():
+        set_flag(name, value)
+    return dict(FLAGS)
+
+
+def set_flag(name, value):
+    if name not in _DEFAULTS:
+        raise KeyError('unknown flag %r (known: %s)'
+                       % (name, sorted(_DEFAULTS)))
+    if not FLAGS:
+        init_flags()
+    FLAGS[name] = value
+
+
+def get_flag(name):
+    if not FLAGS:
+        init_flags()
+    return FLAGS[name]

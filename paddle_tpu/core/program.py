@@ -238,6 +238,9 @@ class Program(object):
         # grads, optimizer state and loss-class ops stay fp32
         # (master-weight AMP; reference analog: fluid's float16 lists).
         self.amp = None
+        # Rematerialization policy set by memory_optimize(): None, 'full',
+        # 'dots_saveable', or 'nothing_saveable' (jax.checkpoint).
+        self.remat_policy = None
 
     def _bump_version(self):
         self._version += 1

@@ -1,0 +1,47 @@
+"""CoNLL-2005 semantic role labeling (reference:
+python/paddle/v2/dataset/conll05.py). Schema: (word_ids, ctx_n2, ctx_n1,
+ctx_0, ctx_p1, ctx_p2, verb_id, mark, label_ids) per sentence.
+Synthetic fallback keeps the 9-slot schema and label cardinality."""
+
+import numpy as np
+
+from . import common
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+_TRAIN_N = 1024
+_TEST_N = 256
+_MAX_LEN = 30
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(WORD_DICT_LEN)}
+    verb_dict = {('v%d' % i): i for i in range(PRED_DICT_LEN)}
+    label_dict = {('l%d' % i): i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def _reader(split, n):
+    def reader():
+        r = common.rng('conll05', split)
+        for _ in range(n):
+            length = int(r.randint(5, _MAX_LEN))
+            words = r.randint(0, WORD_DICT_LEN, length).astype('int64')
+            ctxs = [np.roll(words, k) for k in (-2, -1, 0, 1, 2)]
+            verb = int(r.randint(0, PRED_DICT_LEN))
+            verb_pos = int(r.randint(0, length))
+            mark = np.zeros(length, dtype='int64')
+            mark[verb_pos] = 1
+            labels = r.randint(0, LABEL_DICT_LEN, length).astype('int64')
+            yield (words,) + tuple(ctxs) + (
+                np.full(length, verb, dtype='int64'), mark, labels)
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)

@@ -1,0 +1,37 @@
+"""Oxford 102 flowers (reference: python/paddle/v2/dataset/flowers.py).
+Schema: (image_chw_float32, label). Synthetic: class-colored noise."""
+
+import numpy as np
+
+from . import common
+
+CLASS_NUM = 102
+_TRAIN_N = 1024
+_TEST_N = 256
+_SHAPE = (3, 32, 32)  # reference resizes to 224; kept small for tests
+
+
+def _reader(split, n, mapper=None):
+    def reader():
+        r = common.rng('flowers', split)
+        for _ in range(n):
+            label = int(r.randint(0, CLASS_NUM))
+            base = np.zeros(_SHAPE, dtype='float32')
+            base[label % 3] = (label % 10) / 10.0
+            img = np.clip(base + r.normal(0, 0.2, _SHAPE), 0, 1) \
+                .astype('float32')
+            item = (img, label)
+            yield mapper(item) if mapper else item
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader('train', _TRAIN_N, mapper)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader('test', _TEST_N, mapper)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader('valid', _TEST_N, mapper)

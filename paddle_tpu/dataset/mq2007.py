@@ -1,0 +1,49 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/v2/dataset/mq2007.py).
+Modes: 'pointwise' (feature, relevance), 'pairwise' (better, worse),
+'listwise' (per-query feature list, label list)."""
+
+import numpy as np
+
+from . import common
+
+FEATURE_DIM = 46
+_QUERIES = 128
+_DOCS_PER_QUERY = 8
+
+
+def _make_query(r):
+    # latent weight vector per split drives consistent relevance
+    feats = r.uniform(0, 1, (_DOCS_PER_QUERY, FEATURE_DIM)) \
+        .astype('float32')
+    scores = feats[:, :5].sum(axis=1)
+    rel = np.digitize(scores, np.percentile(scores, [50, 80])) \
+        .astype('int64')  # 0/1/2 relevance
+    return feats, rel
+
+
+def _reader(split, format):
+    def reader():
+        r = common.rng('mq2007', split)
+        for _ in range(_QUERIES):
+            feats, rel = _make_query(r)
+            if format == 'pointwise':
+                for f, y in zip(feats, rel):
+                    yield f, int(y)
+            elif format == 'pairwise':
+                for i in range(len(rel)):
+                    for j in range(len(rel)):
+                        if rel[i] > rel[j]:
+                            yield feats[i], feats[j]
+            elif format == 'listwise':
+                yield feats, rel
+            else:
+                raise ValueError('unknown format %r' % format)
+    return reader
+
+
+def train(format='pairwise'):
+    return _reader('train', format)
+
+
+def test(format='pairwise'):
+    return _reader('test', format)

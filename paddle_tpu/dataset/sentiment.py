@@ -1,0 +1,40 @@
+"""Movie review sentiment, NLTK-style (reference:
+python/paddle/v2/dataset/sentiment.py). Schema: (word_id_list, label)."""
+
+import numpy as np
+
+from . import common
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 8000
+_MAX_LEN = 60
+
+
+def get_word_dict():
+    return [('w%d' % i, i) for i in range(_VOCAB)]
+
+
+def _reader(split, n):
+    def reader():
+        r = common.rng('sentiment', split)
+        for _ in range(n):
+            label = int(r.randint(0, 2))
+            length = int(r.randint(8, _MAX_LEN))
+            if label:
+                toks = np.minimum(r.exponential(_VOCAB / 10, length)
+                                  .astype('int64'), _VOCAB - 1)
+            else:
+                toks = _VOCAB - 1 - np.minimum(
+                    r.exponential(_VOCAB / 10, length).astype('int64'),
+                    _VOCAB - 1)
+            yield toks, label
+    return reader
+
+
+def train():
+    return _reader('train', NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _reader('test', NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -1,0 +1,39 @@
+"""PASCAL VOC2012 segmentation (reference:
+python/paddle/v2/dataset/voc2012.py). Schema: (image_chw, seg_label_hw)."""
+
+import numpy as np
+
+from . import common
+
+CLASS_NUM = 21  # 20 classes + background
+_TRAIN_N = 256
+_TEST_N = 64
+_SHAPE = (3, 32, 32)
+
+
+def _reader(split, n):
+    def reader():
+        r = common.rng('voc2012', split)
+        h, w = _SHAPE[1], _SHAPE[2]
+        for _ in range(n):
+            img = r.uniform(0, 1, _SHAPE).astype('float32')
+            # blocky segmentation mask
+            seg = np.zeros((h, w), dtype='int32')
+            for _k in range(3):
+                cls = int(r.randint(1, CLASS_NUM))
+                y0, x0 = r.randint(0, h // 2), r.randint(0, w // 2)
+                seg[y0:y0 + h // 2, x0:x0 + w // 2] = cls
+            yield img, seg
+    return reader
+
+
+def train():
+    return _reader('train', _TRAIN_N)
+
+
+def test():
+    return _reader('test', _TEST_N)
+
+
+def val():
+    return _reader('val', _TEST_N)

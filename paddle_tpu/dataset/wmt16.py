@@ -1,0 +1,46 @@
+"""WMT16 en-de translation (reference: python/paddle/v2/dataset/wmt16.py).
+Schema: (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> = 0/1/2."""
+
+import numpy as np
+
+from . import common
+
+_SRC_VOCAB = 10000
+_TRG_VOCAB = 10000
+_TRAIN_N = 2048
+_TEST_N = 256
+_MAX_LEN = 50
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {('%s_w%d' % (lang, i)): i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def _reader(split, n, src_dict_size, trg_dict_size):
+    def reader():
+        r = common.rng('wmt16', split)
+        for _ in range(n):
+            slen = int(r.randint(3, _MAX_LEN))
+            tlen = max(3, int(slen * r.uniform(0.8, 1.2)))
+            src = r.randint(3, src_dict_size, slen).astype('int64')
+            trg = r.randint(3, trg_dict_size, tlen).astype('int64')
+            trg_in = np.concatenate([[0], trg]).astype('int64')   # <s> ...
+            trg_next = np.concatenate([trg, [1]]).astype('int64')  # ... <e>
+            yield src, trg_in, trg_next
+    return reader
+
+
+def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+          src_lang='en'):
+    return _reader('train', _TRAIN_N, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+         src_lang='en'):
+    return _reader('test', _TEST_N, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
+               src_lang='en'):
+    return _reader('val', _TEST_N, src_dict_size, trg_dict_size)

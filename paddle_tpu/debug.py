@@ -1,0 +1,68 @@
+"""Program debugging/visualization (reference: python/paddle/fluid/
+debuger.py — pprint + graphviz export of a ProgramDesc).
+
+draw_block_graphviz writes a .dot file (render offline); print_program /
+program_to_code give a readable op listing with shapes and attrs.
+"""
+
+from .core.program import Variable, default_main_program
+
+__all__ = ['program_to_code', 'print_program', 'draw_block_graphviz']
+
+
+def _fmt_var(block, name):
+    var = block._find_var_recursive(name)
+    if var is None:
+        return name
+    shape = 'x'.join('?' if s is None else str(s)
+                     for s in (var.shape or ()))
+    return '%s[%s:%s]' % (name, var.dtype, shape)
+
+
+def program_to_code(program=None, skip_attrs=('op_role',)):
+    program = program or default_main_program()
+    lines = []
+    for block in program.blocks:
+        lines.append('// block %d (parent %d)' % (block.idx,
+                                                  block.parent_idx))
+        for op in block.ops:
+            ins = ', '.join(
+                '%s=%s' % (slot, [_fmt_var(block, n) for n in names])
+                for slot, names in sorted(op.inputs.items()))
+            outs = ', '.join(
+                '%s=%s' % (slot, [_fmt_var(block, n) for n in names])
+                for slot, names in sorted(op.outputs.items()))
+            attrs = ', '.join(
+                '%s=%r' % (k, v) for k, v in sorted(op.attrs.items())
+                if k not in skip_attrs)
+            lines.append('  %s(%s) -> %s  {%s}' % (op.type, ins, outs,
+                                                   attrs))
+    return '\n'.join(lines)
+
+
+def print_program(program=None):
+    print(program_to_code(program))
+
+
+def draw_block_graphviz(block, path='program.dot', highlights=None):
+    """Emit a graphviz dot of the op/var dataflow graph."""
+    highlights = set(highlights or [])
+    lines = ['digraph G {', '  rankdir=TB;']
+    for i, op in enumerate(block.ops):
+        color = 'lightcoral' if op.type in highlights else 'lightblue'
+        lines.append('  op_%d [label="%s" shape=box style=filled '
+                     'fillcolor=%s];' % (i, op.type, color))
+    producers = {}
+    for i, op in enumerate(block.ops):
+        for name in op.output_names():
+            producers[name] = i
+    for i, op in enumerate(block.ops):
+        for name in op.input_names():
+            j = producers.get(name)
+            if j is not None and j != i:
+                lines.append('  op_%d -> op_%d [label="%s"];'
+                             % (j, i, name))
+    lines.append('}')
+    with open(path, 'w') as f:
+        f.write('\n'.join(lines))
+    return path

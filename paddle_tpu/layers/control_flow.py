@@ -15,8 +15,24 @@ from .tensor import fill_constant
 __all__ = [
     'increment', 'less_than', 'equal', 'array_write', 'array_read',
     'create_array', 'array_length', 'While', 'StaticRNN', 'Switch',
-    'Print', 'is_empty',
+    'Print', 'is_empty', 'IfElse', 'DynamicRNN',
 ]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _in_parent_block(program):
+    """Emit ops into the parent of the current (sub-)block — boot values
+    for loop memories must live where the loop op can read them."""
+    sub_idx = program.current_block_idx
+    # global block has parent_idx -1; clamp so ops never land in blocks[-1]
+    program.current_block_idx = max(program.block(sub_idx).parent_idx, 0)
+    try:
+        yield
+    finally:
+        program.current_block_idx = sub_idx
 
 
 def increment(x, value=1.0, in_place=True):
@@ -124,10 +140,17 @@ class While(object):
             self.owner.program.rollback()
             block = self.owner.block
             parent = self.owner.program.current_block()
+            written = []
+            for op in block.ops:
+                for n in op.output_names():
+                    var = parent._find_var_recursive(n)
+                    if var is not None and n not in written:
+                        written.append(n)
+            out_vars = [parent._find_var_recursive(n) for n in written]
             parent.append_op(
                 type='while',
                 inputs={'Condition': [self.owner.cond_var]},
-                outputs={},
+                outputs={'Out': out_vars},
                 attrs={'sub_block': block.idx})
             return False
 
@@ -188,8 +211,9 @@ class StaticRNN(object):
             if batch_ref is None:
                 raise ValueError('memory needs init or batch_ref')
             from .tensor import fill_constant_batch_size_like
-            init = fill_constant_batch_size_like(
-                batch_ref, [1] + list(shape), dtype, value)
+            with _in_parent_block(self.program):
+                init = fill_constant_batch_size_like(
+                    batch_ref, [1] + list(shape), dtype, value)
         pre = helper.create_variable_for_type_inference(init.dtype)
         pre.shape = init.shape
         self._memories.append({'init': init, 'pre': pre.name, 'cur': None})
@@ -244,3 +268,179 @@ def Print(input, first_n=-1, message=None, summarize=-1,
                      outputs={'Out': [out]},
                      attrs={'message': message or ''})
     return out
+
+class IfElse(object):
+    """Per-example branch select (reference control_flow.py:IfElse).
+
+    The reference gathers the true/false sub-batches and runs each branch
+    on its slice; on TPU both branches run on the full batch and outputs
+    merge by mask (static shapes). API-compatible:
+
+        ie = IfElse(cond)               # cond: [B, 1] bool
+        with ie.true_block():
+            ie.output(a)
+        with ie.false_block():
+            ie.output(b)
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('if_else', name=name)
+        self.cond = cond
+        self.program = default_main_program()
+        self._blocks = {}          # 'true' / 'false' -> block idx
+        self._outputs = {'true': [], 'false': []}
+        self._current = None
+
+    class _Guard(object):
+        def __init__(self, owner, which):
+            self.owner, self.which = owner, which
+
+        def __enter__(self):
+            self.owner._current = self.which
+            block = self.owner.program.create_block()
+            self.owner._blocks[self.which] = block.idx
+            return self
+
+        def __exit__(self, *exc):
+            self.owner.program.rollback()
+            self.owner._current = None
+            return False
+
+    def true_block(self):
+        return IfElse._Guard(self, 'true')
+
+    def false_block(self):
+        return IfElse._Guard(self, 'false')
+
+    def input(self, x):
+        # reference slices x to the branch sub-batch; full-batch here
+        return x
+
+    def output(self, *outs):
+        if self._current is None:
+            raise ValueError('IfElse.output() must be called inside '
+                             'true_block()/false_block()')
+        self._outputs[self._current].extend(outs)
+
+    def __call__(self):
+        t_outs = self._outputs['true']
+        f_outs = self._outputs['false']
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                'IfElse branches declared %d vs %d outputs; they must '
+                'match pairwise' % (len(t_outs), len(f_outs)))
+        parent = self.program.current_block()
+        merged = []
+        for tv in t_outs:
+            var = self.helper.create_variable_for_type_inference(tv.dtype)
+            if tv.shape is not None:
+                var.shape = tuple(tv.shape)
+            merged.append(var)
+        parent.append_op(
+            type='if_else',
+            inputs={'Cond': [self.cond]},
+            outputs={'Outs': merged},
+            attrs={'true_block': self._blocks['true'],
+                   'false_block': self._blocks['false'],
+                   'true_names': [v.name for v in t_outs],
+                   'false_names': [v.name for v in f_outs]})
+        return merged
+
+
+class DynamicRNN(object):
+    """Length-masked RNN over padded [B, T, ...] inputs (reference
+    control_flow.py:DynamicRNN over LoD). step_input takes the padded
+    sequence; pass `length` to mask updates past each sequence end."""
+
+    def __init__(self, length=None, name=None):
+        self.helper = LayerHelper('dynamic_rnn', name=name)
+        self.program = default_main_program()
+        self.length = length
+        self._inputs = []
+        self._memories = []
+        self._outputs = []
+        self._sub_block = None
+
+    class _Guard(object):
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn._sub_block = self.rnn.program.create_block()
+            return self
+
+        def __exit__(self, *exc):
+            self.rnn.program.rollback()
+            rnn = self.rnn
+            parent = rnn.program.current_block()
+            out_vars = []
+            for o in rnn._outputs:
+                var = rnn.helper.create_variable_for_type_inference(o.dtype)
+                if o.shape is not None:
+                    var.shape = (o.shape[0], None) + tuple(o.shape[1:])
+                out_vars.append(var)
+            final_mems = []
+            for m in rnn._memories:
+                var = rnn.helper.create_variable_for_type_inference(
+                    m['init'].dtype)
+                if m['init'].shape is not None:
+                    var.shape = tuple(m['init'].shape)
+                final_mems.append(var)
+            inputs = {'Inputs': [v for v, _ in rnn._inputs],
+                      'BootMemories': [m['init'] for m in rnn._memories]}
+            if rnn.length is not None:
+                inputs['Length'] = [rnn.length]
+            parent.append_op(
+                type='dynamic_rnn',
+                inputs=inputs,
+                outputs={'Outputs': out_vars, 'FinalMemories': final_mems},
+                attrs={'sub_block': rnn._sub_block.idx,
+                       'step_input_names': [s for _, s in rnn._inputs],
+                       'memory_names': [(m['pre'], m['cur'])
+                                        for m in rnn._memories],
+                       'output_names': [o.name for o in rnn._outputs]})
+            rnn._out_vars = out_vars
+            return False
+
+    def block(self):
+        return DynamicRNN._Guard(self)
+
+    def step_input(self, x):
+        helper = LayerHelper('drnn_step_input')
+        step = helper.create_variable_for_type_inference(x.dtype)
+        if x.shape is not None and len(x.shape) >= 2:
+            step.shape = (x.shape[0],) + tuple(x.shape[2:])
+        self._inputs.append((x, step.name))
+        return step
+
+    def memory(self, init=None, shape=None, value=0.0, batch_ref=None,
+               dtype='float32'):
+        helper = LayerHelper('drnn_memory')
+        if init is None:
+            if batch_ref is None and not self._inputs:
+                raise ValueError('memory needs init or batch_ref')
+            from .tensor import fill_constant_batch_size_like
+            ref = batch_ref if batch_ref is not None else self._inputs[0][0]
+            with _in_parent_block(self.program):
+                init = fill_constant_batch_size_like(
+                    ref, [1] + list(shape), dtype, value)
+        pre = helper.create_variable_for_type_inference(init.dtype)
+        pre.shape = init.shape
+        self._memories.append({'init': init, 'pre': pre.name, 'cur': None})
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self._memories:
+            if m['pre'] == mem.name:
+                m['cur'] = var.name
+                return
+        raise ValueError('unknown dynamic_rnn memory %r' % mem.name)
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+
+    def __call__(self):
+        vars_ = self._out_vars
+        return vars_[0] if len(vars_) == 1 else vars_
+

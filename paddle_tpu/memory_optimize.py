@@ -1,0 +1,41 @@
+"""Memory optimization (reference: python/paddle/fluid/
+memory_optimization_transpiler.py — var reuse by liveness analysis).
+
+TPU-native translation: XLA already does buffer reuse/liveness inside a
+compiled program, so the wins here are the knobs XLA can't choose for
+you:
+- rematerialization (jax.checkpoint) of the forward pass — trade FLOPs
+  for activation memory, essential for long-sequence training;
+- donation is already on by default in the Executor (params alias their
+  updates in HBM).
+
+memory_optimize(program) therefore sets the program's remat policy; the
+Executor wraps the traced forward in jax.checkpoint with it.
+"""
+
+__all__ = ['memory_optimize', 'release_memory', 'REMAT_POLICIES']
+
+REMAT_POLICIES = ('none', 'full', 'dots_saveable', 'nothing_saveable')
+
+
+def memory_optimize(input_program=None, print_log=False, level=0,
+                    policy=None):
+    """level 0 -> save matmul outputs (cheap recompute of elementwise);
+    level 1 -> full remat (recompute everything in backward)."""
+    from .core.program import default_main_program
+    program = input_program or default_main_program()
+    if policy is None:
+        policy = 'dots_saveable' if level == 0 else 'full'
+    if policy not in REMAT_POLICIES:
+        raise ValueError('unknown remat policy %r (choose from %s)'
+                         % (policy, REMAT_POLICIES))
+    program.remat_policy = None if policy == 'none' else policy
+    if print_log:
+        print('memory_optimize: remat policy = %s' % policy)
+    return program
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """Reference-API shim: with XLA managing buffers there is nothing to
+    release eagerly; kept for ported scripts."""
+    return input_program

@@ -21,9 +21,9 @@ def _run_block_ops(block, env, base_key, is_test=False):
     return env
 
 
-@register('static_rnn')
-def _static_rnn(ctx):
-    """Lower a StaticRNN sub-block with lax.scan over time (axis 1)."""
+def _scan_rnn(ctx, length):
+    """Shared lax.scan lowering for StaticRNN (length=None) and
+    DynamicRNN (length masks memory updates/outputs past sequence end)."""
     block = ctx.block.program.block(ctx.attr('sub_block'))
     step_input_names = ctx.attr('step_input_names')
     memory_names = ctx.attr('memory_names')  # [(pre, cur), ...]
@@ -33,22 +33,38 @@ def _static_rnn(ctx):
     base_key = ctx.rng_key()
     outer_env = dict(ctx.env)
 
+    def masked(t, new, old, zero=False):
+        if length is None:
+            return new
+        alive = (t < length).reshape((-1,) + (1,) * (new.ndim - 1))
+        return jnp.where(alive, new,
+                         jnp.zeros_like(new) if zero else old)
+
     def body(carry, xs):
+        t, mems = carry
         env = dict(outer_env)
         for name, val in zip(step_input_names, xs):
             env[name] = val
-        for (pre, _), mem in zip(memory_names, carry):
+        for (pre, _), mem in zip(memory_names, mems):
             env[pre] = mem
         env = _run_block_ops(block, env, base_key, is_test=ctx.is_test)
-        new_carry = tuple(env[cur] for _, cur in memory_names)
-        outs = tuple(env[name] for name in output_names)
-        return new_carry, outs
+        new_mems = tuple(masked(t, env[cur], mem)
+                         for (_, cur), mem in zip(memory_names, mems))
+        outs = tuple(masked(t, env[name], None, zero=True)
+                     for name in output_names)
+        return (t + 1, new_mems), outs
 
     xs = tuple(jnp.swapaxes(x, 0, 1) for x in seq_inputs)  # time-major
-    carry0 = tuple(boot_memories)
-    _, outs = jax.lax.scan(body, carry0, xs)
+    carry0 = (jnp.asarray(0, jnp.int32), tuple(boot_memories))
+    (_, final_mems), outs = jax.lax.scan(body, carry0, xs)
     outs = tuple(jnp.swapaxes(o, 0, 1) for o in outs)  # back to batch-major
     ctx.set_output_list('Outputs', outs)
+    ctx.set_output_list('FinalMemories', final_mems)
+
+
+@register('static_rnn')
+def _static_rnn(ctx):
+    _scan_rnn(ctx, length=None)
 
 
 @register('while')
@@ -117,3 +133,39 @@ def _array_read(ctx):
 def _array_length(ctx):
     arr = ctx.input('X')
     ctx.set_output('Out', jnp.asarray([arr.shape[0]], dtype=jnp.int64))
+
+
+@register('if_else')
+def _if_else(ctx):
+    """Lower IfElse: both branch blocks run on the FULL batch, outputs
+    merged per example with jnp.where on the condition (if_else_op.cc
+    gathers true/false sub-batches; dynamic sub-batch shapes don't
+    compile on TPU, and select-on-mask is the XLA-native form)."""
+    cond = ctx.input('Cond')
+    true_block = ctx.block.program.block(ctx.attr('true_block'))
+    false_block = ctx.block.program.block(ctx.attr('false_block'))
+    true_names = ctx.attr('true_names')
+    false_names = ctx.attr('false_names')
+    base_key = ctx.rng_key()
+
+    env_t = _run_block_ops(true_block, dict(ctx.env), base_key,
+                           is_test=ctx.is_test)
+    env_f = _run_block_ops(false_block, dict(ctx.env), base_key,
+                           is_test=ctx.is_test)
+    outs = []
+    for tn, fn in zip(true_names, false_names):
+        tv, fv = env_t[tn], env_f[fn]
+        c = cond.reshape(cond.shape[0:1] + (1,) * (tv.ndim - 1))
+        outs.append(jnp.where(c.astype(bool), tv, fv))
+    ctx.set_output_list('Outs', outs)
+
+
+@register('dynamic_rnn')
+def _dynamic_rnn(ctx):
+    """StaticRNN + per-example lengths (the reference DynamicRNN walks LoD
+    levels; here a mask freezes memories and zeroes outputs past each
+    sequence's end on dense [B, T, ...] arrays)."""
+    length = ctx.input('Length') if ctx.has_input('Length') else None
+    if length is not None:
+        length = length.reshape(-1)
+    _scan_rnn(ctx, length)

@@ -1,0 +1,90 @@
+"""Control flow: While, StaticRNN, DynamicRNN, IfElse, arrays (reference:
+fluid/tests/unittests/test_while_op.py, test_recurrent_op.py,
+test_dyn_rnn.py, test_if_else_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+def test_static_rnn_cumsum():
+    x = fluid.layers.data(name='x', shape=[5, 3], dtype='float32')
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        mem = rnn.memory(batch_ref=x, shape=[3], value=0.0)
+        acc = fluid.layers.elementwise_add(x=mem, y=xt)
+        rnn.update_memory(mem, acc)
+        rnn.step_output(acc)
+    out = rnn()
+    xs = rand(2, 5, 3, seed=0)
+    got = run_startup_and({'x': xs}, [out])[0]
+    np.testing.assert_allclose(got, np.cumsum(xs, axis=1), rtol=1e-5)
+
+
+def test_while_countdown():
+    i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype='int64', value=5)
+    cond = fluid.layers.less_than(x=i, y=limit)
+    total = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                       value=0.0)
+    w = fluid.layers.While(cond=cond)
+    with w.block():
+        fluid.layers.increment(x=i, value=1, in_place=True)
+        fluid.layers.increment(x=total, value=2.0, in_place=True)
+        fluid.layers.less_than(x=i, y=limit, cond=cond)
+    got = run_startup_and({}, [total, i])
+    np.testing.assert_allclose(got[0], [10.0])
+    np.testing.assert_array_equal(got[1], [5])
+
+
+def test_if_else_per_example_select():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    zeros = fluid.layers.fill_constant_batch_size_like(
+        x, shape=[1, 1], dtype='float32', value=0.0)
+    row_sum = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+    cond = fluid.layers.less_than(x=zeros, y=row_sum)  # sum > 0
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(fluid.layers.scale(x, scale=2.0))
+    with ie.false_block():
+        ie.output(fluid.layers.scale(x, scale=-1.0))
+    out, = ie()
+    xs = np.array([[1, 1, 1], [-1, -1, -1]], dtype='float32')
+    got = run_startup_and({'x': xs}, [out])[0]
+    np.testing.assert_allclose(got[0], xs[0] * 2.0)
+    np.testing.assert_allclose(got[1], -xs[1])
+
+
+def test_dynamic_rnn_respects_lengths():
+    x = fluid.layers.data(name='x', shape=[4, 2], dtype='float32')
+    length = fluid.layers.data(name='len', shape=[], dtype='int64')
+    drnn = fluid.layers.DynamicRNN(length=length)
+    with drnn.block():
+        xt = drnn.step_input(x)
+        mem = drnn.memory(batch_ref=x, shape=[2], value=0.0)
+        acc = fluid.layers.elementwise_add(x=mem, y=xt)
+        drnn.update_memory(mem, acc)
+        drnn.output(acc)
+    out = drnn()
+    xs = np.ones((2, 4, 2), dtype='float32')
+    lens = np.array([2, 4], dtype='int64')
+    got = run_startup_and({'x': xs, 'len': lens}, [out])[0]
+    # example 0: cumsum stops after t=1; later outputs masked to 0
+    np.testing.assert_allclose(got[0, :, 0], [1, 2, 0, 0])
+    np.testing.assert_allclose(got[1, :, 0], [1, 2, 3, 4])
+
+
+def test_array_write_read():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    i0 = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+    i1 = fluid.layers.fill_constant(shape=[1], dtype='int64', value=1)
+    arr = fluid.layers.array_write(x, i0)
+    fluid.layers.array_write(fluid.layers.scale(x, 3.0), i1, array=arr)
+    r0 = fluid.layers.array_read(arr, i0)
+    r1 = fluid.layers.array_read(arr, i1)
+    xs = rand(2, 3, seed=1)
+    got = run_startup_and({'x': xs}, [r0, r1])
+    np.testing.assert_allclose(got[0], xs, rtol=1e-6)
+    np.testing.assert_allclose(got[1], xs * 3.0, rtol=1e-6)
