@@ -13,7 +13,7 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     'warpctc', 'ctc_greedy_decoder', 'linear_chain_crf', 'crf_decoding',
-    'beam_search', 'beam_search_decode',
+    'beam_search', 'beam_search_decode', 'beam_gather',
 ]
 
 
@@ -147,3 +147,16 @@ def beam_search_decode(step_ids, step_parents, final_scores=None,
                               'SentenceScores': [sent_scores]},
                      attrs={'end_id': end_id})
     return sent, sent_scores
+
+
+def beam_gather(x, index, name=None):
+    """Reorder axis-1 (beam) entries of `x` by per-example `index`
+    ([B, beam] int). Used between beam_search steps to realign prefixes."""
+    helper = LayerHelper(name or 'beam_gather')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        out.shape = tuple(x.shape)
+    helper.append_op(type='beam_gather',
+                     inputs={'X': [x], 'Index': [index]},
+                     outputs={'Out': [out]}, attrs={})
+    return out

@@ -65,19 +65,26 @@ def _multi_head_attention(queries, keys, values, d_key, d_value, d_model,
 
 def _ffn(x, d_inner, d_model, dropout_rate, name='ffn'):
     hidden = layers.fc(input=x, size=d_inner, num_flatten_dims=2,
-                       act='relu', param_attr=ParamAttr(name=name + '_1.w'))
+                       act='relu', param_attr=ParamAttr(name=name + '_1.w'),
+                       bias_attr=ParamAttr(name=name + '_1.b'))
     if dropout_rate:
         hidden = layers.dropout(hidden, dropout_prob=dropout_rate)
     return layers.fc(input=hidden, size=d_model, num_flatten_dims=2,
-                     param_attr=ParamAttr(name=name + '_2.w'))
+                     param_attr=ParamAttr(name=name + '_2.w'),
+                     bias_attr=ParamAttr(name=name + '_2.b'))
 
 
-def _post_process(prev, out, dropout_rate):
-    """residual add + layer_norm (+ dropout), the reference's "dan" chain."""
+def _post_process(prev, out, dropout_rate, name='pp'):
+    """residual add + layer_norm (+ dropout), the reference's "dan" chain.
+    Every parameter is explicitly named so inference graphs (including
+    the unrolled decode, which re-runs these layers per step) share the
+    trained weights."""
     if dropout_rate:
         out = layers.dropout(out, dropout_prob=dropout_rate)
     added = layers.elementwise_add(x=out, y=prev)
-    return layers.layer_norm(added, begin_norm_axis=len(added.shape) - 1)
+    return layers.layer_norm(added, begin_norm_axis=len(added.shape) - 1,
+                             param_attr=ParamAttr(name=name + '_ln.w'),
+                             bias_attr=ParamAttr(name=name + '_ln.b'))
 
 
 def _prepare_input(word_ids, vocab_size, d_model, max_length, dropout_rate,
@@ -86,6 +93,11 @@ def _prepare_input(word_ids, vocab_size, d_model, max_length, dropout_rate,
         input=word_ids, size=[vocab_size, d_model], dtype='float32',
         param_attr=ParamAttr(name=emb_name,
                              initializer=Normal(0., d_model ** -0.5)))
+    if len(emb.shape) == 2:
+        # embedding squeezes a trailing dim of 1 (the fluid [B, 1]
+        # id-column convention); a length-1 decode prefix must stay 3-D
+        # or the step-1 graph would declare wrongly-shaped fc weights.
+        emb = layers.reshape(x=emb, shape=[0, 1, d_model])
     emb = layers.scale(x=emb, scale=d_model ** 0.5)
     seq_len = word_ids.shape[1]
     pos_enc = layers.create_parameter(
@@ -107,9 +119,9 @@ def encoder_layer(x, n_head, d_key, d_value, d_model, d_inner, dropout_rate,
     attn = _multi_head_attention(x, x, x, d_key, d_value, d_model, n_head,
                                  dropout_rate, key_length=src_length,
                                  name=name + '_slf')
-    x = _post_process(x, attn, dropout_rate)
+    x = _post_process(x, attn, dropout_rate, name=name + '_pp1')
     ffn = _ffn(x, d_inner, d_model, dropout_rate, name=name + '_ffn')
-    return _post_process(x, ffn, dropout_rate)
+    return _post_process(x, ffn, dropout_rate, name=name + '_pp2')
 
 
 def decoder_layer(x, enc_out, n_head, d_key, d_value, d_model, d_inner,
@@ -117,14 +129,14 @@ def decoder_layer(x, enc_out, n_head, d_key, d_value, d_model, d_inner,
     slf = _multi_head_attention(x, x, x, d_key, d_value, d_model, n_head,
                                 dropout_rate, causal=True,
                                 name=name + '_slf')
-    x = _post_process(x, slf, dropout_rate)
+    x = _post_process(x, slf, dropout_rate, name=name + '_pp1')
     cross = _multi_head_attention(x, enc_out, enc_out, d_key, d_value,
                                   d_model, n_head, dropout_rate,
                                   key_length=src_length,
                                   name=name + '_cross')
-    x = _post_process(x, cross, dropout_rate)
+    x = _post_process(x, cross, dropout_rate, name=name + '_pp2')
     ffn = _ffn(x, d_inner, d_model, dropout_rate, name=name + '_ffn')
-    return _post_process(x, ffn, dropout_rate)
+    return _post_process(x, ffn, dropout_rate, name=name + '_pp3')
 
 
 def transformer(src_vocab_size, trg_vocab_size, max_length=256,
@@ -215,3 +227,163 @@ def make_fake_batch(batch_size, src_seq_len, trg_seq_len, src_vocab_size,
                                 (batch_size, trg_seq_len)).astype('int64'),
         'lbl_weight': np.ones((batch_size, trg_seq_len), dtype='float32'),
     }
+
+
+# ---------------------------------------------------------------- inference
+def _decode_prefix(prefix_ids, enc_out, src_length, cfg):
+    """Run the decoder stack over a [B*, t] prefix; returns last-position
+    logits [B*, V]. Parameter names match the training graph, so a
+    trained scope decodes directly."""
+    dec_in = _prepare_input(prefix_ids, cfg['trg_vocab_size'],
+                            cfg['d_model'], cfg['max_length'], 0.0,
+                            cfg['dec_emb_name'], cfg['pos_table'])
+    y = dec_in
+    for i in range(cfg['n_layer']):
+        y = decoder_layer(y, enc_out, cfg['n_head'], cfg['d_key'],
+                          cfg['d_value'], cfg['d_model'], cfg['d_inner'],
+                          0.0, src_length=src_length, name='dec_%d' % i)
+    logits = layers.fc(input=y, size=cfg['trg_vocab_size'],
+                       num_flatten_dims=2, bias_attr=False,
+                       param_attr=ParamAttr(name='out_proj.w'))
+    t = prefix_ids.shape[1]
+    last = layers.slice(logits, axes=[1], starts=[t - 1], ends=[t])
+    return layers.reshape(x=last, shape=[0, cfg['trg_vocab_size']])
+
+
+def _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
+               d_key, d_value, d_model, d_inner, weight_sharing):
+    return dict(trg_vocab_size=trg_vocab_size, d_model=d_model,
+                max_length=max_length, n_layer=n_layer, n_head=n_head,
+                d_key=d_key, d_value=d_value, d_inner=d_inner,
+                dec_emb_name='src_emb' if weight_sharing else 'trg_emb',
+                pos_table=position_encoding_table(max_length, d_model))
+
+
+def _build_encoder(src_word, src_length, src_vocab_size, cfg):
+    enc_in = _prepare_input(src_word, src_vocab_size, cfg['d_model'],
+                            cfg['max_length'], 0.0, 'src_emb',
+                            cfg['pos_table'])
+    x = enc_in
+    for i in range(cfg['n_layer']):
+        x = encoder_layer(x, cfg['n_head'], cfg['d_key'], cfg['d_value'],
+                          cfg['d_model'], cfg['d_inner'], 0.0,
+                          src_length=src_length, name='enc_%d' % i)
+    return x
+
+
+def transformer_greedy_infer(src_vocab_size, trg_vocab_size,
+                             max_out_len=16, bos_id=0, eos_id=1,
+                             src_seq_len=16, max_length=256, n_layer=6,
+                             n_head=8, d_key=64, d_value=64, d_model=512,
+                             d_inner=2048, weight_sharing=False):
+    """Unrolled greedy decode (static shapes per step, one XLA program).
+    Feeds: src_word [B, S], src_length [B]. Returns out_ids [B, T].
+    Reference analog: the transformer infer program built with
+    layers.While + beam ops; unrolling trades graph size for zero
+    dynamic shapes (round-2: cached incremental While decode)."""
+    cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
+                     n_head, d_key, d_value, d_model, d_inner,
+                     weight_sharing)
+    src_word = layers.data(name='src_word', shape=[src_seq_len],
+                           dtype='int64')
+    src_length = layers.data(name='src_length', shape=[], dtype='int64')
+    enc_out = _build_encoder(src_word, src_length, src_vocab_size, cfg)
+
+    bos = layers.fill_constant_batch_size_like(
+        src_word, shape=[1, 1], dtype='int64', value=bos_id)
+    ids = bos
+    for _t in range(1, max_out_len):
+        logits = _decode_prefix(ids, enc_out, src_length, cfg)
+        nxt = layers.argmax(logits, axis=-1)
+        nxt = layers.reshape(x=nxt, shape=[0, 1])
+        ids = layers.concat([ids, layers.cast(nxt, 'int64')], axis=1)
+    # freeze everything after the first EOS to EOS (the beam path gets
+    # this from beam_search_decode; greedy does it arithmetically)
+    eos = layers.fill_constant_batch_size_like(
+        ids, shape=[1, max_out_len], dtype='int64', value=eos_id)
+    is_eos = layers.cast(layers.equal(x=ids, y=eos), 'int64')
+    before = layers.elementwise_sub(
+        x=layers.cumsum(is_eos, axis=1), y=is_eos)   # eos count before t
+    zeros = layers.fill_constant_batch_size_like(
+        ids, shape=[1, max_out_len], dtype='int64', value=0)
+    after = layers.cast(layers.less_than(x=zeros, y=before), 'int64')
+    keep = layers.elementwise_sub(
+        x=layers.fill_constant_batch_size_like(
+            ids, shape=[1, max_out_len], dtype='int64', value=1),
+        y=after)
+    ids = layers.elementwise_add(
+        x=layers.elementwise_mul(x=ids, y=keep),
+        y=layers.elementwise_mul(x=eos, y=after))
+    return ids, ['src_word', 'src_length']
+
+
+def transformer_beam_infer(src_vocab_size, trg_vocab_size, beam_size=4,
+                           max_out_len=16, bos_id=0, eos_id=1,
+                           src_seq_len=16, max_length=256, n_layer=6,
+                           n_head=8, d_key=64, d_value=64, d_model=512,
+                           d_inner=2048, weight_sharing=False):
+    """Unrolled beam-search decode over the beam_search/beam_gather/
+    beam_search_decode ops. Returns (sentence_ids [B, beam, T],
+    sentence_scores [B, beam])."""
+    cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
+                     n_head, d_key, d_value, d_model, d_inner,
+                     weight_sharing)
+    src_word = layers.data(name='src_word', shape=[src_seq_len],
+                           dtype='int64')
+    src_length = layers.data(name='src_length', shape=[], dtype='int64')
+    enc_out = _build_encoder(src_word, src_length, src_vocab_size, cfg)
+
+    # tile encoder state over the beam: [B, S, D] -> [B*beam, S, D]
+    enc_beam = layers.expand(layers.unsqueeze(enc_out, axes=[1]),
+                             expand_times=[1, beam_size, 1, 1])
+    enc_beam = layers.reshape(x=enc_beam, shape=[-1] +
+                              [enc_out.shape[1], enc_out.shape[2]])
+    len_beam = layers.expand(layers.unsqueeze(src_length, axes=[1]),
+                             expand_times=[1, beam_size])
+    len_beam = layers.reshape(x=len_beam, shape=[-1])
+
+    bos = layers.fill_constant_batch_size_like(
+        enc_beam, shape=[1, 1], dtype='int64', value=bos_id)
+    prefix = bos                                   # [B*beam, t]
+    pre_ids = layers.fill_constant_batch_size_like(
+        src_word, shape=[1, beam_size], dtype='int64', value=bos_id)
+    # only slot 0 live at t=0 (all beams identical otherwise): bias is
+    # (one_hot(0) - 1) * 1e9 = [0, -1e9, ...] broadcast over the batch
+    slot0 = layers.fill_constant(shape=[1, 1], dtype='int64', value=0)
+    oh = layers.reshape(x=layers.one_hot(slot0, depth=beam_size),
+                        shape=[1, beam_size])
+    init_bias = layers.scale(oh, scale=1e9, bias=-1e9)
+    ones = layers.fill_constant_batch_size_like(
+        src_word, shape=[1, beam_size], dtype='float32', value=1.0)
+    pre_scores = layers.elementwise_mul(x=ones, y=init_bias, axis=-1)
+
+    step_ids, step_parents = [], []
+    for _t in range(1, max_out_len):
+        logits = _decode_prefix(prefix, enc_beam, len_beam, cfg)
+        logp = layers.log_softmax(logits)          # [B*beam, V]
+        top_scores, top_ids = layers.topk(logp, k=beam_size)
+        cand_ids = layers.reshape(x=layers.cast(top_ids, 'int64'),
+                                  shape=[-1, beam_size, beam_size])
+        cand_scores = layers.reshape(x=top_scores,
+                                     shape=[-1, beam_size, beam_size])
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, cand_ids, cand_scores,
+            beam_size=beam_size, end_id=eos_id)
+        # realign prefixes to the selected parents and append new token
+        prefix_b = layers.reshape(x=prefix, shape=[-1, beam_size,
+                                                   prefix.shape[1]])
+        prefix_b = layers.beam_gather(prefix_b, parent)
+        prefix = layers.reshape(x=prefix_b,
+                                shape=[-1, prefix.shape[1]])
+        nxt = layers.reshape(x=sel_ids, shape=[-1, 1])
+        prefix = layers.concat([prefix, nxt], axis=1)
+        pre_ids, pre_scores = sel_ids, sel_scores
+        step_ids.append(sel_ids)
+        step_parents.append(parent)
+
+    stacked_ids = layers.stack(step_ids, axis=0)       # [T-1, B, beam]
+    stacked_parents = layers.stack(step_parents, axis=0)
+    sent, sent_scores = layers.beam_search_decode(
+        stacked_ids, stacked_parents, final_scores=pre_scores,
+        end_id=eos_id)
+    return (sent, sent_scores), ['src_word', 'src_length']

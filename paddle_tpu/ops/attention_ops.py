@@ -71,11 +71,8 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
             query_length is None and q.shape[-2] >= 512 and \
             q.shape[-2] % 512 == 0 and k.shape[-2] % 128 == 0 and \
             q.shape[-1] % 128 == 0:
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            backend = 'cpu'
-        use_pallas = backend in ('tpu', 'axon')
+        from .pallas import pallas_enabled
+        use_pallas = pallas_enabled()
     if use_pallas:
         from .pallas.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal)

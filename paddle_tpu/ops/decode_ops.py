@@ -266,3 +266,13 @@ def _beam_search_decode(ctx):
     ctx.set_output('SentenceIds', seq.astype(_i64()))
     if ctx.has_input('FinalScores'):
         ctx.set_output('SentenceScores', ctx.input('FinalScores'))
+
+
+@register('beam_gather')
+def _beam_gather(ctx):
+    """out[b, j, ...] = X[b, Index[b, j], ...] — reorders per-beam state
+    (token prefixes, caches) by parent index after a beam_search step."""
+    x = ctx.input('X')
+    idx = ctx.input('Index').astype(jnp.int32)
+    idx_e = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    ctx.set_output('Out', jnp.take_along_axis(x, idx_e, axis=1))

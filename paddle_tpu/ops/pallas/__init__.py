@@ -1,3 +1,24 @@
 """Hand-written Pallas TPU kernels for the hot ops (flash attention,
 fused normalization). Everything here has a jnp fallback so the same IR
 runs on CPU test meshes."""
+
+import os
+
+
+def pallas_enabled():
+    """Whether to dispatch hot ops to Pallas kernels.
+
+    Default: only on a directly-attached TPU backend. The 'axon' tunnel
+    backend remote-compiles Pallas kernels and (as of this image) hangs
+    on pallas_call lowering — measured: even a trivial kernel never
+    returns — so it is excluded until the relay supports it. Override
+    with PADDLE_TPU_USE_PALLAS=1/0.
+    """
+    import jax
+    env = os.environ.get('PADDLE_TPU_USE_PALLAS')
+    if env is not None:
+        return env not in ('0', 'false', 'False')
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:
+        return False

@@ -1,0 +1,84 @@
+"""Multi-host (pod-scale) runtime glue.
+
+Reference analog: the reference trains multi-machine through MPI job
+scripts + parameter servers over RDMA (paddle/pserver, go/pserver). The
+TPU-native equivalent is jax.distributed: every host runs the SAME SPMD
+program, jax.devices() spans the pod, and the Mesh lays DCN-crossing
+axes (dp) outermost while ICI-hungry axes (tp/sp) stay inside a host's
+slice (scaling-book recipe).
+
+Environment contracts supported (first match wins):
+- explicit args to init_distributed()
+- PADDLE_TRAINERS / PADDLE_TRAINER_ID / PADDLE_COORDINATOR (reference
+  fleet-style env names)
+- TPU pod metadata (jax.distributed.initialize() with no args)
+"""
+
+import os
+
+__all__ = ['init_distributed', 'is_initialized', 'global_device_mesh',
+           'host_local_batch', 'process_index', 'process_count']
+
+_initialized = False
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize jax.distributed for multi-host training. Safe to call
+    on single host (no-op when no cluster env is present)."""
+    global _initialized
+    import jax
+    if _initialized:
+        return True
+    if coordinator_address is None:
+        coordinator_address = os.environ.get('PADDLE_COORDINATOR')
+    if num_processes is None and os.environ.get('PADDLE_TRAINERS'):
+        num_processes = int(os.environ['PADDLE_TRAINERS'])
+    if process_id is None and os.environ.get('PADDLE_TRAINER_ID'):
+        process_id = int(os.environ['PADDLE_TRAINER_ID'])
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            _initialized = True
+        elif num_processes is not None and num_processes > 1:
+            jax.distributed.initialize()
+            _initialized = True
+    except Exception:
+        # single-host fallback: everything below still works on the
+        # local devices
+        _initialized = False
+    return _initialized
+
+
+def is_initialized():
+    return _initialized
+
+
+def process_index():
+    import jax
+    return jax.process_index()
+
+
+def process_count():
+    import jax
+    return jax.process_count()
+
+
+def global_device_mesh(pp=1, sp=1, tp=1, ep=1):
+    """Pod-wide mesh: dp spans hosts (DCN-friendly outer axis); pp/sp/tp/
+    ep subdivide within the pod slice (ICI). dp is inferred from the
+    global device count."""
+    from .mesh import make_mesh
+    return make_mesh(dp=None, pp=pp, sp=sp, tp=tp, ep=ep)
+
+
+def host_local_batch(global_batch):
+    """Per-host slice size of a dp-sharded global batch."""
+    import jax
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError('global batch %d not divisible by %d hosts'
+                         % (global_batch, n))
+    return global_batch // n

@@ -208,3 +208,12 @@ def test_pipeline_parallel_differentiable():
     g_ref = jax.grad(loss_ref)(jnp.asarray(ws))
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_multihost_single_host_fallbacks():
+    from paddle_tpu.parallel import multihost
+    assert multihost.init_distributed() in (True, False)
+    assert multihost.process_count() >= 1
+    assert multihost.host_local_batch(16) == 16 // multihost.process_count()
+    mesh = multihost.global_device_mesh(tp=2)
+    assert mesh.shape['tp'] == 2
