@@ -17,6 +17,7 @@ __all__ = [
     'cos_sim', 'smooth_l1', 'im2sequence', 'multiplex', 'label_smooth',
     'autoincreased_step_counter', 'nce', 'auc', 'group_norm',
     'bilinear_tensor_product', 'pad', 'relu_layer', 'maxout',
+    'row_conv', 'huber_loss', 'rank_loss', 'margin_rank_loss', 'hinge_loss', 'log_loss', 'conv_shift', 'spp', 'resize_bilinear', 'resize_nearest', 'dot', 'label_smoothed_cross_entropy',
 ]
 
 
@@ -687,3 +688,148 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     block.append_op(type='increment', inputs={'X': [counter]},
                     outputs={'Out': [counter]}, attrs={'step': float(step)})
     return counter
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (row_conv_op.cc; DeepSpeech2 streaming).
+    input: [B, T, D] dense padded."""
+    helper = LayerHelper('row_conv', **locals())
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
+    helper.append_op(type='row_conv', inputs={'X': [input], 'Filter': [w]},
+                     outputs={'Out': [out]}, attrs={})
+    return helper.append_activation(out)
+
+
+def huber_loss(input, label, delta=1.0):
+    helper = LayerHelper('huber_loss')
+    residual = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
+    helper.append_op(type='huber_loss',
+                     inputs={'X': [input], 'Y': [label]},
+                     outputs={'Out': [out], 'Residual': [residual]},
+                     attrs={'delta': delta})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (rank_loss_op.cc)."""
+    helper = LayerHelper(name or 'rank_loss')
+    out = helper.create_variable_for_type_inference(left.dtype)
+    if left.shape is not None:
+        out.shape = tuple(left.shape)
+    helper.append_op(type='rank_loss',
+                     inputs={'Label': [label], 'Left': [left],
+                             'Right': [right]},
+                     outputs={'Out': [out]}, attrs={})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper(name or 'margin_rank_loss')
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    if left.shape is not None:
+        out.shape = tuple(left.shape)
+    helper.append_op(type='margin_rank_loss',
+                     inputs={'Label': [label], 'X1': [left],
+                             'X2': [right]},
+                     outputs={'Out': [out], 'Activated': [act]},
+                     attrs={'margin': margin})
+    return out
+
+
+def hinge_loss(input, label, name=None):
+    helper = LayerHelper(name or 'hinge_loss')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
+    helper.append_op(type='hinge_loss',
+                     inputs={'Logits': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]}, attrs={})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper(name or 'log_loss')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
+    helper.append_op(type='log_loss',
+                     inputs={'Predicted': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]}, attrs={'epsilon': epsilon})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution (conv_shift_op.cc; NTM addressing)."""
+    helper = LayerHelper(name or 'conv_shift')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        out.shape = tuple(x.shape)
+    helper.append_op(type='conv_shift', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]}, attrs={})
+    return out
+
+
+def spp(input, pyramid_height=2, pool_type='max', name=None):
+    """Spatial pyramid pooling (spp_op.cc)."""
+    helper = LayerHelper(name or 'spp')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='spp', inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'pyramid_height': pyramid_height,
+                            'pooling_type': pool_type})
+    return out
+
+
+def resize_bilinear(input, out_shape, name=None):
+    helper = LayerHelper(name or 'bilinear_interp')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = (input.shape[0], input.shape[1]) + tuple(out_shape)
+    helper.append_op(type='bilinear_interp', inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'out_h': out_shape[0], 'out_w': out_shape[1]})
+    return out
+
+
+def resize_nearest(input, out_shape, name=None):
+    helper = LayerHelper(name or 'nearest_interp')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None:
+        out.shape = (input.shape[0], input.shape[1]) + tuple(out_shape)
+    helper.append_op(type='nearest_interp', inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'out_h': out_shape[0], 'out_w': out_shape[1]})
+    return out
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper(name or 'dot')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        out.shape = tuple(x.shape[:-1]) + (1,)
+    helper.append_op(type='dot', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]}, attrs={})
+    return out
+
+
+def label_smoothed_cross_entropy(logits, label, epsilon=0.1, name=None):
+    """Fused (1-eps)·CE + eps·uniform-KL loss over hard labels — the
+    efficient form of one_hot+label_smooth+softmax_with_cross_entropy."""
+    helper = LayerHelper(name or 'label_smoothed_cross_entropy')
+    out = helper.create_variable_for_type_inference('float32')
+    if logits.shape is not None:
+        out.shape = tuple(logits.shape[:-1]) + (1,)
+    helper.append_op(type='label_smoothed_cross_entropy',
+                     inputs={'Logits': [logits], 'Label': [label]},
+                     outputs={'Loss': [out]}, attrs={'epsilon': epsilon})
+    return out

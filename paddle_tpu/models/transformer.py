@@ -184,11 +184,9 @@ def transformer(src_vocab_size, trg_vocab_size, max_length=256,
 
     # label smoothing + softmax cross entropy, weighted by non-pad mask
     if label_smooth_eps:
-        smooth = layers.label_smooth(
-            label=layers.one_hot(lbl_word, depth=trg_vocab_size),
-            epsilon=label_smooth_eps)
-        cost = layers.softmax_with_cross_entropy(
-            logits=logits, label=smooth, soft_label=True)
+        # fused: never materializes the [B, T, V] smoothed one-hot
+        cost = layers.label_smoothed_cross_entropy(
+            logits=logits, label=lbl_word, epsilon=label_smooth_eps)
     else:
         lbl3 = layers.unsqueeze(lbl_word, axes=[2])
         cost = layers.softmax_with_cross_entropy(logits=logits, label=lbl3)

@@ -1,0 +1,94 @@
+"""High-level Trainer / Inferencer (reference: the v2 trainer loop
+python/paddle/v2/trainer.py SGD.train with event handlers, and the later
+fluid.Trainer shape).
+
+A thin, reader-driven loop over the Executor: batches from a v2-style
+reader (optionally prefetched to HBM), per-step/epoch events to a
+handler, checkpointing via io.save_checkpoint.
+"""
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.place import TPUPlace
+from .core.program import default_main_program, default_startup_program
+from . import io as _io
+
+__all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+           'EndStepEvent', 'Trainer']
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer(object):
+    """train_func builds the graph and returns the fetch vars (loss
+    first); optimizer_func returns the optimizer. Mirrors the reference
+    trainer's event-handler contract."""
+
+    def __init__(self, train_func, optimizer_func, place=None,
+                 checkpoint_config=None, program=None,
+                 startup_program=None):
+        self.place = place if place is not None else TPUPlace(0)
+        self.program = program or default_main_program()
+        self.startup = startup_program or default_startup_program()
+        self.fetches = train_func()
+        if not isinstance(self.fetches, (list, tuple)):
+            self.fetches = [self.fetches]
+        optimizer_func().minimize(self.fetches[0])
+        self.exe = Executor(self.place)
+        self.checkpoint_dir = checkpoint_config
+        self._step = 0
+
+    def train(self, num_epochs, event_handler=None, reader=None,
+              feed_order=None, feeder=None):
+        event_handler = event_handler or (lambda e: None)
+        self.exe.run(self.startup)
+        for epoch in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch))
+            for step, data in enumerate(reader()):
+                event_handler(BeginStepEvent(epoch, step))
+                if feeder is not None:
+                    feed = feeder.feed(data)
+                elif isinstance(data, dict):
+                    feed = data
+                else:
+                    feed = {name: np.asarray([d[i] for d in data])
+                            for i, name in enumerate(feed_order)}
+                metrics = self.exe.run(program=self.program, feed=feed,
+                                       fetch_list=self.fetches)
+                self._step += 1
+                event_handler(EndStepEvent(epoch, step, metrics))
+            event_handler(EndEpochEvent(epoch))
+            if self.checkpoint_dir:
+                _io.save_checkpoint(self.exe, self.checkpoint_dir,
+                                    main_program=self.program,
+                                    step=self._step)
+
+    def save_params(self, dirname):
+        _io.save_params(self.exe, dirname, main_program=self.program)
+
+    def save_inference_model(self, dirname, feeded_var_names,
+                             target_vars):
+        _io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                 self.exe, main_program=self.program)

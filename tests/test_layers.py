@@ -258,3 +258,19 @@ def test_smooth_l1():
     expect = np.where(np.abs(d) < 1.0, 0.5 * d * d,
                       np.abs(d) - 0.5).sum(1, keepdims=True)
     np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_label_smoothed_ce_matches_onehot_path():
+    logits = fluid.layers.data(name='lg', shape=[4, 7], dtype='float32')
+    label = fluid.layers.data(name='lb', shape=[4], dtype='int64')
+    fused = fluid.layers.label_smoothed_cross_entropy(logits, label,
+                                                      epsilon=0.1)
+    smooth = fluid.layers.label_smooth(
+        label=fluid.layers.one_hot(label, depth=7), epsilon=0.1)
+    ref = fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=smooth, soft_label=True)
+    lg = rand(2, 4, 7, seed=20)
+    lb = rand(2, 4, dtype='int64', high=7)
+    got = run_startup_and({'lg': lg, 'lb': lb}, [fused, ref])
+    np.testing.assert_allclose(got[0].ravel(), got[1].ravel(), rtol=1e-5,
+                               atol=1e-6)

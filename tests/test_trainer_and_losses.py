@@ -1,0 +1,115 @@
+"""Trainer high-level loop + the loss/misc layer wrappers (reference:
+v2/trainer.py event-handler loop; test_rank_loss_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+def test_trainer_event_loop(tmp_path):
+    events = []
+
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return [fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))]
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype('float32')
+
+    def reader():
+        for _ in range(5):
+            xs = rng.randn(8, 4).astype('float32')
+            yield {'x': xs, 'y': xs @ w}
+
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        place=fluid.CPUPlace(), checkpoint_config=str(tmp_path))
+    losses = []
+    trainer.train(num_epochs=3, event_handler=lambda e: (
+        losses.append(float(np.asarray(e.metrics[0]).reshape(())))
+        if isinstance(e, fluid.trainer.EndStepEvent) else
+        events.append(type(e).__name__)),
+        reader=reader)
+    assert events.count('BeginEpochEvent') == 3
+    assert events.count('EndEpochEvent') == 3
+    assert losses[-1] < losses[0]
+    assert (tmp_path / 'checkpoint_meta.json').exists() or \
+        len(list(tmp_path.iterdir())) > 0  # checkpoint written
+
+
+def test_huber_log_hinge_losses():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[3], dtype='float32')
+    hl = fluid.layers.huber_loss(x, y, delta=1.0)
+    xs = np.array([[0.2, 2.0, -3.0]], dtype='float32')
+    ys = np.zeros((1, 3), dtype='float32')
+    got = run_startup_and({'x': xs, 'y': ys}, [hl])[0]
+    d = ys - xs
+    expect = np.where(np.abs(d) <= 1.0, 0.5 * d * d,
+                      np.abs(d) - 0.5)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_rank_and_margin_rank_loss():
+    lbl = fluid.layers.data(name='l', shape=[1], dtype='float32')
+    left = fluid.layers.data(name='a', shape=[1], dtype='float32')
+    right = fluid.layers.data(name='b', shape=[1], dtype='float32')
+    rl = fluid.layers.rank_loss(lbl, left, right)
+    mrl = fluid.layers.margin_rank_loss(lbl, left, right, margin=0.1)
+    lv = np.array([[1.0], [0.0]], dtype='float32')
+    av = np.array([[2.0], [1.0]], dtype='float32')
+    bv = np.array([[1.0], [3.0]], dtype='float32')
+    got = run_startup_and({'l': lv, 'a': av, 'b': bv}, [rl, mrl])
+    diff = av - bv
+    expect_rl = np.log1p(np.exp(diff)) - lv * diff
+    np.testing.assert_allclose(got[0], expect_rl, rtol=1e-5)
+    # margin rank: max(0, -label*(x1-x2)+margin), label in {-1,1}-ish
+    assert np.isfinite(got[1]).all()
+
+
+def test_row_conv_and_conv_shift_and_dot():
+    x = fluid.layers.data(name='x', shape=[5, 4], dtype='float32')
+    rc = fluid.layers.row_conv(x, future_context_size=2)
+    a = fluid.layers.data(name='a', shape=[6], dtype='float32')
+    b = fluid.layers.data(name='b', shape=[3], dtype='float32')
+    cs = fluid.layers.conv_shift(a, b)
+    d = fluid.layers.dot(a, a)
+    got = run_startup_and({'x': rand(2, 5, 4), 'a': rand(2, 6),
+                           'b': rand(2, 3)}, [rc, cs, d])
+    assert got[0].shape == (2, 5, 4)
+    assert got[1].shape == (2, 6)
+    av = rand(2, 6)
+    np.testing.assert_allclose(got[2], (av * av).sum(-1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_resize_and_spp():
+    img = fluid.layers.data(name='img', shape=[2, 8, 8], dtype='float32')
+    rb = fluid.layers.resize_bilinear(img, out_shape=[16, 16])
+    rn = fluid.layers.resize_nearest(img, out_shape=[4, 4])
+    sp = fluid.layers.spp(img, pyramid_height=2)
+    got = run_startup_and({'img': rand(2, 2, 8, 8)}, [rb, rn, sp])
+    assert got[0].shape == (2, 2, 16, 16)
+    assert got[1].shape == (2, 2, 4, 4)
+    assert got[2].shape[0] == 2  # [B, C*(1+4)]
+
+
+def test_metrics_accumulate():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-6
+    p = fluid.metrics.Precision()
+    p.update(preds=np.array([[0.9], [0.2], [0.8]]),
+             labels=np.array([[1], [0], [0]]))
+    assert 0.0 <= p.eval() <= 1.0
+    auc = fluid.metrics.Auc(name='auc')
+    preds = np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8]])
+    auc.update(preds=preds, labels=np.array([[1], [0], [1]]))
+    assert 0.9 <= auc.eval() <= 1.0
