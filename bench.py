@@ -6,15 +6,59 @@ the real chip and prints ONE JSON line:
 Baselines (BASELINE.json, reference-era P100 fp32 batch 64):
 ResNet-50 ~200 img/s, Transformer base ~4500 tok/s. The headline metric is
 the geometric-mean speedup over both; `value` is Transformer tok/s.
+
+Defensive against a flaky hosted backend (round-1 failure mode: axon relay
+init raised UNAVAILABLE and the whole run produced nothing): the TPU backend
+is probed in a subprocess with retry/backoff before any in-process jax use,
+each workload is independently try/excepted, and a JSON line is ALWAYS
+printed — partial numbers (or a cpu-backend fallback) beat an empty round.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASE_RESNET_IMG_S = 200.0
 BASE_TRANSFORMER_TOK_S = 4500.0
+
+
+def _probe_backend(attempts=2, first_backoff=10.0, attempt_timeout=60.0):
+    """Probe TPU backend init in a SUBPROCESS (jax caches init failures
+    in-process, so retrying there is useless; and a hung relay init must be
+    killable). Returns the platform of the default backend ('tpu'/'axon')
+    or 'cpu' after exhausting retries. Worst case ~130s — a hung relay
+    never resolves within a retry window anyway, and the remaining driver
+    budget is needed for the cpu-fallback bench itself.
+
+    Returns (platform, degraded): degraded=True means retries were
+    exhausted (flaky relay) as opposed to the machine genuinely defaulting
+    to cpu (no TPU configured — a clean answer, not a fallback)."""
+    probe = ("import jax; d = jax.devices(); "
+             "print(d[0].platform if d else 'none')")
+    backoff = first_backoff
+    for i in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, '-c', probe],
+                               capture_output=True, text=True,
+                               timeout=attempt_timeout)
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], False
+            sys.stderr.write('bench: backend probe attempt %d/%d failed '
+                             '(rc=%s): %s\n'
+                             % (i + 1, attempts, r.returncode,
+                                (r.stderr or '').strip()[-500:]))
+        except subprocess.TimeoutExpired:
+            sys.stderr.write('bench: backend probe attempt %d/%d timed '
+                             'out after %.0fs\n'
+                             % (i + 1, attempts, attempt_timeout))
+        if i + 1 < attempts:
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 120.0)
+    return 'cpu', True
 
 
 def _fresh():
@@ -39,7 +83,7 @@ def _to_device(feed):
     return {k: jax.device_put(v) for k, v in feed.items()}
 
 
-def bench_transformer(batch=64, seq=64, vocab=32000):
+def bench_transformer(batch=64, seq=64, vocab=32000, iters=20):
     fluid = _fresh()
     from paddle_tpu.models import transformer as T
     avg_cost, _ = T.transformer_base(
@@ -56,11 +100,11 @@ def bench_transformer(batch=64, seq=64, vocab=32000):
     def step():
         return exe.run(feed=feed, fetch_list=[avg_cost], return_numpy=False)
 
-    dt = _time_steps(step)
+    dt = _time_steps(step, iters=iters)
     return batch * seq / dt
 
 
-def bench_resnet50(batch=64):
+def bench_resnet50(batch=64, image=224, iters=20):
     fluid = _fresh()
     from paddle_tpu.models.resnet import resnet50_with_loss
     _, avg_cost, _ = resnet50_with_loss()
@@ -71,32 +115,128 @@ def bench_resnet50(batch=64):
     exe.run(fluid.default_startup_program())
     rng = np.random.RandomState(0)
     feed = _to_device(
-        {'image': rng.rand(batch, 3, 224, 224).astype('float32'),
+        {'image': rng.rand(batch, 3, image, image).astype('float32'),
          'label': rng.randint(0, 1000, (batch, 1)).astype('int64')})
 
     def step():
         return exe.run(feed=feed, fetch_list=[avg_cost], return_numpy=False)
 
-    dt = _time_steps(step)
+    dt = _time_steps(step, iters=iters)
     return batch / dt
 
 
+def _run_workload_child(workload, backend, reduced):
+    """Child-process entry: run ONE workload, print 'RESULT <number>'."""
+    if backend == 'cpu':
+        from paddle_tpu.core.platform_boot import force_host_cpu
+        force_host_cpu()
+    if workload == 'transformer':
+        kw = dict(batch=8, seq=32, vocab=4096, iters=5) if reduced else {}
+        val = bench_transformer(**kw)
+    else:
+        kw = dict(batch=4, image=64, iters=5) if reduced else {}
+        val = bench_resnet50(**kw)
+    print('RESULT %r' % val, flush=True)
+
+
+def _run_workload(workload, backend, reduced, timeout):
+    """Run one workload in a watchdogged subprocess: a relay that answers
+    the probe then hangs mid-run (documented failure mode) must not take
+    the whole bench down with no JSON printed. Returns (value, error)."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--workload', workload, '--backend', backend]
+    if reduced:
+        cmd.append('--reduced')
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, 'timeout after %.0fs' % timeout
+    for line in reversed((r.stdout or '').splitlines()):
+        if line.startswith('RESULT '):
+            return float(line[len('RESULT '):]), None
+    return None, ('rc=%s: %s' % (r.returncode,
+                                 (r.stderr or '').strip()[-800:]))
+
+
 def main():
-    tok_s = bench_transformer()
-    img_s = bench_resnet50()
-    speedup = ((tok_s / BASE_TRANSFORMER_TOK_S) *
-               (img_s / BASE_RESNET_IMG_S)) ** 0.5
+    forced = os.environ.get('BENCH_BACKEND')
+    if forced:
+        backend, degraded = forced, False
+    else:
+        backend, degraded = _probe_backend()
+        if degraded:
+            sys.stderr.write('bench: TPU backend unavailable after '
+                             'retries; falling back to cpu with reduced '
+                             'shapes\n')
+    # Reduced shapes only in the unplanned-degradation case (flaky relay
+    # inside a fixed driver budget); a deliberate BENCH_BACKEND=cpu run or
+    # a genuinely-cpu machine keeps full shapes unless BENCH_REDUCED=1.
+    reduced = degraded or os.environ.get('BENCH_REDUCED') == '1'
+    timeout = 200.0 if reduced else 250.0
+
+    tok_s = img_s = None
+    errors = {}
+    tok_s, err = _run_workload('transformer', backend, reduced, timeout)
+    if err:
+        errors['transformer'] = err
+        sys.stderr.write('bench: transformer failed: %s\n' % err)
+    img_s, err = _run_workload('resnet50', backend, reduced, timeout)
+    if err:
+        errors['resnet50'] = err
+        sys.stderr.write('bench: resnet50 failed: %s\n' % err)
+
+    # vs_baseline keeps its headline meaning (geomean speedup of the two
+    # FULL-shape workloads vs the P100 baselines). Reduced shapes are a
+    # different model — emit 0.0 rather than an incomparable number.
+    ratios = []
+    if tok_s is not None:
+        ratios.append(tok_s / BASE_TRANSFORMER_TOK_S)
+    if img_s is not None:
+        ratios.append(img_s / BASE_RESNET_IMG_S)
+    if ratios and not reduced:
+        speedup = float(np.prod(ratios)) ** (1.0 / len(ratios))
+    else:
+        speedup = 0.0
+
+    if tok_s is not None:
+        metric, value, unit = ('transformer_base_train_tokens_per_sec',
+                               tok_s, 'tokens/s')
+    elif img_s is not None:
+        metric, value, unit = ('resnet50_train_images_per_sec',
+                               img_s, 'images/s')
+    else:
+        metric, value, unit = 'bench_failed', 0.0, 'n/a'
+
+    detail = {'backend': backend,
+              'backend_forced': bool(forced),
+              'reduced_shapes': reduced,
+              'baseline': {'resnet50': BASE_RESNET_IMG_S,
+                           'transformer': BASE_TRANSFORMER_TOK_S}}
+    if tok_s is not None:
+        detail['transformer_tok_per_sec'] = round(tok_s, 1)
+    if img_s is not None:
+        detail['resnet50_img_per_sec'] = round(img_s, 1)
+    if errors:
+        detail['errors'] = errors
+
     print(json.dumps({
-        'metric': 'transformer_base_train_tokens_per_sec',
-        'value': round(tok_s, 1),
-        'unit': 'tokens/s',
+        'metric': metric,
+        'value': round(value, 1),
+        'unit': unit,
         'vs_baseline': round(speedup, 3),
-        'detail': {'resnet50_img_per_sec': round(img_s, 1),
-                   'transformer_tok_per_sec': round(tok_s, 1),
-                   'baseline': {'resnet50': BASE_RESNET_IMG_S,
-                                'transformer': BASE_TRANSFORMER_TOK_S}},
+        'detail': detail,
     }))
 
 
 if __name__ == '__main__':
-    main()
+    if '--workload' in sys.argv:
+        import argparse
+        p = argparse.ArgumentParser()
+        p.add_argument('--workload', choices=['transformer', 'resnet50'])
+        p.add_argument('--backend', default='cpu')
+        p.add_argument('--reduced', action='store_true')
+        a = p.parse_args()
+        _run_workload_child(a.workload, a.backend, a.reduced)
+    else:
+        main()

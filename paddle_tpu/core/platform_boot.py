@@ -1,0 +1,29 @@
+"""Force the host-CPU jax platform despite the hosted-TPU sitecustomize.
+
+The hosted environment pins jax_platforms to 'axon,cpu' at interpreter boot
+(overriding the JAX_PLATFORMS env var), and the first device query then
+blocks initializing the axon relay when it is down. The one reliable force
+is jax.config.update BEFORE any device query. This helper is the single
+home for that dance — bench.py, __graft_entry__.py, and tests/conftest.py
+all use it so the next backend quirk is fixed in one place.
+"""
+
+import os
+
+
+def force_host_cpu(n_devices=None):
+    """Pin jax to the host CPU platform; optionally request n_devices
+    virtual devices (only effective if the backend is not yet initialized).
+
+    Safe to call after `import jax` but must run before any device query
+    (jax.devices(), first jit execution, ...).
+    """
+    if n_devices is not None:
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=%d'
+                % n_devices).strip()
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')

@@ -1,20 +1,9 @@
 """Test config: force an 8-virtual-device CPU platform BEFORE jax imports
 (SURVEY.md §4), so mesh/sharding tests run without TPU hardware."""
 
-import os
+from paddle_tpu.core.platform_boot import force_host_cpu
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in _flags:
-    os.environ['XLA_FLAGS'] = (
-        _flags + ' --xla_force_host_platform_device_count=8').strip()
-
-import jax  # noqa: E402
-
-# The hosted-TPU sitecustomize calls jax.config.update('jax_platforms',
-# 'axon,cpu') at interpreter boot, which overrides the env var — force it
-# back so tests really run on the 8-virtual-device CPU platform.
-jax.config.update('jax_platforms', 'cpu')
+force_host_cpu(8)
 
 import pytest  # noqa: E402
 
