@@ -46,7 +46,34 @@ class _Compiled(object):
         self.fetch_names = fetch_names
 
 
-def _analyze(block, ops, feed_names):
+_SUB_BLOCK_ATTRS = ('sub_block', 'true_block', 'false_block')
+
+
+def _op_reads(op, program, cache=None):
+    """All names *op* reads, including external reads made inside its
+    sub-blocks (while/rnn bodies, if_else branches). A name defined by an
+    earlier op within the same sub-block is internal and excluded, so the
+    result is exactly the set of values the op needs from its surroundings.
+    Pass a dict as *cache* to amortize the sub-block walk across passes."""
+    if cache is not None and id(op) in cache:
+        return cache[id(op)]
+    reads = list(op.input_names())
+    if program is not None:
+        for attr in _SUB_BLOCK_ATTRS:
+            idx = op.attrs.get(attr)
+            if idx is not None:
+                defined = set()
+                for sub_op in program.block(idx).ops:
+                    for n in _op_reads(sub_op, program, cache):
+                        if n not in defined:
+                            reads.append(n)
+                    defined.update(sub_op.output_names())
+    if cache is not None:
+        cache[id(op)] = reads
+    return reads
+
+
+def _analyze(block, ops, feed_names, reads_cache=None):
     """Determine scope inputs (persistable/state vars read before defined)
     and scope outputs (persistable vars written)."""
     defined = set(feed_names)
@@ -55,7 +82,7 @@ def _analyze(block, ops, feed_names):
         if op.type == 'backward_marker':
             defined.update(op.attrs['grad_names'])
             continue
-        for name in op.input_names():
+        for name in _op_reads(op, block.program, reads_cache):
             if name in defined or name in scope_in:
                 continue
             scope_in.append(name)
@@ -67,8 +94,13 @@ def _analyze(block, ops, feed_names):
     return scope_in, scope_out
 
 
-def _prune_ops(block, ops, fetch_names):
-    """Keep ops contributing to fetches or to persistable state updates."""
+def _prune_ops(block, ops, fetch_names, reads_cache=None):
+    """Keep ops contributing to fetches or to persistable state updates.
+
+    Liveness walks into sub-blocks via _op_reads: a var read only inside a
+    while/if_else body still keeps its producer alive (reference analog:
+    Prune in paddle/fluid/framework/prune.cc descends into sub-block descs).
+    """
     needed = set(fetch_names)
     kept = []
     for op in reversed(ops):
@@ -79,7 +111,7 @@ def _prune_ops(block, ops, fetch_names):
         if op.type == 'backward_marker' or writes_state or \
                 (set(op.output_names()) & needed):
             kept.append(op)
-            needed.update(op.input_names())
+            needed.update(_op_reads(op, block.program, reads_cache))
             if op.type == 'backward_marker':
                 needed.add(op.attrs['loss_name'])
     kept.reverse()
@@ -182,19 +214,21 @@ class Executor(object):
 
         block = program.global_block()
         all_ops = list(block.ops)
-        ops = _prune_ops(block, all_ops, fetch_names)
+        reads_cache = {}  # amortizes the sub-block walk across the 3 passes
+        ops = _prune_ops(block, all_ops, fetch_names, reads_cache)
 
         # Data vars actually consumed must be fed.
         consumed = set()
         for op in ops:
-            consumed.update(op.input_names())
+            consumed.update(_op_reads(op, program, reads_cache))
         needed_feeds = sorted(
             n for n in consumed
             if (lambda v: v is not None and v.is_data)(
                 block._find_var_recursive(n)))
 
         scope_in, scope_out = _analyze(block, ops, set(feed_names) | set(
-            n for n in consumed if block._find_var_recursive(n) is None))
+            n for n in consumed if block._find_var_recursive(n) is None),
+            reads_cache)
         # Drop anything that's actually a fed data var.
         scope_in = [n for n in scope_in if n not in set(feed_names)]
         # Donation-friendly: every scope input is also returned (pass-through
@@ -263,17 +297,8 @@ class Executor(object):
                 needed_after = set(fetch_names) | set(scope_out_all)
                 needed_after.add(loss_name)
 
-                def collect_reads(op_list, blocks_seen=None):
-                    for op in op_list:
-                        needed_after.update(op.input_names())
-                        for attr in ('sub_block', 'true_block',
-                                     'false_block'):
-                            idx = op.attrs.get(attr)
-                            if idx is not None:
-                                collect_reads(
-                                    program.block(idx).ops)
-
-                collect_reads(post)
+                for op in post:
+                    needed_after.update(_op_reads(op, program, reads_cache))
 
                 def fwd(p):
                     e = dict(base_env)

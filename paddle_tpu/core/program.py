@@ -326,7 +326,9 @@ class Program(object):
 
     def prune(self, targets):
         """Return a clone keeping only ops needed for target vars
-        (reference: framework/prune.cc)."""
+        (reference: framework/prune.cc). Liveness descends into
+        while/if_else sub-blocks, same as the executor's prune."""
+        from .executor import _op_reads
         target_names = set(t.name if isinstance(t, Variable) else t
                            for t in targets)
         p = self.clone()
@@ -336,7 +338,7 @@ class Program(object):
         for op in reversed(b.ops):
             if set(op.output_names()) & needed or op.type == 'backward_marker':
                 kept.append(op)
-                needed.update(op.input_names())
+                needed.update(_op_reads(op, p))
         b.ops = list(reversed(kept))
         return p
 

@@ -6,6 +6,7 @@ ABI and is compiled on first use with g++ (cached next to the sources).
 """
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -15,16 +16,35 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
-def _build_lib():
-    src = os.path.join(_HERE, 'recordio.cpp')
-    out = os.path.join(_HERE, 'librecordio.so')
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+def build_native(name):
+    """Compile paddle_tpu/native/<name>.cpp into a .so cached by source
+    content hash — a stale or foreign binary can never be loaded (no
+    prebuilt .so ships in the repo; everything is built from source)."""
+    src = os.path.join(_HERE, name + '.cpp')
+    with open(src, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    out = os.path.join(_HERE, 'lib%s-%s.so' % (name, digest))
+    if os.path.exists(out):
         return out
+    # Per-process tmp name + atomic rename: concurrent builders (e.g.
+    # pytest-xdist workers) each produce a complete .so and the last
+    # rename wins — a half-written file is never visible under `out`.
+    tmp = '%s.tmp.%d' % (out, os.getpid())
     cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-pthread',
-           src, '-o', out + '.tmp']
+           src, '-o', tmp]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(out + '.tmp', out)
+    for stale in os.listdir(_HERE):  # drop builds of older source revisions
+        if stale.startswith('lib%s-' % name) and stale.endswith('.so'):
+            try:
+                os.unlink(os.path.join(_HERE, stale))
+            except OSError:
+                pass  # another process already removed it
+    os.replace(tmp, out)
     return out
+
+
+def _build_lib():
+    return build_native('recordio')
 
 
 def load_library():

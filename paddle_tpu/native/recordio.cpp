@@ -80,6 +80,7 @@ struct Reader {
   void run() {
     std::mt19937_64 rng(seed);
     std::vector<Record> pool;  // reservoir for shuffling
+    bool failed = false;
     for (const auto& path : paths) {
       FILE* f = fopen(path.c_str(), "rb");
       if (!f) {
@@ -101,10 +102,12 @@ struct Reader {
         r.data.resize(hdr[0]);
         if (fread(r.data.data(), 1, hdr[0], f) != hdr[0]) break;
         if (crc32(r.data.data(), r.data.size()) != hdr[1]) {
-          std::lock_guard<std::mutex> lk(mu);
-          error = "recordio: crc mismatch in " + path;
-          fclose(f);
-          goto out;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            error = "recordio: crc mismatch in " + path;
+          }
+          failed = true;  // stop reading, but still drain the pool below
+          break;
         }
         if (shuffle_buf > 1) {
           if (pool.size() < shuffle_buf) {
@@ -119,10 +122,12 @@ struct Reader {
         }
         {
           std::lock_guard<std::mutex> lk(mu);
-          if (stop) { fclose(f); goto out; }
+          if (stop) failed = true;
         }
+        if (failed) break;
       }
       fclose(f);
+      if (failed) break;
     }
     // drain shuffle pool in random order
     {
@@ -135,7 +140,6 @@ struct Reader {
       std::lock_guard<std::mutex> lk(mu);
       if (stop) break;
     }
-  out:
     std::lock_guard<std::mutex> lk(mu);
     done = true;
     not_empty.notify_all();
@@ -192,8 +196,9 @@ int64_t recordio_reader_next(void* h, const uint8_t** out) {
   auto* r = static_cast<Reader*>(h);
   std::unique_lock<std::mutex> lk(r->mu);
   r->not_empty.wait(lk, [&] { return !r->ring.empty() || r->done; });
-  if (!r->error.empty()) return -1;
-  if (r->ring.empty()) return 0;
+  // Drain buffered records first: a crc failure at record N must not
+  // discard the valid records 0..N-1 already sitting in the ring.
+  if (r->ring.empty()) return r->error.empty() ? 0 : -1;
   r->current = std::move(r->ring.front());
   r->ring.pop_front();
   r->not_full.notify_one();

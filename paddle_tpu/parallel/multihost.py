@@ -36,19 +36,22 @@ def init_distributed(coordinator_address=None, num_processes=None,
         num_processes = int(os.environ['PADDLE_TRAINERS'])
     if process_id is None and os.environ.get('PADDLE_TRAINER_ID'):
         process_id = int(os.environ['PADDLE_TRAINER_ID'])
-    try:
-        if coordinator_address is not None:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
-            _initialized = True
-        elif num_processes is not None and num_processes > 1:
+    if coordinator_address is not None:
+        # An explicit coordinator means the caller REQUIRES the cluster:
+        # failing to join must surface (a silent single-host fallback would
+        # train on duplicate data and wrong global batch).
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+    elif num_processes is not None and num_processes > 1:
+        try:
             jax.distributed.initialize()
             _initialized = True
-    except Exception:
-        # single-host fallback: everything below still works on the
-        # local devices
-        _initialized = False
+        except Exception:
+            # auto-detect path only: no pod metadata → single-host
+            # fallback; everything below still works on local devices
+            _initialized = False
     return _initialized
 
 

@@ -11,7 +11,8 @@ import numpy as np
 
 from .core.executor import Executor
 from .core.place import TPUPlace
-from .core.program import default_main_program, default_startup_program
+from .core.program import (default_main_program, default_startup_program,
+                           program_guard)
 from . import io as _io
 
 __all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
@@ -52,10 +53,14 @@ class Trainer(object):
         self.place = place if place is not None else TPUPlace(0)
         self.program = program or default_main_program()
         self.startup = startup_program or default_startup_program()
-        self.fetches = train_func()
-        if not isinstance(self.fetches, (list, tuple)):
-            self.fetches = [self.fetches]
-        optimizer_func().minimize(self.fetches[0])
+        # Build into self.program/self.startup even when the caller passed
+        # custom Programs (otherwise train_func appends to the defaults and
+        # the custom Program trains an empty graph).
+        with program_guard(self.program, self.startup):
+            self.fetches = train_func()
+            if not isinstance(self.fetches, (list, tuple)):
+                self.fetches = [self.fetches]
+            optimizer_func().minimize(self.fetches[0])
         self.exe = Executor(self.place)
         self.checkpoint_dir = checkpoint_config
         self._step = 0

@@ -57,6 +57,64 @@ def test_if_else_per_example_select():
     np.testing.assert_allclose(got[1], -xs[1])
 
 
+def test_if_else_branch_reads_outer_constant():
+    """Regression: a var read ONLY inside a sub-block must keep its producer
+    alive through pruning (prune walks sub-blocks like fluid prune.cc)."""
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    zeros = fluid.layers.fill_constant_batch_size_like(
+        x, shape=[1, 1], dtype='float32', value=0.0)
+    # Produced at the parent level, consumed only inside the true branch.
+    bias = fluid.layers.fill_constant(shape=[3], dtype='float32', value=7.0)
+    row_sum = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+    cond = fluid.layers.less_than(x=zeros, y=row_sum)
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(fluid.layers.elementwise_add(x=x, y=bias))
+    with ie.false_block():
+        ie.output(fluid.layers.scale(x, scale=-1.0))
+    out, = ie()
+    xs = np.array([[1, 1, 1], [-1, -1, -1]], dtype='float32')
+    got = run_startup_and({'x': xs}, [out])[0]
+    np.testing.assert_allclose(got[0], xs[0] + 7.0)
+    np.testing.assert_allclose(got[1], -xs[1])
+
+
+def test_program_prune_keeps_sub_block_producers():
+    """Program.prune (save_inference_model path) must also walk sub-blocks."""
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    zeros = fluid.layers.fill_constant_batch_size_like(
+        x, shape=[1, 1], dtype='float32', value=0.0)
+    bias = fluid.layers.fill_constant(shape=[3], dtype='float32', value=7.0)
+    cond = fluid.layers.less_than(
+        x=zeros, y=fluid.layers.reduce_sum(x, dim=1, keep_dim=True))
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(fluid.layers.elementwise_add(x=x, y=bias))
+    with ie.false_block():
+        ie.output(fluid.layers.scale(x, scale=-1.0))
+    out, = ie()
+    pruned = fluid.default_main_program().prune([out])
+    kept_types = [op.type for op in pruned.global_block().ops]
+    assert 'fill_constant' in kept_types  # bias producer must survive
+
+
+def test_while_body_reads_outer_constant():
+    """Same regression through a While sub-block."""
+    i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype='int64', value=3)
+    step = fluid.layers.fill_constant(shape=[1], dtype='float32', value=2.5)
+    cond = fluid.layers.less_than(x=i, y=limit)
+    total = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+    w = fluid.layers.While(cond=cond)
+    with w.block():
+        fluid.layers.increment(x=i, value=1, in_place=True)
+        acc = fluid.layers.elementwise_add(x=total, y=step)
+        fluid.layers.assign(acc, total)
+        fluid.layers.less_than(x=i, y=limit, cond=cond)
+    got = run_startup_and({}, [total])[0]
+    np.testing.assert_allclose(got, [7.5])
+
+
 def test_dynamic_rnn_respects_lengths():
     x = fluid.layers.data(name='x', shape=[4, 2], dtype='float32')
     length = fluid.layers.data(name='len', shape=[], dtype='int64')

@@ -110,6 +110,18 @@ def test_recordio_multi_file_and_corruption(tmp_path):
     import pytest as _pytest
     with _pytest.raises(IOError):
         list(recordio_reader(p1)())
+    # records buffered BEFORE the corrupt one must still be delivered
+    # (the reader drains its ring before surfacing the error)
+    it = recordio_reader(p1)()
+    assert next(it) == (1,)
+    with _pytest.raises(IOError):
+        list(it)
+    # same with shuffling: valid records held in the shuffle pool when the
+    # crc error hits must drain before the error surfaces
+    it = recordio_reader(p1, shuffle_buf=64, seed=0)()
+    assert next(it) == (1,)
+    with _pytest.raises(IOError):
+        list(it)
 
 
 def test_prefetch_to_device():
