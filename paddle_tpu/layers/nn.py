@@ -81,10 +81,23 @@ def _append_bias(helper, input_var, size, axis=1):
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
-    """Embedding lookup (reference nn.py:embedding / lookup_table_op.cc)."""
+    """Embedding lookup (reference nn.py:embedding / lookup_table_op.cc).
+
+    is_sparse/is_distributed: the reference switches to SelectedRows
+    gradients + the pserver sparse-row protocol (lookup_table_op.cc,
+    go/pserver/service.go) so CTR-scale vocabs never materialize a dense
+    grad on one device. TPU-native equivalent: the table is marked for
+    ROW-SHARDING over the mesh — the transpiler lays W as P(axis, None),
+    XLA partitions the gather (local masked lookup + psum) and the dense
+    row-sharded grad + optimizer update stay local to each chip. Max vocab
+    thus scales with the mesh: ~16 GB HBM/chip / (emb_dim x 4 B x ~3 for
+    Adam moments) rows per chip x n_shards.
+    """
     helper = LayerHelper('embedding', **locals())
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype)
+    if is_sparse or is_distributed:
+        w.row_shard = True  # consumed by parallel.transpiler
     out = helper.create_variable_for_type_inference(dtype)
     in_shape = input.shape
     if in_shape is not None:

@@ -21,6 +21,7 @@ def wide_deep_net(sparse_ids, dense_feat, label, vocab_sizes,
     for i, (ids, vocab) in enumerate(zip(sparse_ids, vocab_sizes)):
         embs.append(layers.embedding(
             input=ids, size=[vocab, embed_size], dtype='float32',
+            is_sparse=True,  # CTR-scale: row-shard the table over the mesh
             param_attr=ParamAttr(name='emb_slot_%d' % i)))
     deep = layers.concat(input=embs + [dense_feat], axis=-1)
     for i, h in enumerate(hidden_sizes):
@@ -30,7 +31,7 @@ def wide_deep_net(sparse_ids, dense_feat, label, vocab_sizes,
     wides = []
     for i, (ids, vocab) in enumerate(zip(sparse_ids, vocab_sizes)):
         wides.append(layers.embedding(
-            input=ids, size=[vocab, 1], dtype='float32',
+            input=ids, size=[vocab, 1], dtype='float32', is_sparse=True,
             param_attr=ParamAttr(name='wide_slot_%d' % i)))
     wide = layers.concat(input=wides + [dense_feat], axis=-1)
 

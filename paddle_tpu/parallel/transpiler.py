@@ -22,7 +22,8 @@ from ..core.program import Parameter
 
 class ParallelStrategy(object):
     def __init__(self, data_parallel=True, tensor_parallel=False,
-                 sequence_parallel=False, tp_rules=None, sp_vars=None):
+                 sequence_parallel=False, tp_rules=None, sp_vars=None,
+                 shard_embeddings=True):
         self.data_parallel = data_parallel
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
@@ -30,6 +31,10 @@ class ParallelStrategy(object):
         # which weight dim is split over 'tp'.
         self.tp_rules = tp_rules or []
         self.sp_vars = sp_vars or []
+        # Row-shard embedding tables flagged by layers.embedding(is_sparse/
+        # is_distributed) — the pserver sparse-row role (go/pserver/
+        # service.go) done as GSPMD gather partitioning.
+        self.shard_embeddings = shard_embeddings
 
 
 def _tp_spec_for(param, rules):
@@ -42,11 +47,77 @@ def _tp_spec_for(param, rules):
     return None
 
 
+_TP_PROPAGATE = frozenset((
+    'relu', 'gelu', 'tanh', 'sigmoid', 'softsign', 'softplus', 'leaky_relu',
+    'elu', 'dropout', 'scale', 'cast', 'elementwise_add', 'elementwise_mul',
+    'elementwise_sub', 'elementwise_div'))
+
+
+def _auto_tp_specs(program):
+    """Derive Megatron column/row weight splits from the DATAFLOW, not
+    names: a mul/matmul consuming an unsharded activation gets its weight
+    column-split ('tp' on the output dim) and marks its activation
+    tp-sharded; a mul/matmul consuming a tp-sharded activation gets its
+    weight row-split (GSPMD inserts the psum), restoring replication.
+    Elementwise/activation ops propagate the marker; the bias of a
+    column-split layer is split the same way. Mis-detection only costs
+    resharding traffic — GSPMD keeps numerics exact either way."""
+    block = program.global_block()
+    specs = {}
+    tp_last = set()  # vars currently sharded 'tp' on their last dim
+    for op in block.ops:
+        if op.type in ('mul', 'matmul'):
+            xn = op.inputs.get('X', [None])[0]
+            yn = op.inputs.get('Y', [None])[0]
+            yvar = block._find_var_recursive(yn) if yn else None
+            if isinstance(yvar, Parameter) and yn not in specs:
+                ndim = len(yvar.shape)
+                if xn in tp_last:
+                    specs[yn] = P(*(['tp'] + [None] * (ndim - 1)))
+                else:
+                    specs[yn] = P(*([None] * (ndim - 1) + ['tp']))
+                    tp_last.update(op.output_names())
+        elif op.type == 'elementwise_add' and \
+                op.inputs.get('X', [None])[0] in tp_last:
+            yn = op.inputs.get('Y', [None])[0]
+            yvar = block._find_var_recursive(yn) if yn else None
+            if isinstance(yvar, Parameter) and len(yvar.shape) == 1 \
+                    and yn not in specs:
+                specs[yn] = P('tp')  # bias of a column-split layer
+            tp_last.update(op.output_names())
+        elif op.type in _TP_PROPAGATE:
+            if any(n in tp_last for n in op.input_names()):
+                tp_last.update(op.output_names())
+    return specs
+
+
+def _row_shard_axis(mesh):
+    """Mesh axis for embedding row-sharding: prefer the model-parallel
+    axis (rows stay put while dp batches move), fall back to dp."""
+    for axis in ('tp', 'ep', 'sp', 'dp'):
+        if mesh.shape.get(axis, 1) > 1:
+            return axis
+    return None
+
+
+def _row_shard_spec_for(param, mesh):
+    if not getattr(param, 'row_shard', False):
+        return None
+    axis = _row_shard_axis(mesh)
+    if axis is None:
+        return None
+    return P(*([axis] + [None] * (len(param.shape) - 1)))
+
+
 def transpile(program, mesh, strategy=None):
     """Attach shardings for `mesh` to `program` in place; returns program."""
     strategy = strategy or ParallelStrategy()
     shardings = {}
     block = program.global_block()
+
+    auto_tp = {}
+    if strategy.tensor_parallel and not strategy.tp_rules:
+        auto_tp = _auto_tp_specs(program)
 
     for var in program.list_vars():
         if var.shape is None:
@@ -54,9 +125,12 @@ def transpile(program, mesh, strategy=None):
         if isinstance(var, Parameter):
             spec = None
             if strategy.tensor_parallel:
-                spec = _tp_spec_for(var, strategy.tp_rules)
+                spec = _tp_spec_for(var, strategy.tp_rules) \
+                    if strategy.tp_rules else auto_tp.get(var.name)
+            if spec is None and strategy.shard_embeddings:
+                spec = _row_shard_spec_for(var, mesh)
             shardings[var.name] = spec if spec is not None else P()
-            if strategy.tensor_parallel and spec is not None:
+            if spec is not None:
                 shardings[var.name + GRAD_SUFFIX] = spec
         elif var.is_data and strategy.data_parallel:
             ndim = len(var.shape)
@@ -66,22 +140,34 @@ def transpile(program, mesh, strategy=None):
                 spec[1] = 'sp'
             shardings[var.name] = P(*spec)
 
-    # Optimizer accumulators follow their parameter's sharding (matched by
-    # same-shape name-prefix, e.g. fc_0.w_0_moment1_acc -> fc_0.w_0).
+    # Optimizer accumulators follow their parameter's sharding — derived
+    # STRUCTURALLY from the optimizer op (every op carrying a 'Param' input
+    # slot pairs that param with its same-shape state inputs: Moment,
+    # Velocity, ...). Name strings play no part, so colliding names
+    # cannot mis-shard (reference analog: accumulators live beside the
+    # param on its pserver shard, go/pserver/service.go).
+    for op in block.ops:
+        pnames = op.inputs.get('Param')
+        if not pnames:
+            continue
+        pvar = block._find_var_recursive(pnames[0])
+        spec = shardings.get(pnames[0])
+        if pvar is None or spec is None:
+            continue
+        for slot, names in op.inputs.items():
+            if slot in ('Param', 'Grad'):
+                continue
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable and n not in shardings \
+                        and v.shape == pvar.shape:
+                    shardings[n] = spec
+
+    # Remaining persistable state (lr, beta_pow, BN stats, ...) replicates.
     for var in program.list_vars():
-        if not var.persistable or var.shape is None:
-            continue
-        if var.name in shardings:
-            continue
-        matched = None
-        for pname, spec in list(shardings.items()):
-            if pname != var.name and var.name.startswith(pname + '_') and \
-                    isinstance(block._find_var_recursive(pname), Parameter):
-                pvar = block._find_var_recursive(pname)
-                if pvar.shape == var.shape:
-                    matched = spec
-                    break
-        shardings[var.name] = matched if matched is not None else P()
+        if var.persistable and var.shape is not None \
+                and var.name not in shardings:
+            shardings[var.name] = P()
 
     program.var_shardings.update(shardings)
     program.mesh = mesh

@@ -69,6 +69,46 @@ def test_tensor_parallel_matches_unsharded():
     np.testing.assert_allclose(w1_1, w1_tp, rtol=1e-4, atol=1e-5)
 
 
+def _train_wide_deep(mesh=None, strategy=None, steps=3, vocab=64):
+    """Wide&Deep (is_sparse embeddings) for the row-sharding parity check."""
+    from paddle_tpu.models import wide_deep
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    predict, avg_cost, acc, feeds = wide_deep.build(
+        num_slots=4, vocab_size=vocab, dense_dim=5, embed_size=8)
+    fluid.default_main_program().random_seed = 11
+    fluid.optimizer.Adagrad(learning_rate=0.05).minimize(avg_cost)
+    if mesh is not None:
+        transpile(fluid.default_main_program(), mesh, strategy)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    feed = {'C%d' % i: rng.randint(0, vocab, (16, 1)).astype('int64')
+            for i in range(4)}
+    feed['dense'] = rng.rand(16, 5).astype('float32')
+    feed['label'] = rng.randint(0, 2, (16, 1)).astype('int64')
+    final = None
+    for _ in range(steps):
+        final = exe.run(feed=feed, fetch_list=[avg_cost])
+    emb = np.asarray(fluid.global_scope().find('emb_slot_0'))
+    return float(np.asarray(final[0])), emb
+
+
+def test_row_sharded_embedding_matches_unsharded():
+    """is_sparse tables row-sharded over tp must train identically to the
+    replicated run (the pserver sparse-row role via GSPMD gather)."""
+    loss_1, emb_1 = _train_wide_deep(mesh=None)
+    mesh = make_mesh(dp=2, tp=4)
+    loss_sh, emb_sh = _train_wide_deep(
+        mesh=mesh, strategy=ParallelStrategy(data_parallel=True))
+    # the transpiled program must actually row-shard the tables
+    sh = fluid.default_main_program().var_shardings
+    assert sh['emb_slot_0'] == ('tp',) or sh['emb_slot_0'][0] == 'tp'
+    assert sh['wide_slot_0'][0] == 'tp'
+    assert abs(loss_1 - loss_sh) < 1e-4
+    np.testing.assert_allclose(emb_1, emb_sh, rtol=1e-4, atol=1e-5)
+
+
 def test_ring_attention_equals_full_attention():
     from paddle_tpu.parallel.ring_attention import ring_attention
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -144,6 +184,50 @@ def test_transpiler_attaches_shardings():
     assert moment_names
     for n in moment_names:
         assert sh[n] == sh['w1']
+
+
+def test_auto_tp_matches_unsharded():
+    """tensor_parallel with NO tp_rules: Megatron col/row pairing derived
+    from the op graph must still train identically to unsharded."""
+    loss_1, w1_1 = _train_k_steps(mesh=None)
+    mesh = make_mesh(dp=2, tp=4)
+    strategy = ParallelStrategy(data_parallel=True, tensor_parallel=True)
+    loss_tp, w1_tp = _train_k_steps(mesh=mesh, strategy=strategy)
+    sh = fluid.default_main_program().var_shardings
+    assert sh['w1'][-1] == 'tp'   # first fc: column split
+    assert sh['w2'][0] == 'tp'    # second fc: row split
+    assert sh['b1'] == ('tp',)    # column-split layer's bias follows
+    assert abs(loss_1 - loss_tp) < 1e-4
+    np.testing.assert_allclose(w1_1, w1_tp, rtol=1e-4, atol=1e-5)
+
+
+def test_accumulator_sharding_survives_colliding_names():
+    """Params named so prefix-matching would pair accumulators with the
+    WRONG param ('w' vs 'w_x', same shape, different specs): structural
+    matching keys on the optimizer op, so each velocity follows its own
+    param."""
+    x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+    h = fluid.layers.fc(input=x, size=16, act='relu',
+                        param_attr=fluid.ParamAttr(name='w'),
+                        bias_attr=False)
+    out = fluid.layers.fc(input=h, size=16,
+                          param_attr=fluid.ParamAttr(name='w_x'),
+                          bias_attr=False)
+    loss = fluid.layers.mean(out)
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    # Force same shapes but different specs via explicit rules.
+    mesh = make_mesh(dp=2, tp=4)
+    strategy = ParallelStrategy(
+        data_parallel=True, tensor_parallel=True,
+        tp_rules=[('w_x', 0), ('w', 1)])
+    prog = transpile(fluid.default_main_program(), mesh, strategy)
+    sh = prog.var_shardings
+    block = prog.global_block()
+    for op in block.ops:
+        if op.inputs.get('Param') and op.inputs.get('Velocity'):
+            pname = op.inputs['Param'][0]
+            vname = op.inputs['Velocity'][0]
+            assert sh[vname] == sh[pname], (pname, vname)
 
 
 def test_dryrun_multichip_entrypoint():
