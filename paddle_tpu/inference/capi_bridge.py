@@ -1,0 +1,54 @@
+"""Python side of the inference C ABI (paddle_tpu/native/capi.{h,cpp}).
+
+The embedded interpreter calls `create` / `run`; tensors cross the
+boundary as (name, dtype_code, shape, bytes) tuples so neither side
+needs the numpy C API. Reference analog: paddle/capi/Arguments.cpp
+marshals Matrix/IVector into the GradientMachine — here the marshalled
+arrays go straight into the XLA-compiled Predictor.
+"""
+
+import os
+
+import numpy as np
+
+# Mirrors paddle_dtype in capi.h.
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32, 3: np.float64,
+           4: np.uint8, 5: np.bool_}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def _maybe_force_platform():
+    plat = os.environ.get('PADDLE_TPU_CAPI_PLATFORM')
+    if plat:
+        import jax
+        try:
+            jax.config.update('jax_platforms', plat)
+        except RuntimeError:
+            pass  # backend already initialized; keep whatever it chose
+
+
+def create(model_dir):
+    """Load a saved inference model; returns the Predictor instance."""
+    _maybe_force_platform()
+    from .predictor import Predictor
+    return Predictor(model_dir)
+
+
+def run(pred, feed_items):
+    """feed_items: list of (name, dtype_code, shape_tuple, bytes).
+    Returns list of (dtype_code, shape_tuple, bytes) per fetch target."""
+    feed = {}
+    for name, code, shape, raw in feed_items:
+        arr = np.frombuffer(raw, dtype=_DTYPES[int(code)])
+        feed[name] = arr.reshape(tuple(int(s) for s in shape))
+    outs = pred.predict(feed)
+    result = []
+    for out in outs:
+        arr = np.ascontiguousarray(np.asarray(out))
+        code = _CODES.get(arr.dtype)
+        if code is None:  # e.g. bf16 fetches surface as float32
+            arr = arr.astype(np.float32)
+            code = _CODES[arr.dtype]
+        result.append((int(code), tuple(int(s) for s in arr.shape),
+                       arr.tobytes()))
+    return result

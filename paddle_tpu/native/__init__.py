@@ -16,13 +16,16 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
-def build_native(name):
+def build_native(name, extra_flags=()):
     """Compile paddle_tpu/native/<name>.cpp into a .so cached by source
     content hash — a stale or foreign binary can never be loaded (no
     prebuilt .so ships in the repo; everything is built from source)."""
     src = os.path.join(_HERE, name + '.cpp')
+    hasher = hashlib.sha256()
     with open(src, 'rb') as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+        hasher.update(f.read())
+    hasher.update(' '.join(extra_flags).encode())
+    digest = hasher.hexdigest()[:12]
     out = os.path.join(_HERE, 'lib%s-%s.so' % (name, digest))
     if os.path.exists(out):
         return out
@@ -31,7 +34,7 @@ def build_native(name):
     # rename wins — a half-written file is never visible under `out`.
     tmp = '%s.tmp.%d' % (out, os.getpid())
     cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-pthread',
-           src, '-o', tmp]
+           src, '-o', tmp] + list(extra_flags)
     subprocess.run(cmd, check=True, capture_output=True)
     for stale in os.listdir(_HERE):  # drop builds of older source revisions
         if stale.startswith('lib%s-' % name) and stale.endswith('.so'):
@@ -45,6 +48,19 @@ def build_native(name):
 
 def _build_lib():
     return build_native('recordio')
+
+
+def python_embed_flags():
+    """g++ flags to embed the CPython interpreter (for capi.cpp)."""
+    out = subprocess.run(
+        ['python3-config', '--includes', '--ldflags', '--embed'],
+        check=True, capture_output=True, text=True)
+    return out.stdout.split()
+
+
+def build_capi():
+    """Build the inference C ABI library (capi.h / capi.cpp)."""
+    return build_native('capi', tuple(python_embed_flags()))
 
 
 def load_library():

@@ -1,0 +1,260 @@
+// paddle_tpu inference C ABI — implementation.
+//
+// Reference analog: paddle/capi/{gradient_machine,Arguments,Matrix}.cpp
+// wrap the C++ GradientMachine; here the "machine" is the XLA-compiled
+// Predictor (paddle_tpu/inference/predictor.py), reached through an
+// embedded CPython interpreter. Marshalling crosses the boundary as raw
+// bytes (the bridge re-views them as numpy arrays), so neither side needs
+// the numpy C API.
+//
+// Build: handled by paddle_tpu.native.build_native('capi', python flags).
+
+#include "capi.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+paddle_error fail(paddle_error code, const std::string& msg) {
+  g_last_error = msg;
+  return code;
+}
+
+std::string py_exc_string() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string out = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      out = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return out;
+}
+
+struct Predictor {
+  PyObject* bridge = nullptr;   // paddle_tpu.inference.capi_bridge module
+  PyObject* py_pred = nullptr;  // Predictor instance
+  // Output buffers from the last run; tensors point into bufs.
+  std::vector<std::vector<uint8_t>> bufs;
+  std::vector<paddle_tensor> tensors;
+};
+
+bool g_initialized = false;
+
+size_t dtype_size(paddle_dtype d) {
+  switch (d) {
+    case PD_FLOAT32: return 4;
+    case PD_INT64: return 8;
+    case PD_INT32: return 4;
+    case PD_FLOAT64: return 8;
+    case PD_UINT8: return 1;
+    case PD_BOOL: return 1;
+  }
+  return 0;
+}
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+paddle_error paddle_tpu_init(const char* platform) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL taken by Py_InitializeEx so every later entry
+    // (any thread, including this one) uniformly uses PyGILState_Ensure.
+    PyEval_SaveThread();
+  }
+  GIL gil;
+  if (platform != nullptr && *platform != '\0') {
+    // Consumed by the bridge before it touches the jax backend.
+    PyObject* os = PyImport_ImportModule("os");
+    if (os == nullptr) return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    PyObject* environ = PyObject_GetAttrString(os, "environ");
+    Py_DECREF(os);
+    if (environ == nullptr) return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    PyObject* r = PyObject_CallMethod(environ, "__setitem__", "ss",
+                                      "PADDLE_TPU_CAPI_PLATFORM", platform);
+    Py_DECREF(environ);
+    if (r == nullptr) return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    Py_DECREF(r);
+  }
+  g_initialized = true;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_predictor_create(const char* model_dir,
+                                     paddle_predictor* out) {
+  if (model_dir == nullptr || out == nullptr)
+    return fail(kPD_NULLPTR, "model_dir/out is NULL");
+  if (!g_initialized) {
+    paddle_error e = paddle_tpu_init(nullptr);
+    if (e != kPD_NO_ERROR) return e;
+  }
+  GIL gil;
+  PyObject* bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (bridge == nullptr)
+    return fail(kPD_PROTOBUF_ERROR,
+                "cannot import paddle_tpu (set PYTHONPATH): " +
+                    py_exc_string());
+  PyObject* pred =
+      PyObject_CallMethod(bridge, "create", "s", model_dir);
+  if (pred == nullptr) {
+    std::string msg = py_exc_string();
+    Py_DECREF(bridge);
+    return fail(kPD_PROTOBUF_ERROR, "load failed: " + msg);
+  }
+  auto* p = new Predictor();
+  p->bridge = bridge;
+  p->py_pred = pred;
+  *out = p;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_predictor_run(paddle_predictor pred, int32_t n_inputs,
+                                  const char** input_names,
+                                  const paddle_tensor* inputs) {
+  if (pred == nullptr) return fail(kPD_NULLPTR, "predictor is NULL");
+  if (n_inputs > 0 && (input_names == nullptr || inputs == nullptr))
+    return fail(kPD_NULLPTR, "input_names/inputs is NULL");
+  auto* p = static_cast<Predictor*>(pred);
+  GIL gil;
+
+  // feed: list of (name, dtype, shape-tuple, bytes)
+  PyObject* feed = PyList_New(n_inputs);
+  if (feed == nullptr) return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+  for (int32_t i = 0; i < n_inputs; i++) {
+    const paddle_tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > PD_MAX_NDIM) {
+      Py_DECREF(feed);
+      return fail(kPD_OUT_OF_RANGE, "tensor ndim out of range");
+    }
+    size_t elems = 1;
+    PyObject* shape = PyTuple_New(t.ndim);
+    for (int32_t d = 0; d < t.ndim; d++) {
+      elems *= static_cast<size_t>(t.shape[d]);
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    size_t nbytes = elems * dtype_size(t.dtype);
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        static_cast<const char*>(t.data), static_cast<Py_ssize_t>(nbytes));
+    PyObject* item = Py_BuildValue("(siNN)", input_names[i],
+                                   static_cast<int>(t.dtype), shape, bytes);
+    if (item == nullptr) {
+      Py_DECREF(feed);
+      return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    }
+    PyList_SET_ITEM(feed, i, item);
+  }
+
+  PyObject* result =
+      PyObject_CallMethod(p->bridge, "run", "OO", p->py_pred, feed);
+  Py_DECREF(feed);
+  if (result == nullptr)
+    return fail(kPD_UNDEFINED_ERROR, "run failed: " + py_exc_string());
+
+  // result: list of (dtype, shape-tuple, bytes) — copy out, then the
+  // Python objects can go.
+  p->bufs.clear();
+  p->tensors.clear();
+  Py_ssize_t n = PyList_Size(result);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* item = PyList_GetItem(result, i);  // borrowed
+    int dtype = 0;
+    PyObject* shape = nullptr;
+    PyObject* bytes = nullptr;
+    if (!PyArg_ParseTuple(item, "iOO", &dtype, &shape, &bytes)) {
+      Py_DECREF(result);
+      return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    }
+    paddle_tensor t;
+    std::memset(&t, 0, sizeof(t));
+    t.dtype = static_cast<paddle_dtype>(dtype);
+    t.ndim = static_cast<int32_t>(PyTuple_Size(shape));
+    if (t.ndim > PD_MAX_NDIM) {
+      Py_DECREF(result);
+      return fail(kPD_OUT_OF_RANGE, "output ndim > PD_MAX_NDIM");
+    }
+    for (int32_t d = 0; d < t.ndim; d++)
+      t.shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    char* data = nullptr;
+    Py_ssize_t nbytes = 0;
+    if (PyBytes_AsStringAndSize(bytes, &data, &nbytes) != 0) {
+      Py_DECREF(result);
+      return fail(kPD_UNDEFINED_ERROR, py_exc_string());
+    }
+    p->bufs.emplace_back(data, data + nbytes);
+    t.data = p->bufs.back().data();
+    p->tensors.push_back(t);
+  }
+  Py_DECREF(result);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_predictor_output_count(paddle_predictor pred,
+                                           int32_t* count) {
+  if (pred == nullptr || count == nullptr)
+    return fail(kPD_NULLPTR, "predictor/count is NULL");
+  auto* p = static_cast<Predictor*>(pred);
+  *count = static_cast<int32_t>(p->tensors.size());
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_predictor_output(paddle_predictor pred, int32_t idx,
+                                     paddle_tensor* out) {
+  if (pred == nullptr || out == nullptr)
+    return fail(kPD_NULLPTR, "predictor/out is NULL");
+  auto* p = static_cast<Predictor*>(pred);
+  if (idx < 0 || static_cast<size_t>(idx) >= p->tensors.size())
+    return fail(kPD_OUT_OF_RANGE, "output index out of range");
+  *out = p->tensors[idx];
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_predictor_destroy(paddle_predictor pred) {
+  if (pred == nullptr) return fail(kPD_NULLPTR, "predictor is NULL");
+  auto* p = static_cast<Predictor*>(pred);
+  {
+    GIL gil;
+    Py_XDECREF(p->py_pred);
+    Py_XDECREF(p->bridge);
+  }
+  delete p;
+  return kPD_NO_ERROR;
+}
+
+const char* paddle_last_error_message(void) { return g_last_error.c_str(); }
+
+const char* paddle_error_string(paddle_error err) {
+  switch (err) {
+    case kPD_NO_ERROR: return "no error";
+    case kPD_NULLPTR: return "null pointer";
+    case kPD_OUT_OF_RANGE: return "out of range";
+    case kPD_PROTOBUF_ERROR: return "model load error";
+    case kPD_NOT_SUPPORTED: return "not supported";
+    case kPD_UNDEFINED_ERROR: return "undefined error";
+  }
+  return "unknown";
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+/* paddle_tpu inference C ABI.
+ *
+ * Reference analog: paddle/capi/capi.h:1-32 (error.h, gradient_machine.h,
+ * arguments/matrix accessors) — create a machine from a saved model,
+ * forward, fetch outputs. TPU-native: the library embeds the CPython/JAX
+ * runtime and drives the XLA-compiled Predictor, so a plain C program
+ * gets the same AOT-compiled inference path Python users get. Repeated
+ * runs with a stable input signature are cached XLA dispatches.
+ *
+ * Thread model: calls are serialized on the embedded interpreter's GIL.
+ * Output buffers are owned by the predictor and stay valid until the next
+ * paddle_predictor_run / paddle_predictor_destroy on that predictor.
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  kPD_NO_ERROR = 0,
+  kPD_NULLPTR = 1,
+  kPD_OUT_OF_RANGE = 2,
+  kPD_PROTOBUF_ERROR = 3,
+  kPD_NOT_SUPPORTED = 4,
+  kPD_UNDEFINED_ERROR = -1,
+} paddle_error;
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_INT64 = 1,
+  PD_INT32 = 2,
+  PD_FLOAT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+} paddle_dtype;
+
+#define PD_MAX_NDIM 8
+
+typedef struct {
+  paddle_dtype dtype;
+  int32_t ndim;
+  int64_t shape[PD_MAX_NDIM];
+  void* data; /* row-major, dense */
+} paddle_tensor;
+
+typedef void* paddle_predictor;
+
+/* Start (or attach to) the embedded Python/JAX runtime. Optional —
+ * paddle_predictor_create calls it implicitly. `platform` may be NULL
+ * (auto), "tpu" or "cpu". */
+paddle_error paddle_tpu_init(const char* platform);
+
+/* Load a model saved by fluid.io.save_inference_model(dirname, ...). */
+paddle_error paddle_predictor_create(const char* model_dir,
+                                     paddle_predictor* out);
+
+/* Run inference. inputs[i] pairs with input_names[i]; data is copied in,
+ * so caller buffers may be freed immediately after the call returns. */
+paddle_error paddle_predictor_run(paddle_predictor pred, int32_t n_inputs,
+                                  const char** input_names,
+                                  const paddle_tensor* inputs);
+
+/* Number of fetch outputs of the loaded model. */
+paddle_error paddle_predictor_output_count(paddle_predictor pred,
+                                           int32_t* count);
+
+/* Fetch output #idx from the last run. `out->data` points into
+ * predictor-owned memory (valid until the next run/destroy). */
+paddle_error paddle_predictor_output(paddle_predictor pred, int32_t idx,
+                                     paddle_tensor* out);
+
+paddle_error paddle_predictor_destroy(paddle_predictor pred);
+
+/* Human-readable message for the LAST error returned on this thread
+ * (empty string if none). */
+const char* paddle_last_error_message(void);
+
+const char* paddle_error_string(paddle_error err);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H_ */

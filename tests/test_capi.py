@@ -1,0 +1,104 @@
+"""Inference C ABI: a plain C program loads a saved model through
+libcapi.so (embedded Python/JAX runtime) and classifies. Reference:
+paddle/capi/tests + paddle/capi/examples/model_inference."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_CLIENT = r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "capi.h"
+
+#define CHECK(expr) do { paddle_error e_ = (expr); if (e_ != kPD_NO_ERROR) { \
+  fprintf(stderr, "%s -> %s: %s\n", #expr, paddle_error_string(e_), \
+          paddle_last_error_message()); exit(1); } } while (0)
+
+int main(int argc, char** argv) {
+  CHECK(paddle_tpu_init("cpu"));
+  paddle_predictor pred;
+  CHECK(paddle_predictor_create(argv[1], &pred));
+
+  float x[2 * 4];
+  for (int i = 0; i < 8; i++) x[i] = (i < 4) ? 1.0f : -1.0f;
+  paddle_tensor in;
+  in.dtype = PD_FLOAT32;
+  in.ndim = 2;
+  in.shape[0] = 2;
+  in.shape[1] = 4;
+  in.data = x;
+  const char* names[] = {"x"};
+  CHECK(paddle_predictor_run(pred, 1, names, &in));
+
+  int32_t n;
+  CHECK(paddle_predictor_output_count(pred, &n));
+  printf("outputs=%d\n", n);
+  paddle_tensor out;
+  CHECK(paddle_predictor_output(pred, 0, &out));
+  printf("shape=%lld,%lld\n", (long long)out.shape[0],
+         (long long)out.shape[1]);
+  const float* p = (const float*)out.data;
+  for (int r = 0; r < 2; r++) {
+    int best = 0;
+    for (int c = 1; c < out.shape[1]; c++)
+      if (p[r * out.shape[1] + c] > p[r * out.shape[1] + best]) best = c;
+    printf("row%d argmax=%d prob=%.4f\n", r, best,
+           p[r * out.shape[1] + best]);
+  }
+  CHECK(paddle_predictor_destroy(pred));
+  printf("OK\n");
+  return 0;
+}
+'''
+
+
+def _save_tiny_classifier(dirname):
+    """2-class linear classifier with hand-set weights so the C client's
+    expected argmax is deterministic: class1 iff sum(x) > 0."""
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    prob = fluid.layers.fc(input=x, size=2, act='softmax',
+                           param_attr=fluid.ParamAttr(name='cap_w'),
+                           bias_attr=fluid.ParamAttr(name='cap_b'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w = np.zeros((4, 2), dtype='float32')
+    w[:, 1] = 1.0  # logit1 = sum(x), logit0 = 0
+    fluid.global_scope().set('cap_w', w)
+    fluid.global_scope().set('cap_b', np.zeros(2, dtype='float32'))
+    fluid.io.save_inference_model(dirname, ['x'], [prob], exe)
+
+
+@pytest.mark.skipif(sys.platform != 'linux', reason='embed build is linux')
+def test_c_client_classifies(tmp_path):
+    from paddle_tpu.native import build_capi
+    model_dir = str(tmp_path / 'model')
+    _save_tiny_classifier(model_dir)
+
+    so = build_capi()
+    src = tmp_path / 'client.c'
+    src.write_text(C_CLIENT)
+    exe_path = str(tmp_path / 'client')
+    subprocess.run(
+        ['gcc', str(src), '-I', os.path.join(REPO, 'paddle_tpu', 'native'),
+         so, '-o', exe_path, '-Wl,-rpath,' + os.path.dirname(so)],
+        check=True, capture_output=True)
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run([exe_path, model_dir], capture_output=True,
+                       text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert 'outputs=1' in lines[0]
+    assert 'shape=2,2' in lines[1]
+    assert 'row0 argmax=1' in lines[2]  # sum=+4 -> class 1
+    assert 'row1 argmax=0' in lines[3]  # sum=-4 -> class 0
+    assert lines[-1] == 'OK'
