@@ -101,12 +101,12 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         return result
 
 
-_gradient_clip_attr = None
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    global _gradient_clip_attr
-    _gradient_clip_attr = clip
+    """Attach a default clip strategy to `program` (not process-global:
+    a second Program built in the same process must not inherit it)."""
+    from .core.program import default_main_program
+    program = program if program is not None else default_main_program()
+    program._gradient_clip_attr = clip
     if param_list is not None:
         for p in param_list:
             if hasattr(p, 'gradient_clip_attr'):
@@ -114,12 +114,14 @@ def set_gradient_clip(clip, param_list=None, program=None):
 
 
 def append_gradient_clip_ops(param_grads):
+    from .core.program import default_main_program
     helper = LayerHelper('gradient_clip')
+    program_clip = getattr(default_main_program(),
+                           '_gradient_clip_attr', None)
     res = []
     global_clips = {}
     for p, g in param_grads:
-        clip_attr = getattr(p, 'gradient_clip_attr', None) or \
-            _gradient_clip_attr
+        clip_attr = getattr(p, 'gradient_clip_attr', None) or program_clip
         if clip_attr is None:
             res.append((p, g))
             continue

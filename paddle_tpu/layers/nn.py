@@ -18,6 +18,7 @@ __all__ = [
     'autoincreased_step_counter', 'nce', 'auc', 'group_norm',
     'bilinear_tensor_product', 'pad', 'relu_layer', 'maxout',
     'row_conv', 'huber_loss', 'rank_loss', 'margin_rank_loss', 'hinge_loss', 'log_loss', 'conv_shift', 'spp', 'resize_bilinear', 'resize_nearest', 'dot', 'label_smoothed_cross_entropy',
+    'lrn', 'crop', 'roi_pool', 'max_pool2d_with_index', 'unpool', 'sign', 'l1_norm', 'squared_l2_norm', 'squared_l2_distance', 'modified_huber_loss', 'precision_recall', 'positive_negative_pair',
 ]
 
 
@@ -846,3 +847,186 @@ def label_smoothed_cross_entropy(logits, label, epsilon=0.1, name=None):
                      inputs={'Logits': [logits], 'Label': [label]},
                      outputs={'Loss': [out]}, attrs={'epsilon': epsilon})
     return out
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None):
+    """Local response normalization across channels (lrn_op.cc:145-185)."""
+    helper = LayerHelper('lrn', **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type='lrn', inputs={'X': [input]},
+                     outputs={'Out': [out], 'MidOut': [mid]},
+                     attrs={'n': n, 'k': k, 'alpha': alpha, 'beta': beta})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop x to `shape` at `offsets` (crop_op.cc:57-71). `shape` may be
+    a Variable whose shape is the crop target."""
+    helper = LayerHelper('crop', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {'X': [x]}
+    attrs = {}
+    if hasattr(shape, 'name'):  # Variable reference target
+        inputs['Y'] = [shape]
+        out.shape = shape.shape
+    else:
+        attrs['shape'] = list(shape)
+        out.shape = tuple(shape)
+    attrs['offsets'] = list(offsets) if offsets is not None else None
+    helper.append_op(type='crop', inputs=inputs, outputs={'Out': [out]},
+                     attrs=attrs)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """Max-pool each ROI rectangle to a fixed grid (roi_pool_op.cc:104-140).
+    rois: int64 [R, 5] rows of (batch_id, x1, y1, x2, y2)."""
+    helper = LayerHelper('roi_pool', **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference('int64')
+    if rois.shape is not None and input.shape is not None:
+        out.shape = (rois.shape[0], input.shape[1], pooled_height,
+                     pooled_width)
+    helper.append_op(
+        type='roi_pool', inputs={'X': [input], 'ROIs': [rois]},
+        outputs={'Out': [out], 'Argmax': [argmax]},
+        attrs={'pooled_height': pooled_height, 'pooled_width': pooled_width,
+               'spatial_scale': spatial_scale})
+    return out
+
+
+def max_pool2d_with_index(input, ksize, strides=None, paddings=None):
+    """Max pool returning (out, mask-of-argmax) (pool_with_index_op.cc);
+    the mask feeds unpool."""
+    helper = LayerHelper('max_pool2d_with_index', **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='max_pool2d_with_index', inputs={'X': [input]},
+        outputs={'Out': [out], 'Mask': [mask]},
+        attrs={'ksize': list(ksize),
+               'strides': list(strides or [1, 1]),
+               'paddings': list(paddings or [0, 0])})
+    return out, mask
+
+
+def unpool(input, indices, ksize, strides=None, paddings=None):
+    """Max-unpool: scatter values to their recorded argmax positions
+    (unpool_op.cc:23-55)."""
+    helper = LayerHelper('unpool', **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='unpool', inputs={'X': [input], 'Indices': [indices]},
+        outputs={'Out': [out]},
+        attrs={'ksize': list(ksize),
+               'strides': list(strides or [1, 1]),
+               'paddings': list(paddings or [0, 0])})
+    return out
+
+
+def sign(x):
+    """Elementwise sign (sign_op.cc)."""
+    helper = LayerHelper('sign', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type='sign', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def l1_norm(x):
+    """sum(|x|) over all elements (l1_norm_op.cc)."""
+    helper = LayerHelper('l1_norm', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = (1,)
+    helper.append_op(type='l1_norm', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def squared_l2_norm(x):
+    """sum(x^2) over all elements (squared_l2_norm_op.cc)."""
+    helper = LayerHelper('squared_l2_norm', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = (1,)
+    helper.append_op(type='squared_l2_norm', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def squared_l2_distance(x, y):
+    """Row-wise sum((x-y)^2) (squared_l2_distance_op.cc)."""
+    helper = LayerHelper('squared_l2_distance', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    sub = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None:
+        out.shape = (x.shape[0], 1)
+    helper.append_op(type='squared_l2_distance',
+                     inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out], 'sub_result': [sub]})
+    return out
+
+
+def modified_huber_loss(x, y):
+    """Binary-classification modified Huber loss
+    (modified_huber_loss_op.h:37-72); y in {0, 1}."""
+    helper = LayerHelper('modified_huber_loss', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inter = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type='modified_huber_loss',
+                     inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out], 'IntermediateVal': [inter]})
+    return out
+
+
+def precision_recall(indices, labels, class_number, weights=None,
+                     states_info=None):
+    """Multi-class precision/recall/F1 metrics + TP/FP/TN/FN states
+    (precision_recall_op.cc:95-140). Returns (batch_metrics [6],
+    accum_metrics [6], accum_states [class_number, 4])."""
+    helper = LayerHelper('precision_recall', **locals())
+    batch = helper.create_variable_for_type_inference('float32')
+    accum = helper.create_variable_for_type_inference('float32')
+    states = helper.create_variable_for_type_inference('float32')
+    batch.shape = accum.shape = (6,)
+    states.shape = (class_number, 4)
+    inputs = {'Indices': [indices], 'Labels': [labels]}
+    if weights is not None:
+        inputs['Weights'] = [weights]
+    if states_info is not None:
+        inputs['StatesInfo'] = [states_info]
+    helper.append_op(
+        type='precision_recall', inputs=inputs,
+        outputs={'BatchMetrics': [batch], 'AccumMetrics': [accum],
+                 'AccumStatesInfo': [states]},
+        attrs={'class_number': class_number})
+    return batch, accum, states
+
+
+def positive_negative_pair(score, label, qid, weight=None, column=0,
+                           accum=None):
+    """Ranking pair counts per query (positive_negative_pair_op.cc:100-150).
+    Returns (positive, negative, neutral) [1] each; pass accum=(p, n, u)
+    to accumulate across batches."""
+    helper = LayerHelper('positive_negative_pair', **locals())
+    pos = helper.create_variable_for_type_inference('float32')
+    neg = helper.create_variable_for_type_inference('float32')
+    neu = helper.create_variable_for_type_inference('float32')
+    pos.shape = neg.shape = neu.shape = (1,)
+    inputs = {'Score': [score], 'Label': [label], 'QueryID': [qid]}
+    if weight is not None:
+        inputs['Weight'] = [weight]
+    if accum is not None:
+        inputs['AccumulatePositivePair'] = [accum[0]]
+        inputs['AccumulateNegativePair'] = [accum[1]]
+        inputs['AccumulateNeutralPair'] = [accum[2]]
+    helper.append_op(
+        type='positive_negative_pair', inputs=inputs,
+        outputs={'PositivePair': [pos], 'NegativePair': [neg],
+                 'NeutralPair': [neu]},
+        attrs={'column': column})
+    return pos, neg, neu

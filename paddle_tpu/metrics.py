@@ -4,7 +4,7 @@ in later versions; Accuracy/ChunkEvaluator live in evaluator.py)."""
 import numpy as np
 
 __all__ = ['MetricBase', 'CompositeMetric', 'Accuracy', 'Auc',
-           'EditDistance', 'Precision', 'Recall']
+           'EditDistance', 'Precision', 'Recall', 'DetectionMAP']
 
 
 class MetricBase(object):
@@ -150,3 +150,101 @@ class Auc(MetricBase):
             auc += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
             tot_pos, tot_neg = new_pos, new_neg
         return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """VOC-style mean average precision over detections.
+
+    Reference: paddle/fluid/operators/detection_map_op.h (CalcMAP at
+    :387-447, greedy IoU matching above it). TPU-first stance: AP needs
+    per-class sorting and data-dependent matching, which has no MXU
+    mapping and runs once per eval — so it lives on host over fetched
+    detections instead of inside the jitted step (SURVEY.md §6).
+
+    update() takes, per image:
+      detections: [M, 6] rows (label, score, xmin, ymin, xmax, ymax)
+      gt_boxes:   [N, 5] rows (label, xmin, ymin, xmax, ymax) or
+                  [N, 6] with a trailing is_difficult flag.
+    eval() returns mAP in [0, 100].
+    """
+
+    def __init__(self, overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version='integral', name=None):
+        super(DetectionMAP, self).__init__(name)
+        if ap_version not in ('integral', '11point'):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self._thresh = overlap_threshold
+        self._eval_difficult = evaluate_difficult
+        self._ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._pos_count = {}   # class -> #gt boxes
+        self._scored = {}      # class -> list of (score, is_tp)
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = np.maximum(box[0], boxes[:, 0])
+        iy1 = np.maximum(box[1], boxes[:, 1])
+        ix2 = np.minimum(box[2], boxes[:, 2])
+        iy2 = np.minimum(box[3], boxes[:, 3])
+        iw = np.maximum(ix2 - ix1, 0.0)
+        ih = np.maximum(iy2 - iy1, 0.0)
+        inter = iw * ih
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        union = a1 + a2 - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+    def update(self, detections, gt_boxes):
+        detections = np.asarray(detections, dtype='float64').reshape(-1, 6)
+        gt = np.asarray(gt_boxes, dtype='float64')
+        gt = gt.reshape(-1, gt.shape[-1]) if gt.size else gt.reshape(0, 5)
+        difficult = gt[:, 5].astype(bool) if gt.shape[-1] >= 6 \
+            else np.zeros(len(gt), bool)
+        for cls in np.unique(gt[:, 0]).astype(int) if len(gt) else []:
+            sel = (gt[:, 0] == cls) & (self._eval_difficult | ~difficult)
+            self._pos_count[cls] = self._pos_count.get(cls, 0) + \
+                int(sel.sum())
+        for cls in (np.unique(detections[:, 0]).astype(int)
+                    if len(detections) else []):
+            dets = detections[detections[:, 0] == cls]
+            dets = dets[np.argsort(-dets[:, 1])]  # score desc
+            cls_gt = gt[gt[:, 0] == cls][:, 1:5] if len(gt) else \
+                np.zeros((0, 4))
+            matched = np.zeros(len(cls_gt), bool)
+            bucket = self._scored.setdefault(cls, [])
+            for det in dets:
+                if len(cls_gt):
+                    ious = self._iou(det[2:6], cls_gt)
+                    best = int(ious.argmax())
+                    if ious[best] >= self._thresh and not matched[best]:
+                        matched[best] = True
+                        bucket.append((float(det[1]), 1))
+                        continue
+                bucket.append((float(det[1]), 0))
+
+    def eval(self):
+        m_ap, count = 0.0, 0
+        for cls, npos in self._pos_count.items():
+            if npos == 0 or cls not in self._scored:
+                continue
+            pairs = sorted(self._scored[cls], key=lambda p: -p[0])
+            tps = np.cumsum([tp for _, tp in pairs])
+            fps = np.cumsum([1 - tp for _, tp in pairs])
+            precision = tps / np.maximum(tps + fps, 1e-10)
+            recall = tps / float(npos)
+            if self._ap_version == '11point':
+                ap = 0.0
+                for t in np.arange(0.0, 1.1, 0.1):
+                    p = precision[recall >= t]
+                    ap += (p.max() if len(p) else 0.0) / 11.0
+            else:  # natural integral (detection_map_op.h:430-439)
+                ap, prev_r = 0.0, 0.0
+                for p, r in zip(precision, recall):
+                    if abs(r - prev_r) > 1e-6:
+                        ap += p * abs(r - prev_r)
+                    prev_r = r
+            m_ap += ap
+            count += 1
+        return (m_ap / count) * 100.0 if count else 0.0

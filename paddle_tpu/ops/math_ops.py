@@ -171,3 +171,32 @@ def _dot(ctx):
     x = ctx.input('X')
     y = ctx.input('Y')
     ctx.set_output('Out', jnp.sum(x * y, axis=-1, keepdims=True))
+
+
+@register('l1_norm')
+def _l1_norm(ctx):
+    """sum(|x|) over all elements (l1_norm_op.cc)."""
+    ctx.set_output('Out', jnp.abs(ctx.input('X')).sum().reshape(1))
+
+
+@register('squared_l2_norm')
+def _squared_l2_norm(ctx):
+    """sum(x^2) over all elements (squared_l2_norm_op.cc)."""
+    ctx.set_output('Out', jnp.square(ctx.input('X')).sum().reshape(1))
+
+
+@register('squared_l2_distance')
+def _squared_l2_distance(ctx):
+    """Row-wise sum((x - y)^2); Y may be a single row broadcast over X's
+    batch (squared_l2_distance_op.cc). sub_result feeds the grad."""
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    sub = x - y  # broadcasts y [1, D] over x [N, D]
+    ctx.set_output('sub_result', sub)
+    ctx.set_output('Out', jnp.square(sub).sum(-1, keepdims=True))
+
+
+@register('minus')
+def _minus(ctx):
+    """out = x - y (minus_op.cc)."""
+    ctx.set_output('Out', ctx.input('X') - ctx.input('Y'))

@@ -1,0 +1,97 @@
+"""Metric ops computed in-graph: precision_recall, positive_negative_pair.
+
+Reference: paddle/fluid/operators/{precision_recall_op,
+positive_negative_pair_op}.{cc,h}. Both reduce to one-hot segment sums /
+an O(N^2) pair matrix — static-shaped, so they ride along in the jitted
+step instead of forcing a host round-trip.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def _prec(tp, fp):
+    # reference convention (precision_recall_op.h:102-113): empty -> 1.0
+    denom = tp + fp
+    return jnp.where(denom > 0, tp / jnp.where(denom > 0, denom, 1.0), 1.0)
+
+
+def _f1(p, r):
+    s = p + r
+    return jnp.where(s > 0, 2 * p * r / jnp.where(s > 0, s, 1.0), 0.0)
+
+
+def _metrics_from_states(states):
+    """states [C, 4] (TP FP TN FN) -> [macro_p, macro_r, macro_f1,
+    micro_p, micro_r, micro_f1] (precision_recall_op.h:ComputeMetrics)."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+    macro_p = _prec(tp, fp).mean()
+    macro_r = _prec(tp, fn).mean()
+    micro_p = _prec(tp.sum(), fp.sum())
+    micro_r = _prec(tp.sum(), fn.sum())
+    return jnp.stack([macro_p, macro_r, _f1(macro_p, macro_r),
+                      micro_p, micro_r, _f1(micro_p, micro_r)])
+
+
+@register('precision_recall')
+def _precision_recall(ctx):
+    """Multi-class (optionally weighted) precision/recall/F1 with
+    accumulated TP/FP/TN/FN states (precision_recall_op.h:30-98)."""
+    idx = ctx.input('Indices').reshape(-1).astype(jnp.int32)
+    labels = ctx.input('Labels').reshape(-1).astype(jnp.int32)
+    cls_num = ctx.attr('class_number')
+    w = ctx.input('Weights').reshape(-1).astype(jnp.float32) \
+        if ctx.has_input('Weights') else jnp.ones(idx.shape, jnp.float32)
+
+    c = jnp.arange(cls_num)
+    is_idx = (idx[:, None] == c[None, :]).astype(jnp.float32)    # [N, C]
+    is_lab = (labels[:, None] == c[None, :]).astype(jnp.float32)
+    correct = (idx == labels).astype(jnp.float32)
+    tp = (w * correct) @ is_idx
+    fp = (w * (1 - correct)) @ is_idx
+    fn = (w * (1 - correct)) @ is_lab
+    # TN_j = sum_i w_i * (idx_i != j and label_i != j)
+    tn = w.sum() - (w @ jnp.maximum(is_idx, is_lab))
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)           # [C, 4]
+    ctx.set_output('BatchMetrics', _metrics_from_states(batch_states)
+                   .astype(jnp.float32))
+    accum = batch_states
+    if ctx.has_input('StatesInfo'):
+        accum = accum + ctx.input('StatesInfo').astype(jnp.float32)
+    ctx.set_output('AccumStatesInfo', accum)
+    ctx.set_output('AccumMetrics', _metrics_from_states(accum)
+                   .astype(jnp.float32))
+
+
+@register('positive_negative_pair')
+def _positive_negative_pair(ctx):
+    """Ranking pair counts per query (positive_negative_pair_op.h:36-101):
+    over same-query pairs with differing labels, a pair is positive when
+    score order agrees with label order, else negative; equal scores also
+    count neutral (the reference counts such pairs neutral AND negative)."""
+    score = ctx.input('Score')
+    label = ctx.input('Label').reshape(-1).astype(jnp.float32)
+    qid = ctx.input('QueryID').reshape(-1)
+    column = ctx.attr('column', 0)
+    s = score[:, column].astype(jnp.float32)
+    w = ctx.input('Weight').reshape(-1).astype(jnp.float32) \
+        if ctx.has_input('Weight') else jnp.ones(s.shape, jnp.float32)
+
+    n = s.shape[0]
+    i_lt_j = jnp.tril(jnp.ones((n, n), bool), -1).T  # i < j upper triangle
+    same_q = qid[:, None] == qid[None, :]
+    dl = label[:, None] - label[None, :]
+    ds = s[:, None] - s[None, :]
+    pair_w = (w[:, None] + w[None, :]) * 0.5
+    considered = (i_lt_j & same_q & (dl != 0)).astype(jnp.float32) * pair_w
+    pos = (considered * (ds * dl > 0)).sum()
+    neg = (considered * (ds * dl <= 0)).sum()
+    neu = (considered * (ds == 0)).sum()
+    if ctx.has_input('AccumulatePositivePair'):
+        pos = pos + ctx.input('AccumulatePositivePair').reshape(())
+        neg = neg + ctx.input('AccumulateNegativePair').reshape(())
+        neu = neu + ctx.input('AccumulateNeutralPair').reshape(())
+    ctx.set_output('PositivePair', pos.reshape(1))
+    ctx.set_output('NegativePair', neg.reshape(1))
+    ctx.set_output('NeutralPair', neu.reshape(1))

@@ -328,3 +328,16 @@ def _label_smoothed_xent(ctx):
     uniform = -jnp.mean(lsm, axis=-1)
     loss = (1.0 - eps) * nll + eps * uniform
     ctx.set_output('Loss', loss[..., None])
+
+
+@register('modified_huber_loss')
+def _modified_huber_loss(ctx):
+    """Binary classification loss (modified_huber_loss_op.h:37-72):
+    z = x * (2y - 1); loss = -4z for z < -1, (1-z)^2 for z < 1, else 0."""
+    x = ctx.input('X')
+    y = ctx.input('Y').astype(x.dtype)
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    ctx.set_output('IntermediateVal', z)
+    ctx.set_output('Out', loss)

@@ -191,3 +191,22 @@ def _proximal_gd(ctx):
     p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
         (1.0 + lr * l2)
     ctx.set_output('ParamOut', p_out.astype(p.dtype))
+
+
+@register('proximal_adagrad')
+def _proximal_adagrad(ctx):
+    """Adagrad step followed by the proximal l1/l2 operator
+    (proximal_adagrad_op.h)."""
+    p = ctx.input('Param')
+    g = ctx.input('Grad')
+    m = ctx.input('Moment')
+    lr = _lr(ctx)
+    l1 = ctx.attr('l1', 0.0)
+    l2 = ctx.attr('l2', 0.0)
+    m_out = m + g * g
+    lr_t = lr / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    ctx.set_output('MomentOut', m_out.astype(m.dtype))
+    ctx.set_output('ParamOut', p_out.astype(p.dtype))

@@ -1,0 +1,179 @@
+"""Vision ops: lrn, roi_pool, crop, max_pool2d_with_index, unpool.
+
+Reference: paddle/fluid/operators/{lrn_op,roi_pool_op,crop_op,
+pool_with_index_op,unpool_op}.cc. All lowerings keep static shapes
+(pooled sizes, windows, crop shapes are attrs), so XLA can tile them;
+data-dependent extents (ROI rectangles) become masks over the full
+feature map instead of dynamic slices.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('lrn')
+def _lrn(ctx):
+    """Local response normalization across channels (lrn_op.cc:30-56):
+    mid = k + alpha * sum_{c in [i-(n-1)/2, i+(n+1)/2]} x_c^2 (the
+    reference window loop is inclusive of both ends -> n+1 taps);
+    out = x * mid^-beta. NCHW."""
+    x = ctx.input('X')
+    n = ctx.attr('n', 5)
+    k = ctx.attr('k', 2.0)
+    alpha = ctx.attr('alpha', 1e-4)
+    beta = ctx.attr('beta', 0.75)
+    c_dim = x.shape[1]
+    start = -(n - 1) // 2
+    sq = x * x
+    mid = jnp.full_like(x, k)
+    for off in range(start, start + n + 1):
+        lo, hi = max(0, off), min(c_dim, c_dim + off)
+        if lo >= hi:
+            continue
+        mid = mid.at[:, lo - off:hi - off].add(alpha * sq[:, lo:hi])
+    out = x * mid ** (-beta)
+    ctx.set_output('MidOut', mid)
+    ctx.set_output('Out', out)
+
+
+@register('roi_pool')
+def _roi_pool(ctx):
+    """Max pool per ROI rectangle (roi_pool_op.h:60-120). ROIs are
+    [R, 5] (batch_id, x1, y1, x2, y2); output [R, C, PH, PW] + Argmax of
+    flattened h*W+w. ROI extents are data -> each output bin max-reduces
+    the full map under a bin mask (static shapes; the MXU-friendly trade:
+    more FLOPs, no dynamic shapes)."""
+    x = ctx.input('X')          # [B, C, H, W]
+    rois = ctx.input('ROIs')    # [R, 5]
+    ph_n = ctx.attr('pooled_height', 1)
+    pw_n = ctx.attr('pooled_width', 1)
+    scale = ctx.attr('spatial_scale', 1.0)
+    _, _, H, W = x.shape
+
+    def one_roi(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        coords = jnp.round(roi[1:].astype(jnp.float32) * scale).astype(
+            jnp.int32)
+        x1, y1, x2, y2 = coords[0], coords[1], coords[2], coords[3]
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        bin_h = roi_h.astype(jnp.float32) / ph_n
+        bin_w = roi_w.astype(jnp.float32) / pw_n
+        ph = jnp.arange(ph_n, dtype=jnp.float32)
+        pw = jnp.arange(pw_n, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(ph * bin_h).astype(jnp.int32) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1) * bin_h).astype(jnp.int32) + y1,
+                        0, H)
+        wstart = jnp.clip(jnp.floor(pw * bin_w).astype(jnp.int32) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((pw + 1) * bin_w).astype(jnp.int32) + x1,
+                        0, W)
+        h_idx = jnp.arange(H)
+        w_idx = jnp.arange(W)
+        in_h = (h_idx[None, :] >= hstart[:, None]) & \
+               (h_idx[None, :] < hend[:, None])       # [PH, H]
+        in_w = (w_idx[None, :] >= wstart[:, None]) & \
+               (w_idx[None, :] < wend[:, None])       # [PW, W]
+        mask = in_h[:, None, :, None] & in_w[None, :, None, :]  # PH,PW,H,W
+        feat = jnp.take(x, batch_id, axis=0)                    # [C, H, W]
+        neg = jnp.finfo(feat.dtype).min
+        masked = jnp.where(mask[None], feat[:, None, None], neg)
+        flat = masked.reshape(masked.shape[:3] + (H * W,))
+        pooled = flat.max(-1)
+        arg = flat.argmax(-1).astype(jnp.int64)
+        empty = ~mask.any((-1, -2))                             # [PH, PW]
+        pooled = jnp.where(empty[None], 0.0, pooled)
+        arg = jnp.where(empty[None], -1, arg)
+        return pooled, arg
+
+    out, argmax = jax.vmap(one_roi)(rois)
+    ctx.set_output('Out', out)
+    ctx.set_output('Argmax', argmax)
+
+
+@register('crop')
+def _crop(ctx):
+    """Crop X to `shape` starting at `offsets` (crop_op.cc:57-71); the
+    target shape may also come from a second input Y."""
+    x = ctx.input('X')
+    y = ctx.input('Y') if ctx.has_input('Y') else None
+    shape = ctx.attr('shape')
+    if y is not None:
+        shape = y.shape
+    offsets = ctx.attr('offsets') or [0] * x.ndim
+    if ctx.has_input('Offsets'):
+        off = ctx.input('Offsets')
+        out = jax.lax.dynamic_slice(x, [off[i] for i in range(x.ndim)],
+                                    shape)
+    else:
+        out = jax.lax.slice(x, offsets,
+                            [o + s for o, s in zip(offsets, shape)])
+    ctx.set_output('Out', out)
+
+
+def _pool_patches(x, ksize, strides, paddings):
+    """Extract [B, C, OH, OW, KH*KW] windows plus the flattened h*W+w
+    global index of every tap (-1 where the tap hangs in padding)."""
+    _, _, H, W = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    h_idx = (jnp.arange(oh) * sh - ph)[:, None] + jnp.arange(kh)[None, :]
+    w_idx = (jnp.arange(ow) * sw - pw)[:, None] + jnp.arange(kw)[None, :]
+    h_ok = (h_idx >= 0) & (h_idx < H)
+    w_ok = (w_idx >= 0) & (w_idx < W)
+    hc = jnp.clip(h_idx, 0, H - 1)
+    wc = jnp.clip(w_idx, 0, W - 1)
+    patches = x[:, :, hc[:, :, None, None], wc[None, None]]  # B,C,OH,KH,OW,KW
+    ok = h_ok[:, :, None, None] & w_ok[None, None]           # OH,KH,OW,KW
+    gidx = hc[:, :, None, None] * W + wc[None, None]
+    patches = patches.transpose(0, 1, 2, 4, 3, 5).reshape(
+        x.shape[0], x.shape[1], oh, ow, kh * kw)
+    ok = ok.transpose(0, 2, 1, 3).reshape(oh, ow, kh * kw)
+    gidx = gidx.transpose(0, 2, 1, 3).reshape(oh, ow, kh * kw)
+    return patches, ok, gidx
+
+
+@register('max_pool2d_with_index')
+def _max_pool2d_with_index(ctx):
+    """Max pool returning the argmax position flattened over h*W+w
+    (pool_with_index_op.cc); the Mask feeds unpool."""
+    x = ctx.input('X')
+    ksize = ctx.attr('ksize')
+    strides = ctx.attr('strides', [1, 1])
+    paddings = ctx.attr('paddings', [0, 0])
+    patches, ok, gidx = _pool_patches(x, ksize, strides, paddings)
+    neg = jnp.finfo(patches.dtype).min
+    masked = jnp.where(ok[None, None], patches, neg)
+    out = masked.max(-1)
+    local = masked.argmax(-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(gidx, masked.shape), local[..., None], -1
+    ).squeeze(-1).astype(jnp.int32)
+    ctx.set_output('Out', out)
+    ctx.set_output('Mask', mask)
+
+
+@register('unpool')
+def _unpool(ctx):
+    """Scatter pooled values back to their argmax positions
+    (math/unpooling.cc:20-49); Indices hold flattened h*W+w."""
+    x = ctx.input('X')            # [B, C, IH, IW]
+    idx = ctx.input('Indices')    # same shape, int
+    ksize = ctx.attr('ksize')
+    strides = ctx.attr('strides', [1, 1])
+    paddings = ctx.attr('paddings', [0, 0])
+    b, c, ih, iw = x.shape
+    oh = (ih - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    ow = (iw - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    vals = x.reshape(b * c, ih * iw)
+    flat_idx = idx.reshape(b * c, ih * iw).astype(jnp.int32)
+
+    def one(row_vals, row_idx):
+        return jnp.zeros(oh * ow, x.dtype).at[row_idx].set(row_vals)
+
+    out = jax.vmap(one)(vals, flat_idx).reshape(b, c, oh, ow)
+    ctx.set_output('Out', out)

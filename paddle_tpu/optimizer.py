@@ -18,6 +18,7 @@ __all__ = ['SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
            'MomentumOptimizer', 'AdagradOptimizer', 'AdamOptimizer',
            'AdamaxOptimizer', 'DecayedAdagradOptimizer',
            'AdadeltaOptimizer', 'RMSPropOptimizer', 'FtrlOptimizer',
+           'ProximalAdagrad', 'ProximalAdagradOptimizer',
            'Optimizer']
 
 
@@ -404,6 +405,32 @@ class FtrlOptimizer(Optimizer):
                    'lr_power': self._lr_power})
 
 
+class ProximalAdagradOptimizer(Optimizer):
+    """Adagrad with the proximal l1/l2 operator
+    (proximal_adagrad_op.{cc,h})."""
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super(ProximalAdagradOptimizer, self).__init__(learning_rate,
+                                                       **kwargs)
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type='proximal_adagrad',
+            inputs={'Param': [param], 'Grad': [grad], 'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param], 'MomentOut': [moment]},
+            attrs={'l1': self._l1, 'l2': self._l2})
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer
@@ -413,3 +440,4 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -235,3 +235,14 @@ def test_fill_ones_zeros_shape_range():
     np.testing.assert_allclose(got[1], np.ones((2, 2)))
     np.testing.assert_allclose(got[2], np.zeros(3))
     np.testing.assert_array_equal(got[3], np.arange(0, 10, 2))
+
+
+def test_minus():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[3], dtype='float32')
+    out = fluid.layers.tensor.create_tensor(dtype='float32')
+    fluid.default_main_program().global_block().append_op(
+        type='minus', inputs={'X': [x], 'Y': [y]}, outputs={'Out': [out]})
+    xs, ys = rand(2, 3, seed=1), rand(2, 3, seed=2)
+    got = run_startup_and({'x': xs, 'y': ys}, [out])[0]
+    np.testing.assert_allclose(got, xs - ys, rtol=1e-6)

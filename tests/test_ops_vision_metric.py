@@ -1,0 +1,265 @@
+"""Numeric checks vs numpy for the vision/metric/loss op tail
+(reference: paddle/fluid/operators/{lrn,roi_pool,crop,pool_with_index,
+unpool,precision_recall,positive_negative_pair,modified_huber_loss,
+squared_l2_norm,squared_l2_distance,l1_norm,sign}_op)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from util import run_startup_and, rand
+
+
+def _np_lrn(x, n=5, k=2.0, alpha=1e-4, beta=0.75):
+    # reference loop (lrn_op.cc:30-56): inclusive window start..start+n
+    N, C, H, W = x.shape
+    start = -(n - 1) // 2
+    mid = np.full_like(x, k)
+    for i in range(C):
+        for off in range(start, start + n + 1):
+            ch = i + off
+            if 0 <= ch < C:
+                mid[:, i] += alpha * x[:, ch] ** 2
+    return x * mid ** (-beta), mid
+
+
+def test_lrn_matches_numpy():
+    xs = rand(2, 7, 3, 3, seed=1)
+    x = fluid.layers.data(name='x', shape=[7, 3, 3], dtype='float32')
+    out = fluid.layers.lrn(x, n=5)
+    got = run_startup_and({'x': xs}, [out])[0]
+    want, _ = _np_lrn(xs)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _np_roi_pool(x, rois, ph_n, pw_n, scale):
+    # reference kernel (roi_pool_op.h:60-120)
+    R = rois.shape[0]
+    C, H, W = x.shape[1:]
+    out = np.zeros((R, C, ph_n, pw_n), dtype=x.dtype)
+    argmax = np.full((R, C, ph_n, pw_n), -1, dtype='int64')
+    for r in range(R):
+        b, x1, y1, x2, y2 = rois[r]
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in (x1, y1, x2, y2)]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph_n, rw / pw_n
+        for c in range(C):
+            for ph in range(ph_n):
+                for pw in range(pw_n):
+                    hs = min(max(int(np.floor(ph * bh)) + y1, 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh)) + y1, 0), H)
+                    ws = min(max(int(np.floor(pw * bw)) + x1, 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw)) + x1, 0), W)
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = x[b, c, hs:he, ws:we]
+                    out[r, c, ph, pw] = patch.max()
+                    h_loc, w_loc = np.unravel_index(patch.argmax(),
+                                                    patch.shape)
+                    argmax[r, c, ph, pw] = (hs + h_loc) * W + (ws + w_loc)
+    return out, argmax
+
+
+def test_roi_pool_matches_numpy():
+    xs = rand(2, 3, 8, 8, seed=2)
+    rois_np = np.array([[0, 1, 1, 5, 6], [1, 0, 0, 7, 7], [0, 3, 3, 3, 3]],
+                       dtype='int64')
+    x = fluid.layers.data(name='x', shape=[3, 8, 8], dtype='float32')
+    rois = fluid.layers.data(name='rois', shape=[5], dtype='int64')
+    out = fluid.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2,
+                                spatial_scale=1.0)
+    got = run_startup_and({'x': xs, 'rois': rois_np}, [out])[0]
+    want, _ = _np_roi_pool(xs, rois_np, 2, 2, 1.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_crop_static_and_variable_shape():
+    xs = rand(3, 5, 6, seed=3)
+    x = fluid.layers.data(name='x', shape=[5, 6], dtype='float32')
+    out = fluid.layers.crop(x, shape=[2, 3, 4], offsets=[1, 2, 1])
+    got = run_startup_and({'x': xs}, [out])[0]
+    np.testing.assert_allclose(got, xs[1:3, 2:5, 1:5])
+
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = fluid.layers.data(name='x', shape=[5, 6], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[4, 4], dtype='float32')
+    out = fluid.layers.crop(x, shape=y, offsets=[0, 1, 1])
+    got = run_startup_and(
+        {'x': xs, 'y': np.zeros((2, 4, 4), 'float32')}, [out])[0]
+    np.testing.assert_allclose(got, xs[0:2, 1:5, 1:5])
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    xs = rand(2, 3, 6, 6, seed=4)
+    x = fluid.layers.data(name='x', shape=[3, 6, 6], dtype='float32')
+    pooled, mask = fluid.layers.max_pool2d_with_index(
+        x, ksize=[2, 2], strides=[2, 2])
+    restored = fluid.layers.unpool(pooled, mask, ksize=[2, 2],
+                                   strides=[2, 2])
+    got_p, got_m, got_r = run_startup_and({'x': xs},
+                                          [pooled, mask, restored])
+    # pooled matches a plain 2x2/2 max pool
+    want_p = xs.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got_p, want_p, rtol=1e-6)
+    # mask holds flattened h*W+w of each max; unpool scatters back there
+    want_r = np.zeros_like(xs)
+    for b in range(2):
+        for c in range(3):
+            for i in range(3):
+                for j in range(3):
+                    idx = got_m[b, c, i, j]
+                    want_r[b, c, idx // 6, idx % 6] = got_p[b, c, i, j]
+    np.testing.assert_allclose(got_r, want_r, rtol=1e-6)
+    # every mask entry actually points at the max value
+    flat = xs.reshape(2, 3, 36)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, got_m.reshape(2, 3, 9), -1).reshape(got_p.shape),
+        got_p)
+
+
+def _np_precision_recall(ids, labels, cls):
+    # reference kernel (precision_recall_op.h:30-98), weights = 1
+    states = np.zeros((cls, 4))  # TP FP TN FN
+    TP, FP, TN, FN = 0, 1, 2, 3
+    for i, l in zip(ids, labels):
+        if i == l:
+            states[i, TP] += 1
+            states[:, TN] += 1
+            states[i, TN] -= 1
+        else:
+            states[l, FN] += 1
+            states[i, FP] += 1
+            states[:, TN] += 1
+            states[i, TN] -= 1
+            states[l, TN] -= 1
+
+    def prec(tp, fp):
+        return tp / (tp + fp) if tp > 0 or fp > 0 else 1.0
+
+    def f1(p, r):
+        return 2 * p * r / (p + r) if p > 0 or r > 0 else 0.0
+
+    mp = np.mean([prec(states[c, TP], states[c, FP]) for c in range(cls)])
+    mr = np.mean([prec(states[c, TP], states[c, FN]) for c in range(cls)])
+    up = prec(states[:, TP].sum(), states[:, FP].sum())
+    ur = prec(states[:, TP].sum(), states[:, FN].sum())
+    return np.array([mp, mr, f1(mp, mr), up, ur, f1(up, ur)]), states
+
+
+def test_precision_recall_matches_numpy():
+    ids_np = np.array([0, 1, 2, 1, 0, 2, 2, 1], 'int64')[:, None]
+    lab_np = np.array([0, 2, 2, 1, 1, 0, 2, 1], 'int64')[:, None]
+    ids = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+    lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+    batch, accum, states = fluid.layers.precision_recall(ids, lab, 3)
+    got_b, got_s = run_startup_and({'ids': ids_np, 'lab': lab_np},
+                                   [batch, states])
+    want_m, want_s = _np_precision_recall(ids_np.ravel(), lab_np.ravel(), 3)
+    np.testing.assert_allclose(got_b, want_m, rtol=1e-5)
+    np.testing.assert_allclose(got_s, want_s, rtol=1e-6)
+
+
+def test_positive_negative_pair_matches_numpy():
+    rng = np.random.RandomState(5)
+    n = 12
+    score_np = rng.rand(n, 1).astype('float32')
+    score_np[3] = score_np[7]  # force an equal-score pair
+    label_np = rng.randint(0, 3, (n, 1)).astype('float32')
+    qid_np = rng.randint(0, 3, (n, 1)).astype('int64')
+    pos = neg = neu = 0.0
+    for i in range(n):
+        for j in range(i + 1, n):
+            if qid_np[i, 0] != qid_np[j, 0] or label_np[i, 0] == label_np[j, 0]:
+                continue
+            ds = score_np[i, 0] - score_np[j, 0]
+            dl = label_np[i, 0] - label_np[j, 0]
+            if ds == 0:
+                neu += 1
+            if ds * dl > 0:
+                pos += 1
+            else:
+                neg += 1
+    score = fluid.layers.data(name='s', shape=[1], dtype='float32')
+    label = fluid.layers.data(name='l', shape=[1], dtype='float32')
+    qid = fluid.layers.data(name='q', shape=[1], dtype='int64')
+    p, ng, nu = fluid.layers.positive_negative_pair(score, label, qid)
+    got = run_startup_and({'s': score_np, 'l': label_np, 'q': qid_np},
+                          [p, ng, nu])
+    np.testing.assert_allclose([got[0][0], got[1][0], got[2][0]],
+                               [pos, neg, neu], rtol=1e-6)
+
+
+def test_modified_huber_loss_matches_numpy():
+    xs = np.linspace(-3, 3, 13).astype('float32')[:, None]
+    ys = (np.arange(13) % 2).astype('float32')[:, None]
+    z = xs * (2 * ys - 1)
+    want = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0.0))
+    x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    out = fluid.layers.modified_huber_loss(x, y)
+    got = run_startup_and({'x': xs, 'y': ys}, [out])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_norms_distance_sign_match_numpy():
+    xs = rand(4, 5, seed=6)
+    ys = rand(4, 5, seed=7)
+    x = fluid.layers.data(name='x', shape=[5], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[5], dtype='float32')
+    outs = [fluid.layers.l1_norm(x), fluid.layers.squared_l2_norm(x),
+            fluid.layers.squared_l2_distance(x, y), fluid.layers.sign(x)]
+    g1, g2, g3, g4 = run_startup_and({'x': xs, 'y': ys}, outs)
+    np.testing.assert_allclose(g1, [np.abs(xs).sum()], rtol=1e-5)
+    np.testing.assert_allclose(g2, [(xs ** 2).sum()], rtol=1e-5)
+    np.testing.assert_allclose(g3, ((xs - ys) ** 2).sum(-1, keepdims=True),
+                               rtol=1e-5)
+    np.testing.assert_allclose(g4, np.sign(xs))
+
+
+def test_squared_l2_distance_is_differentiable():
+    """The loss-shaped ops must run under append_backward (grad flows)."""
+    x = fluid.layers.data(name='x', shape=[5], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[5], dtype='float32')
+    h = fluid.layers.fc(input=x, size=5,
+                        param_attr=fluid.ParamAttr(name='sq_w'))
+    loss = fluid.layers.mean(fluid.layers.squared_l2_distance(h, y))
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs, ys = rand(8, 5, seed=8), rand(8, 5, seed=9)
+    l0 = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])[0]
+    for _ in range(20):
+        l1 = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])[0]
+    assert float(np.asarray(l1).reshape(())) < float(np.asarray(l0).reshape(()))
+
+
+def test_detection_map_hand_computed():
+    from paddle_tpu.metrics import DetectionMAP
+    m = DetectionMAP(overlap_threshold=0.5, ap_version='integral')
+    # one image, one class, 2 gt boxes; 3 detections: hit, dup-hit(fp), miss
+    gts = np.array([[1, 0, 0, 10, 10], [1, 20, 20, 30, 30]], 'float64')
+    dets = np.array([
+        [1, 0.9, 0, 0, 10, 10],    # tp (iou 1.0)
+        [1, 0.8, 1, 1, 10, 10],    # fp (same gt already matched)
+        [1, 0.7, 20, 20, 30, 30],  # tp
+    ], 'float64')
+    m.update(dets, gts)
+    # precision at hits: 1/1 then 2/3; recall steps 0.5, 0.5->1.0
+    # integral AP = 1.0*0.5 + (2/3)*0.5 = 0.8333 -> 83.33
+    np.testing.assert_allclose(m.eval(), (0.5 + (2 / 3) * 0.5) * 100,
+                               rtol=1e-6)
+    # accumulation across images
+    m.update(np.zeros((0, 6)), gts)  # 2 more positives, no detections
+    # recalls now over npos=4: 0.25, 0.5 -> AP = 1*0.25 + 2/3*0.25
+    np.testing.assert_allclose(m.eval(), (0.25 + (2 / 3) * 0.25) * 100,
+                               rtol=1e-6)
+
+
+def test_detection_map_11point():
+    from paddle_tpu.metrics import DetectionMAP
+    m = DetectionMAP(ap_version='11point')
+    gts = np.array([[0, 0, 0, 4, 4]], 'float64')
+    dets = np.array([[0, 0.6, 0, 0, 4, 4]], 'float64')
+    m.update(dets, gts)
+    # single tp: precision 1 at recall 1 -> all 11 points max precision 1
+    np.testing.assert_allclose(m.eval(), 100.0)

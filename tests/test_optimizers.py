@@ -206,3 +206,16 @@ def test_grad_clip_global_norm():
     scaled = X * min(1.0, 1.0 / gnorm)
     expect = P0 - LR * scaled
     np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_proximal_adagrad():
+    got = _run_steps(lambda: fluid.optimizer.ProximalAdagrad(
+        learning_rate=LR, l1=0.01, l2=0.02))
+    p, m = P0.copy(), np.zeros_like(P0)
+    for _ in range(3):
+        m = m + X * X
+        lr_t = LR / np.sqrt(m)
+        prox = p - lr_t * X
+        p = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * 0.01, 0.0) / \
+            (1.0 + lr_t * 0.02)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
