@@ -1,14 +1,23 @@
 """Convolution / pooling ops.
 
 Reference: paddle/fluid/operators/{conv_op,conv_transpose_op,pool_op}.cc.
-IR semantics stay NCHW for reference-parity; XLA's TPU layout assignment
-re-tiles internally, so no manual NHWC transposes are inserted here.
+IR semantics stay NCHW for reference-parity. By default no manual layout
+transposes are inserted (XLA's TPU layout assignment re-tiles
+internally); set PADDLE_TPU_CONV_LAYOUT=NHWC to lower convs/pools with
+channels-last dimension numbers (SURVEY §5 layout experiment — the bench
+records both, the faster one wins).
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
+
+
+def _conv_layout():
+    return os.environ.get('PADDLE_TPU_CONV_LAYOUT', 'NCHW').upper()
 
 
 @register('conv2d')
@@ -21,11 +30,20 @@ def _conv2d(ctx):
     groups = ctx.attr('groups', 1)
     padding = [(pads[0], pads[0]), (pads[1], pads[1])] if len(pads) == 2 \
         else [(pads[0], pads[1]), (pads[2], pads[3])]
-    out = jax.lax.conv_general_dilated(
-        x, w, window_strides=strides, padding=padding,
-        rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
-        preferred_element_type=x.dtype if x.dtype == jnp.float32 else None)
+    pref = x.dtype if x.dtype == jnp.float32 else None
+    if _conv_layout() == 'NHWC':
+        out = jax.lax.conv_general_dilated(
+            x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
+            window_strides=strides, padding=padding,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            preferred_element_type=pref).transpose(0, 3, 1, 2)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+            preferred_element_type=pref)
     ctx.set_output('Output', out)
 
 

@@ -1,0 +1,111 @@
+"""amp='bf16' end-to-end: the exact codepath the headline bench runs
+(bench.py:92,112). Whitelist ops (mul/conv/attention) compute in
+bfloat16 on the MXU; blacklist ops (softmax/norms/losses) stay fp32;
+master weights stay fp32 in the scope (registry.py AMP policy)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from util import rand
+
+
+def _train(amp, steps=15, seed=0):
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    img = fluid.layers.data(name='img', shape=[1, 12, 12], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    # bias_attr=False: the fp32 bias-add would promote the activation
+    # back to fp32 (per-op promotion policy), which is fine for training
+    # but would blur the in-graph dtype assertion below.
+    conv = fluid.layers.conv2d(img, num_filters=6, filter_size=3,
+                               act='relu', bias_attr=False,
+                               param_attr=fluid.ParamAttr(
+                                   name='amp_conv_w'))
+    pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+    logits = fluid.layers.fc(input=pool, size=10, act='softmax')
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logits, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    if amp:
+        fluid.default_main_program().amp = amp
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(32, 1, 12, 12).astype('float32')
+    ys = (xs.sum((1, 2, 3), keepdims=False)[:, None] > 36).astype('int64')
+    losses = []
+    for _ in range(steps):
+        losses.append(float(np.asarray(
+            exe.run(feed={'img': xs, 'label': ys},
+                    fetch_list=[loss])[0]).reshape(())))
+    return losses, conv
+
+
+def test_bf16_lenet_loss_decreases():
+    losses, _ = _train('bf16')
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert np.isfinite(losses).all()
+
+
+def test_bf16_tracks_fp32():
+    """bf16 training must land near the fp32 trajectory (not diverge)."""
+    l32, _ = _train(None)
+    l16, _ = _train('bf16')
+    assert abs(l16[-1] - l32[-1]) < 0.15, (l32[-1], l16[-1])
+
+
+def test_bf16_dtypes_in_graph_and_scope():
+    """Whitelist op outputs are bfloat16 in-graph; master weights stay
+    float32 in the scope."""
+    import jax.numpy as jnp
+    losses, conv = _train('bf16', steps=1)
+    fluid_prog = fluid.default_main_program()
+    assert fluid_prog.amp == 'bf16'
+    # conv activation inside the jitted graph is bf16
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    xs = rng.rand(4, 1, 12, 12).astype('float32')
+    ys = np.zeros((4, 1), 'int64')
+    out = exe.run(program=fluid_prog, feed={'img': xs, 'label': ys},
+                  fetch_list=[conv], return_numpy=False)[0]
+    assert out.dtype == jnp.bfloat16, out.dtype
+    # master weights in scope stay fp32
+    w = fluid.global_scope().find('amp_conv_w')
+    assert np.asarray(w).dtype == np.float32
+
+
+def test_bf16_resnet_tiny_e2e():
+    from paddle_tpu.models.resnet import resnet_cifar10
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    img = fluid.layers.data(name='image', shape=[3, 16, 16],
+                            dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    net = resnet_cifar10(img, depth=8)
+    logits = fluid.layers.fc(input=net, size=10, act='softmax')
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logits, label=label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rand(8, 3, 16, 16, seed=2)
+    ys = np.arange(8).reshape(-1, 1).astype('int64') % 10
+    first = last = None
+    for _ in range(12):
+        val = float(np.asarray(exe.run(
+            feed={'image': xs, 'label': ys},
+            fetch_list=[loss])[0]).reshape(()))
+        first = val if first is None else first
+        last = val
+    assert np.isfinite(last)
+    assert last < first, (first, last)
+
+
+def test_nhwc_conv_layout_matches_nchw(monkeypatch):
+    """PADDLE_TPU_CONV_LAYOUT=NHWC is numerics-identical (the bench
+    ablation flag, SURVEY §5)."""
+    l_nchw, _ = _train('bf16', steps=5)
+    monkeypatch.setenv('PADDLE_TPU_CONV_LAYOUT', 'NHWC')
+    l_nhwc, _ = _train('bf16', steps=5)
+    np.testing.assert_allclose(l_nchw, l_nhwc, rtol=2e-2, atol=1e-3)
