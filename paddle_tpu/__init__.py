@@ -41,6 +41,7 @@ from .core.lod import create_lod_tensor, pad_sequences  # noqa: F401
 from . import parallel  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import image  # noqa: F401
 
 from .core.backward import append_backward  # noqa: F401
 from .core.executor import Executor  # noqa: F401

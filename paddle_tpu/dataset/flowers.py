@@ -1,37 +1,63 @@
 """Oxford 102 flowers (reference: python/paddle/v2/dataset/flowers.py).
-Schema: (image_chw_float32, label). Synthetic: class-colored noise."""
+Schema: (image_chw_float32, label).
+
+Like the reference, raw HWC images go through the default
+image.simple_transform mapper (reference flowers.py wires
+v2/image.py:291 simple_transform as default_mapper: resize-short then
+train random-crop+flip / test center-crop, then CHW float). Synthetic
+class-colored noise stands in for the tarball (zero egress); sizes are
+scaled down (resize 40, crop 32 vs the reference's 256/224) to keep
+tests fast — the pipeline shape is identical.
+"""
+
+import functools
 
 import numpy as np
 
 from . import common
+from .. import image
 
 CLASS_NUM = 102
 _TRAIN_N = 1024
 _TEST_N = 256
-_SHAPE = (3, 32, 32)  # reference resizes to 224; kept small for tests
+_RAW_HW = (48, 56)     # synthetic source images (HWC uint8, non-square)
+RESIZE_SIZE = 40
+CROP_SIZE = 32
 
 
-def _reader(split, n, mapper=None):
+def default_mapper(is_train, sample):
+    """The reference's default mapper over (hwc_uint8, label)."""
+    img, label = sample
+    img = image.simple_transform(img, RESIZE_SIZE, CROP_SIZE, is_train,
+                                 mean=[127.5, 127.5, 127.5])
+    return img / 127.5, label
+
+
+def _reader(split, n, mapper, buffered_size=1024):
+    is_train = split == 'train'
+    if mapper is None:
+        mapper = functools.partial(default_mapper, is_train)
+
     def reader():
         r = common.rng('flowers', split)
+        h, w = _RAW_HW
         for _ in range(n):
             label = int(r.randint(0, CLASS_NUM))
-            base = np.zeros(_SHAPE, dtype='float32')
-            base[label % 3] = (label % 10) / 10.0
-            img = np.clip(base + r.normal(0, 0.2, _SHAPE), 0, 1) \
-                .astype('float32')
-            item = (img, label)
-            yield mapper(item) if mapper else item
+            base = np.zeros((h, w, 3), dtype='float32')
+            base[..., label % 3] = (label % 10) / 10.0
+            img = np.clip(base + r.normal(0, 0.2, (h, w, 3)), 0, 1)
+            img = (img * 255).astype('uint8')
+            yield mapper((img, label))
     return reader
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader('train', _TRAIN_N, mapper)
+    return _reader('train', _TRAIN_N, mapper, buffered_size)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader('test', _TEST_N, mapper)
+    return _reader('test', _TEST_N, mapper, buffered_size)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
-    return _reader('valid', _TEST_N, mapper)
+    return _reader('valid', _TEST_N, mapper, buffered_size)

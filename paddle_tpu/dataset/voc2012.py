@@ -1,9 +1,12 @@
 """PASCAL VOC2012 segmentation (reference:
-python/paddle/v2/dataset/voc2012.py). Schema: (image_chw, seg_label_hw)."""
+python/paddle/v2/dataset/voc2012.py). Schema: (image_chw, seg_label_hw).
+Raw HWC frames go through image.to_chw like the reference's PIL decode
+path (v2/image.py:189)."""
 
 import numpy as np
 
 from . import common
+from .. import image
 
 CLASS_NUM = 21  # 20 classes + background
 _TRAIN_N = 256
@@ -16,7 +19,8 @@ def _reader(split, n):
         r = common.rng('voc2012', split)
         h, w = _SHAPE[1], _SHAPE[2]
         for _ in range(n):
-            img = r.uniform(0, 1, _SHAPE).astype('float32')
+            hwc = r.uniform(0, 1, (h, w, 3)).astype('float32')
+            img = image.to_chw(hwc)
             # blocky segmentation mask
             seg = np.zeros((h, w), dtype='int32')
             for _k in range(3):

@@ -1,0 +1,150 @@
+"""Image preprocessing for input pipelines.
+
+Reference: python/paddle/v2/image.py:41-381 (same public API). The
+reference decodes/augments with cv2; this uses PIL + numpy (cv2 is not
+in the image). All functions work on HWC uint8/float ndarrays and run on
+HOST inside the reader worker threads — the TPU step consumes the
+already-augmented CHW float batch (augmentation is branchy per-sample
+work with no MXU mapping; keeping it in the data pipeline overlaps it
+with device compute, same as the reference's C++ DataProvider).
+"""
+
+import io as _io
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    'batch_images_from_tar', 'load_image_bytes', 'load_image',
+    'resize_short', 'to_chw', 'center_crop', 'random_crop',
+    'left_right_flip', 'simple_transform', 'load_and_transform',
+]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Read images from a tar, batch them into numpy files
+    (v2/image.py:48-110). Returns the meta-file path listing batches."""
+    import os
+    import pickle
+    out_path = "%s_%s_batch" % (data_file, dataset_name)
+    meta_file = os.path.join(out_path, 'batch_meta')
+    if os.path.exists(meta_file):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    tf = tarfile.open(data_file)
+    names = [m.name for m in tf.getmembers() if m.name in img2label]
+    data, labels, batch_names = [], [], []
+    file_id = 0
+    for name in names:
+        data.append(tf.extractfile(name).read())
+        labels.append(img2label[name])
+        if len(data) == num_per_batch:
+            batch_name = os.path.join(out_path, 'batch_%d' % file_id)
+            with open(batch_name, 'wb') as f:
+                pickle.dump({'data': data, 'label': labels}, f,
+                            protocol=2)
+            batch_names.append(batch_name)
+            data, labels = [], []
+            file_id += 1
+    if data:
+        batch_name = os.path.join(out_path, 'batch_%d' % file_id)
+        with open(batch_name, 'wb') as f:
+            pickle.dump({'data': data, 'label': labels}, f, protocol=2)
+        batch_names.append(batch_name)
+    with open(meta_file, 'w') as f:
+        f.write('\n'.join(batch_names))
+    return meta_file
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an encoded (jpeg/png/...) byte string to an HWC uint8 array
+    (v2/image.py:111-134)."""
+    img = _pil().open(_io.BytesIO(bytes_))
+    img = img.convert('RGB' if is_color else 'L')
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    """Load an image file as an HWC uint8 array (v2/image.py:135-162)."""
+    img = _pil().open(file)
+    img = img.convert('RGB' if is_color else 'L')
+    return np.asarray(img)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge is `size`, keeping aspect ratio
+    (v2/image.py:163-188)."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_h, new_w = int(round(h * size / float(w))), size
+    else:
+        new_h, new_w = size, int(round(w * size / float(h)))
+    pil_im = _pil().fromarray(np.ascontiguousarray(im))
+    resized = pil_im.resize((new_w, new_h), _pil().BILINEAR)
+    return np.asarray(resized)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (v2/image.py:189-212)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """Crop the center size x size patch (v2/image.py:213-240)."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """Crop a random size x size patch (v2/image.py:241-268)."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    """Mirror horizontally (v2/image.py:269-290)."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> (random crop + coin-flip mirror | center crop) ->
+    CHW float32 -> optional mean subtraction (v2/image.py:291-347).
+    `mean` may be per-channel ([C]) or elementwise (CHW)."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color, rng=rng)
+        if rng.randint(0, 2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype('float32')
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """load_image + simple_transform (v2/image.py:348-381)."""
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color, mean)

@@ -17,7 +17,8 @@ Environment contracts supported (first match wins):
 import os
 
 __all__ = ['init_distributed', 'is_initialized', 'global_device_mesh',
-           'host_local_batch', 'process_index', 'process_count']
+           'host_local_batch', 'process_index', 'process_count',
+           'shard_reader']
 
 _initialized = False
 
@@ -85,3 +86,17 @@ def host_local_batch(global_batch):
         raise ValueError('global batch %d not divisible by %d hosts'
                          % (global_batch, n))
     return global_batch // n
+
+
+def shard_reader(reader, drop_uneven=True):
+    """Shard a reader stream across hosts: host i of n yields samples
+    i, i+n, ... (reader.decorator.shard keyed on jax.process_index).
+    Without this every host would feed the SAME batches — dp over hosts
+    would silently train on n duplicate epochs (go/master/service.go is
+    the reference's answer; ours is positional, masterless)."""
+    import jax
+    n = jax.process_count()
+    if n == 1:
+        return reader
+    from ..reader.decorator import shard
+    return shard(reader, n, jax.process_index(), drop_uneven=drop_uneven)

@@ -6,4 +6,4 @@ plug in via paddle_tpu.reader.recordio when built.
 """
 
 from .decorator import (batch, buffered, cache, chain, compose,  # noqa
-                        firstn, map_readers, shuffle, xmap_readers)
+                        firstn, map_readers, shard, shuffle, xmap_readers)

@@ -7,7 +7,7 @@ from threading import Thread
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'prefetch_to_device',
-           'firstn', 'xmap_readers', 'cache', 'batch']
+           'firstn', 'xmap_readers', 'cache', 'batch', 'shard']
 
 
 def map_readers(func, *readers):
@@ -218,3 +218,31 @@ def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
             yield queue.popleft()
 
     return device_reader
+
+
+def shard(reader, num_shards, shard_id, drop_uneven=True):
+    """Deterministic round-robin shard of a reader stream: shard i yields
+    samples i, i+n, i+2n, ... Every host must construct the SAME base
+    reader (same seed/order); the shards are then disjoint and together
+    cover the stream — the role go/master/service.go:1-510 plays with its
+    task queue, done as a pure function of position so there is no
+    master to run or lose.
+
+    drop_uneven=True drops the ragged tail so all shards yield the SAME
+    number of samples — required under SPMD, where every host must step
+    the same number of times or the collectives deadlock.
+    """
+    if not 0 <= shard_id < num_shards:
+        raise ValueError('shard_id %d not in [0, %d)' % (shard_id,
+                                                         num_shards))
+
+    def impl():
+        buf = []
+        for i, item in enumerate(reader()):
+            if i % num_shards == shard_id:
+                buf.append(item)
+            if len(buf) and (i + 1) % num_shards == 0:
+                yield buf.pop()
+        if buf and not drop_uneven:
+            yield buf.pop()
+    return impl

@@ -68,6 +68,11 @@ class Trainer(object):
     def train(self, num_epochs, event_handler=None, reader=None,
               feed_order=None, feeder=None):
         event_handler = event_handler or (lambda e: None)
+        if reader is not None:
+            # Multihost: each host consumes a disjoint shard of the stream
+            # (parallel.multihost.shard_reader; no-op on a single host).
+            from .parallel.multihost import shard_reader
+            reader = shard_reader(reader)
         self.exe.run(self.startup)
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))

@@ -1,0 +1,106 @@
+"""Image preprocessing (reference: python/paddle/v2/image.py) + reader
+wiring."""
+
+import io
+
+import numpy as np
+
+from paddle_tpu import image
+
+
+def _checker(h, w):
+    im = np.zeros((h, w, 3), dtype='uint8')
+    im[::2, ::2] = 255
+    im[:, :, 1] = (np.arange(w) % 256).astype('uint8')
+    return im
+
+
+def test_resize_short_keeps_aspect():
+    im = _checker(40, 80)
+    out = image.resize_short(im, 20)
+    assert out.shape[:2] == (20, 40)
+    out2 = image.resize_short(_checker(80, 40), 20)
+    assert out2.shape[:2] == (40, 20)
+
+
+def test_crops_and_flip():
+    im = _checker(30, 40)
+    c = image.center_crop(im, 20)
+    assert c.shape == (20, 20, 3)
+    np.testing.assert_array_equal(c, im[5:25, 10:30])
+    rng = np.random.RandomState(0)
+    rc = image.random_crop(im, 16, rng=rng)
+    assert rc.shape == (16, 16, 3)
+    f = image.left_right_flip(im)
+    np.testing.assert_array_equal(f, im[:, ::-1])
+
+
+def test_to_chw_and_simple_transform():
+    im = _checker(50, 60)
+    chw = image.to_chw(im)
+    assert chw.shape == (3, 50, 60)
+    rng = np.random.RandomState(1)
+    out = image.simple_transform(im, 32, 24, is_train=True,
+                                 mean=[1.0, 2.0, 3.0], rng=rng)
+    assert out.shape == (3, 24, 24)
+    assert out.dtype == np.float32
+    out_eval = image.simple_transform(im, 32, 24, is_train=False)
+    # eval path is deterministic: center crop of resize_short
+    again = image.simple_transform(im, 32, 24, is_train=False)
+    np.testing.assert_array_equal(out_eval, again)
+
+
+def test_load_image_bytes_roundtrip(tmp_path):
+    from PIL import Image
+    im = _checker(24, 24)
+    buf = io.BytesIO()
+    Image.fromarray(im).save(buf, format='PNG')
+    decoded = image.load_image_bytes(buf.getvalue())
+    np.testing.assert_array_equal(decoded, im)
+    p = tmp_path / 'x.png'
+    Image.fromarray(im).save(str(p))
+    loaded = image.load_image(str(p))
+    np.testing.assert_array_equal(loaded, im)
+    gray = image.load_image(str(p), is_color=False)
+    assert gray.ndim == 2
+
+
+def test_batch_images_from_tar(tmp_path):
+    import pickle
+    import tarfile
+    from PIL import Image
+    tar_path = str(tmp_path / 'imgs.tar')
+    img2label = {}
+    with tarfile.open(tar_path, 'w') as tf:
+        for i in range(5):
+            buf = io.BytesIO()
+            Image.fromarray(_checker(8, 8)).save(buf, format='PNG')
+            data = buf.getvalue()
+            info = tarfile.TarInfo('img_%d.png' % i)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            img2label['img_%d.png' % i] = i % 2
+    meta = image.batch_images_from_tar(tar_path, 'train', img2label,
+                                       num_per_batch=2)
+    batches = open(meta).read().splitlines()
+    assert len(batches) == 3  # 5 images / 2 per batch
+    with open(batches[0], 'rb') as f:
+        b0 = pickle.load(f)
+    assert len(b0['data']) == 2 and len(b0['label']) == 2
+
+
+def test_flowers_reader_uses_image_pipeline():
+    from paddle_tpu.dataset import flowers
+    img, label = next(flowers.train()())
+    assert img.shape == (3, flowers.CROP_SIZE, flowers.CROP_SIZE)
+    assert img.dtype == np.float32
+    assert np.abs(img).max() <= 1.0 + 1e-6  # mean/scale applied
+    assert 0 <= label < flowers.CLASS_NUM
+    img_t, _ = next(flowers.test()())
+    assert img_t.shape == (3, flowers.CROP_SIZE, flowers.CROP_SIZE)
+
+
+def test_voc2012_reader_chw():
+    from paddle_tpu.dataset import voc2012
+    img, seg = next(voc2012.train()())
+    assert img.shape[0] == 3 and img.shape[1:] == seg.shape
