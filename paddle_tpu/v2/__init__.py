@@ -1,0 +1,61 @@
+"""paddle.v2-compatible high-level API (reference: python/paddle/v2/
+__init__.py — layer/data_type/activation/attr/pooling/parameters/
+trainer/event/inference/minibatch/networks/optimizer/dataset/reader/
+image).
+
+The reference v2 stack compiles layer configs into a protobuf Topology
+executed by the C++ GradientMachine; here every v2 call builds fluid IR
+directly, so a v2 model is an ordinary Program that jits to one XLA
+computation and shards over the mesh like any other.
+
+Typical book-chapter usage works verbatim:
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name='x',
+                          type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name='y', type=paddle.data_type.dense_vector(1))
+    y_ = paddle.layer.fc(input=x, size=1,
+                         act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=y_, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=1e-3))
+    trainer.train(reader=paddle.batch(train_reader, 32),
+                  num_passes=10, event_handler=handler,
+                  feeding={'x': 0, 'y': 1})
+    out = paddle.infer(output_layer=y_, input=test_samples,
+                       feeding={'x': 0})
+"""
+
+from . import activation  # noqa: F401
+from . import attr  # noqa: F401
+from . import data_type  # noqa: F401
+from . import event  # noqa: F401
+from . import inference  # noqa: F401
+from . import layer  # noqa: F401
+from . import minibatch  # noqa: F401
+from . import networks  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import parameters  # noqa: F401
+from . import pooling  # noqa: F401
+from . import trainer  # noqa: F401
+from .. import dataset  # noqa: F401
+from .. import image  # noqa: F401
+from .. import reader  # noqa: F401
+from .inference import infer  # noqa: F401
+from .minibatch import batch  # noqa: F401
+
+__all__ = ['init', 'layer', 'data_type', 'activation', 'attr', 'pooling',
+           'parameters', 'trainer', 'event', 'inference', 'infer',
+           'minibatch', 'batch', 'networks', 'optimizer', 'dataset',
+           'reader', 'image']
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """Reference paddle.v2.init parsed gflags and spawned trainers; the
+    TPU runtime needs neither — kept for source compatibility. Multi-host
+    setups call parallel.multihost.init_distributed instead."""
+    return None

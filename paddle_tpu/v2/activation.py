@@ -1,0 +1,51 @@
+"""v2 activation descriptors (reference: python/paddle/v2/activation.py)."""
+
+__all__ = ['Linear', 'Relu', 'Sigmoid', 'Tanh', 'Softmax', 'Exp', 'Log',
+           'Square', 'SoftRelu', 'STanh']
+
+
+class _Act(object):
+    name = None
+
+    def __repr__(self):
+        return 'activation.%s' % type(self).__name__
+
+
+class Linear(_Act):
+    name = None
+
+
+class Relu(_Act):
+    name = 'relu'
+
+
+class Sigmoid(_Act):
+    name = 'sigmoid'
+
+
+class Tanh(_Act):
+    name = 'tanh'
+
+
+class Softmax(_Act):
+    name = 'softmax'
+
+
+class Exp(_Act):
+    name = 'exp'
+
+
+class Log(_Act):
+    name = 'log'
+
+
+class Square(_Act):
+    name = 'square'
+
+
+class SoftRelu(_Act):
+    name = 'softplus'
+
+
+class STanh(_Act):
+    name = 'tanh'

@@ -1,0 +1,29 @@
+"""v2 parameter attributes (reference: python/paddle/v2/attr.py) mapped
+onto fluid ParamAttr."""
+
+from ..param_attr import ParamAttr
+from ..initializer import NormalInitializer
+from ..regularizer import L2Decay
+
+__all__ = ['Param', 'ParamAttr', 'Extra', 'ExtraAttr']
+
+
+def Param(name=None, initial_std=None, initial_mean=None, l2_rate=None,
+          learning_rate=None, **kwargs):
+    init = None
+    if initial_std is not None or initial_mean is not None:
+        init = NormalInitializer(loc=initial_mean or 0.0,
+                                 scale=initial_std
+                                 if initial_std is not None else 0.01)
+    reg = L2Decay(l2_rate) if l2_rate else None
+    return ParamAttr(name=name, initializer=init, regularizer=reg,
+                     learning_rate=learning_rate
+                     if learning_rate is not None else 1.0)
+
+
+class ExtraAttr(object):
+    def __init__(self, **kwargs):
+        self.attrs = kwargs
+
+
+Extra = ExtraAttr

@@ -1,0 +1,48 @@
+"""v2 input type descriptors (reference: python/paddle/v2/data_type.py).
+Each describes one slot of a training sample; layer.data turns it into a
+fluid data Variable. Sequence types become padded dense batches
+(SURVEY §6: LoD -> pad + mask)."""
+
+__all__ = [
+    'dense_vector', 'dense_array', 'integer_value', 'dense_vector_sequence',
+    'integer_value_sequence', 'sparse_binary_vector', 'sparse_float_vector',
+    'InputType',
+]
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, dtype, shape=None):
+        self.dim = dim
+        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        self.dtype = dtype
+        self.shape = shape if shape is not None else [dim]
+
+
+def dense_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, 'float32')
+
+
+def dense_array(dim, shape, seq_type=0):
+    return InputType(dim, seq_type, 'float32', shape=list(shape))
+
+
+def integer_value(value_range, seq_type=0):
+    return InputType(value_range, seq_type, 'int64', shape=[1])
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, seq_type=1)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, seq_type=1)
+
+
+def sparse_binary_vector(dim, seq_type=0):
+    # dense one/multi-hot stand-in: the TPU path has no sparse tensor
+    # type; CTR-scale sparsity is handled by row-sharded embeddings.
+    return InputType(dim, seq_type, 'float32')
+
+
+def sparse_float_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, 'float32')

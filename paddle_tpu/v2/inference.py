@@ -1,0 +1,36 @@
+"""v2 inference (reference: python/paddle/v2/inference.py infer())."""
+
+import numpy as np
+
+from ..core.executor import Executor
+from ..core.place import TPUPlace
+from ..core.program import default_main_program
+from .trainer import _build_feed
+
+__all__ = ['infer', 'Inference']
+
+
+class Inference(object):
+    def __init__(self, output_layer, parameters=None, place=None):
+        self.outputs = output_layer if isinstance(output_layer,
+                                                  (list, tuple)) \
+            else [output_layer]
+        self.program = default_main_program().clone(for_test=True)
+        self.exe = Executor(place if place is not None else TPUPlace(0))
+        self._feed_names = [v.name for v in
+                            self.program.global_block().vars.values()
+                            if getattr(v, 'is_data', False)]
+
+    def infer(self, input, feeding=None, field='value'):
+        feed = _build_feed(input, feeding, self._feed_names)
+        # drop feeds the pruned inference graph doesn't consume (e.g.
+        # the label slot)
+        outs = self.exe.run(program=self.program.prune(self.outputs),
+                            feed=feed, fetch_list=self.outputs)
+        outs = [np.asarray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters=None, input=None, feeding=None,
+          field='value'):
+    return Inference(output_layer, parameters).infer(input, feeding, field)

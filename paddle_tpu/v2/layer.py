@@ -1,0 +1,128 @@
+"""v2 layer API over the fluid IR.
+
+Reference: python/paddle/v2/layer.py:1-326 (wraps trainer_config_helpers
+into declarative layer objects resolved by Topology). Here each call
+builds fluid IR ops EAGERLY into the default program — the Program IS
+the topology, so parse_network/Topology reduce to Program bookkeeping
+and the whole v2 graph compiles to one XLA computation like any fluid
+program.
+
+Sequence inputs (seq_type=1) arrive as padded [B, T] batches (SURVEY §6
+LoD stance); v2 sequence layers (embedding over a sequence, seq pooling)
+operate on the padded time axis with an implicit nonzero mask.
+"""
+
+from .. import layers as _fl
+from . import activation as _act_mod
+from .data_type import InputType
+
+__all__ = ['data', 'fc', 'embedding', 'img_conv', 'img_pool', 'concat',
+           'dropout', 'batch_norm', 'pooling', 'classification_cost',
+           'cross_entropy_cost', 'square_error_cost', 'mse_cost',
+           'parse_network']
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    return act.name if hasattr(act, 'name') else act
+
+
+def data(name, type, height=None, width=None):
+    """Input slot (v2/layer.py __data_layer__). `type` is a
+    data_type.InputType; sequences get a padded time axis of unspecified
+    length (fed per-batch, bucketed recompile)."""
+    assert isinstance(type, InputType)
+    shape = list(type.shape)
+    if type.seq_type:
+        # padded [T] leading time axis before the per-step shape; T is
+        # set by the fed batch (executor recompiles per bucket).
+        shape = [-1] + (shape if shape != [1] else [])
+        var = _fl.data(name=name, shape=shape, dtype=type.dtype)
+    else:
+        var = _fl.data(name=name, shape=shape, dtype=type.dtype)
+    var._v2_type = type
+    return var
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
+       **kwargs):
+    # fluid fc flattens trailing dims itself (num_flatten_dims=1), which
+    # matches v2 fc over conv feature maps.
+    return _fl.fc(input=input, size=size, act=_act_name(act),
+                  param_attr=param_attr,
+                  bias_attr=bias_attr if bias_attr is not None else None,
+                  name=name)
+
+
+def embedding(input, size, param_attr=None, is_sparse=False,
+              vocab_size=None, **kwargs):
+    """Vocab comes from the data layer's integer_value range, like the
+    reference's embedding over an id slot."""
+    t = getattr(input, '_v2_type', None)
+    vocab = vocab_size if vocab_size is not None else \
+        (t.dim if t is not None else None)
+    if vocab is None:
+        raise ValueError('embedding needs an input built by v2.layer.data '
+                         'with an integer_value type (or pass vocab_size=)')
+    return _fl.embedding(input=input, size=[vocab, size],
+                         is_sparse=is_sparse, param_attr=param_attr)
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, param_attr=None, bias_attr=None,
+             **kwargs):
+    return _fl.conv2d(input=input, num_filters=num_filters,
+                      filter_size=filter_size, stride=stride,
+                      padding=padding, act=_act_name(act),
+                      param_attr=param_attr, bias_attr=bias_attr)
+
+
+def img_pool(input, pool_size, stride=1, padding=0, pool_type=None,
+             **kwargs):
+    name = getattr(pool_type, 'name', pool_type) or 'max'
+    return _fl.pool2d(input=input, pool_size=pool_size, pool_stride=stride,
+                      pool_padding=padding, pool_type=name)
+
+
+def concat(input, name=None, **kwargs):
+    return _fl.concat(input=list(input), axis=-1)
+
+
+def dropout(input, dropout_rate, **kwargs):
+    return _fl.dropout(input, dropout_prob=dropout_rate)
+
+
+def batch_norm(input, act=None, **kwargs):
+    return _fl.batch_norm(input=input, act=_act_name(act))
+
+
+def pooling(input, pooling_type=None, **kwargs):
+    """Sequence pooling over the padded time axis (v2 pooling layer);
+    nonzero-mask semantics are the lod.py stance."""
+    name = getattr(pooling_type, 'name', pooling_type) or 'sum'
+    from ..layers import sequence
+    return sequence.sequence_pool(input=input, pool_type=name)
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    """input must be class probabilities (fc with Softmax activation),
+    like the reference's classification_cost over a softmax output."""
+    return _fl.mean(_fl.cross_entropy(input=input, label=label))
+
+
+cross_entropy_cost = classification_cost
+
+
+def square_error_cost(input, label, **kwargs):
+    return _fl.mean(_fl.square_error_cost(input=input, label=label))
+
+
+mse_cost = square_error_cost
+
+
+def parse_network(*outputs):
+    """The Program pruned to `outputs` (reference parse_network returns
+    the sub-model protobuf; here the pruned Program plays that role)."""
+    from ..core.program import default_main_program
+    return default_main_program().prune(list(outputs))

@@ -1,0 +1,8 @@
+"""v2 minibatch (reference: python/paddle/v2/minibatch.py)."""
+
+__all__ = ['batch']
+
+
+def batch(reader, batch_size, drop_last=True):
+    from ..reader.decorator import batch as _batch
+    return _batch(reader, batch_size, drop_last=drop_last)

@@ -1,0 +1,58 @@
+"""v2 networks (reference: python/paddle/v2/networks.py wraps
+trainer_config_helpers.networks) — composed from v2 layers."""
+
+from . import layer as v2_layer
+from .. import nets as _nets
+
+__all__ = ['simple_img_conv_pool', 'img_conv_group', 'sequence_conv_pool',
+           'simple_gru', 'simple_lstm', 'glu', 'scaled_dot_product_attention']
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kwargs):
+    return _nets.simple_img_conv_pool(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        pool_size=pool_size, pool_stride=pool_stride,
+        act=getattr(act, 'name', act))
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, pool_stride=1,
+                   pool_type='max', **kwargs):
+    return _nets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter, pool_size=pool_size,
+        conv_padding=conv_padding, conv_filter_size=conv_filter_size,
+        conv_act=getattr(conv_act, 'name', conv_act),
+        pool_stride=pool_stride, pool_type=getattr(pool_type, 'name',
+                                                   pool_type))
+
+
+def sequence_conv_pool(input, context_len, hidden_size, **kwargs):
+    return _nets.sequence_conv_pool(input=input, num_filters=hidden_size,
+                                    filter_size=context_len)
+
+
+def simple_gru(input, size, **kwargs):
+    from ..layers import rnn as _rnn
+    return _rnn.simple_gru(input=input, size=size) \
+        if hasattr(_rnn, 'simple_gru') else _unsupported('simple_gru')
+
+
+def simple_lstm(input, size, **kwargs):
+    from ..layers import rnn as _rnn
+    return _rnn.simple_lstm(input=input, size=size) \
+        if hasattr(_rnn, 'simple_lstm') else _unsupported('simple_lstm')
+
+
+def glu(input, dim=-1, **kwargs):
+    return _nets.glu(input=input, dim=dim)
+
+
+def scaled_dot_product_attention(queries, keys, values, **kwargs):
+    return _nets.scaled_dot_product_attention(queries, keys, values,
+                                              **kwargs)
+
+
+def _unsupported(name):
+    raise NotImplementedError('%s: build it with fluid.layers.rnn '
+                              'StaticRNN/DynamicRNN instead' % name)

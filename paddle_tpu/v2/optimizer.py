@@ -1,0 +1,61 @@
+"""v2 optimizers (reference: python/paddle/v2/optimizer.py) — thin
+construction shims over the fluid optimizer classes (the update equation
+runs inside the jitted step, not a separate GradientMachine pass)."""
+
+from .. import optimizer as _fo
+from ..regularizer import L2Decay
+
+__all__ = ['Momentum', 'Adam', 'Adamax', 'AdaGrad', 'DecayedAdaGrad',
+           'AdaDelta', 'RMSProp', 'ModelAverage', 'L2Regularization']
+
+
+def L2Regularization(rate):
+    return L2Decay(rate)
+
+
+def _reg(regularization):
+    return regularization
+
+
+def Momentum(momentum=None, learning_rate=1e-3, regularization=None,
+             sparse=False, **kwargs):
+    return _fo.Momentum(learning_rate=learning_rate,
+                        momentum=momentum or 0.0,
+                        regularization=_reg(regularization))
+
+
+def Adam(beta1=0.9, beta2=0.999, epsilon=1e-8, learning_rate=1e-3,
+         regularization=None, **kwargs):
+    return _fo.Adam(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                    epsilon=epsilon, regularization=_reg(regularization))
+
+
+def Adamax(beta1=0.9, beta2=0.999, learning_rate=1e-3, **kwargs):
+    return _fo.Adamax(learning_rate=learning_rate, beta1=beta1,
+                      beta2=beta2)
+
+
+def AdaGrad(learning_rate=1e-3, regularization=None, **kwargs):
+    return _fo.Adagrad(learning_rate=learning_rate,
+                       regularization=_reg(regularization))
+
+
+def DecayedAdaGrad(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return _fo.DecayedAdagrad(learning_rate=learning_rate, decay=rho,
+                              epsilon=epsilon)
+
+
+def AdaDelta(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return _fo.Adadelta(learning_rate=learning_rate, rho=rho,
+                        epsilon=epsilon)
+
+
+def RMSProp(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return _fo.RMSProp(learning_rate=learning_rate, rho=rho,
+                       epsilon=epsilon)
+
+
+def ModelAverage(average_window, **kwargs):
+    raise NotImplementedError(
+        'ModelAverage is not supported; use checkpoint averaging over '
+        'io.save_params snapshots instead')

@@ -1,0 +1,109 @@
+"""v2 Parameters (reference: python/paddle/v2/parameters.py:27-404).
+
+The reference Parameters shuttles numpy arrays in/out of the C++
+GradientMachine; here it is a live view over the fluid global scope —
+__getitem__/__setitem__ read/write the device arrays the jitted step
+trains, and to_tar/from_tar serialize them, keeping the reference's
+checkpoint workflow (event handler calling parameters.to_tar) intact.
+"""
+
+import io
+import pickle
+import tarfile
+
+import numpy as np
+
+__all__ = ['Parameters', 'create']
+
+
+def create(*layers):
+    """Materialize all parameters reachable from the cost layer(s): runs
+    the startup program (init ops) and returns the Parameters view."""
+    from ..core.executor import Executor
+    from ..core.place import CPUPlace
+    from ..core.program import (default_main_program,
+                                default_startup_program)
+    Executor(CPUPlace()).run(default_startup_program())
+    return Parameters(default_main_program())
+
+
+class Parameters(object):
+    def __init__(self, program=None):
+        from ..core.program import default_main_program
+        self._program = program or default_main_program()
+
+    def names(self):
+        return [p.name for p in self._program.global_block()
+                .all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self.names()
+
+    def __contains__(self, key):
+        return self.has_key(key)
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __getitem__(self, key):
+        from ..core.scope import global_scope
+        val = global_scope().find(key)
+        if val is None:
+            raise KeyError('parameter %r is not initialized' % key)
+        return np.asarray(val)
+
+    def __setitem__(self, key, value):
+        from ..core.scope import global_scope
+        var = self._program.global_block()._find_var_recursive(key)
+        if var is None:
+            raise KeyError('no parameter named %r' % key)
+        arr = np.asarray(value, dtype='float32').reshape(var.shape)
+        global_scope().set(key, arr)
+
+    def get(self, key):
+        return self.__getitem__(key)
+
+    def set(self, key, value):
+        self.__setitem__(key, value)
+
+    def get_shape(self, key):
+        var = self._program.global_block()._find_var_recursive(key)
+        if var is None:
+            raise KeyError('no parameter named %r' % key)
+        return tuple(var.shape)
+
+    # ---- serialization (reference to_tar/from_tar) ----
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode='w') as tf:
+            for name in self.names():
+                arr = self[name]
+                buf = io.BytesIO()
+                np.save(buf, arr)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            meta = pickle.dumps({'names': self.names()}, protocol=2)
+            info = tarfile.TarInfo('__meta__')
+            info.size = len(meta)
+            tf.addfile(info, io.BytesIO(meta))
+
+    @staticmethod
+    def from_tar(f):
+        """Returns {name: ndarray}; use init_from_tar to load into a
+        live topology."""
+        out = {}
+        with tarfile.open(fileobj=f, mode='r') as tf:
+            for m in tf.getmembers():
+                if m.name == '__meta__':
+                    continue
+                out[m.name] = np.load(io.BytesIO(tf.extractfile(m).read()))
+        return out
+
+    def init_from_tar(self, f):
+        for name, arr in Parameters.from_tar(f).items():
+            if self.has_key(name):
+                self[name] = arr

@@ -1,0 +1,23 @@
+"""v2 pooling descriptors (reference: python/paddle/v2/pooling.py)."""
+
+__all__ = ['Max', 'Avg', 'Sum', 'CudnnMax', 'CudnnAvg']
+
+
+class _Pool(object):
+    name = None
+
+
+class Max(_Pool):
+    name = 'max'
+
+
+class Avg(_Pool):
+    name = 'avg'
+
+
+class Sum(_Pool):
+    name = 'sum'
+
+
+CudnnMax = Max
+CudnnAvg = Avg

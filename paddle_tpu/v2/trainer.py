@@ -1,0 +1,113 @@
+"""v2 event-driven trainer (reference: python/paddle/v2/trainer.py:37-249
+SGD.train/test with BeginPass/EndIteration events over a reader).
+
+The reference forwards/backwards through the C++ GradientMachine per
+batch; here SGD.train compiles the whole (cost, update) program once via
+the fluid Executor and the loop is pure dispatch — events and feeding
+keep the exact reference contract, including `feeding` as a name->tuple
+-position map."""
+
+import numpy as np
+
+from . import event as v2_event
+from ..core.executor import Executor
+from ..core.place import TPUPlace
+from ..core.program import default_main_program, default_startup_program
+from ..parallel.multihost import shard_reader
+
+__all__ = ['SGD']
+
+
+def _build_feed(data_batch, feeding, feed_names):
+    """data_batch: list of sample tuples (or dicts). feeding maps data
+    layer name -> position in the tuple."""
+    if isinstance(data_batch, dict):
+        return data_batch
+    if feeding is None:
+        feeding = {name: i for i, name in enumerate(feed_names)}
+    feed = {}
+    for name, pos in feeding.items():
+        col = [sample[pos] for sample in data_batch]
+        try:
+            arr = np.asarray(col)
+            ragged = arr.dtype == object
+        except ValueError:  # inhomogeneous lengths
+            ragged = True
+        if ragged:
+            # ragged sequence slot -> pad to the batch max (LoD stance)
+            maxlen = max(len(c) for c in col)
+            first = np.asarray(col[0])
+            arr = np.zeros((len(col), maxlen) + first.shape[1:],
+                           first.dtype)
+            for i, c in enumerate(col):
+                c = np.asarray(c)
+                arr[i, :len(c)] = c
+        feed[name] = arr
+    return feed
+
+
+class SGD(object):
+    """paddle.v2.trainer.SGD(cost, parameters, update_equation)."""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True, place=None):
+        self.cost = cost
+        self.parameters = parameters
+        self.program = default_main_program()
+        self.startup = default_startup_program()
+        update_equation.minimize(cost)
+        self.exe = Executor(place if place is not None else TPUPlace(0))
+        # parameters.create() already ran the startup for the model params
+        # (reference order: params first, update_equation later); run ONLY
+        # the init ops the optimizer just appended (accumulators, lr), so
+        # user-set / trained parameter values survive.
+        self._init_missing_startup_vars()
+        self._feed_names = [v.name for v in
+                            self.program.global_block().vars.values()
+                            if getattr(v, 'is_data', False)]
+        self._extra = extra_layers or []
+
+    def _init_missing_startup_vars(self):
+        from ..core.scope import global_scope
+        scope = global_scope()
+        pending = self.startup.clone()
+        block = pending.global_block()
+        block.ops = [op for op in block.ops
+                     if any(scope.find(n) is None
+                            for n in op.output_names())]
+        if block.ops:
+            self.exe.run(pending)
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        event_handler = event_handler or (lambda e: None)
+        reader = shard_reader(reader)
+        fetch = [self.cost] + list(self._extra)
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            for batch_id, data in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = _build_feed(data, feeding, self._feed_names)
+                outs = self.exe.run(program=self.program, feed=feed,
+                                    fetch_list=fetch)
+                cost = float(np.asarray(outs[0]).reshape(()))
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost,
+                    metrics={getattr(v, 'name', str(i)):
+                             np.asarray(outs[1 + i])
+                             for i, v in enumerate(self._extra)}))
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        inference = self.program.clone(for_test=True)
+        costs, n = 0.0, 0
+        for data in reader():
+            feed = _build_feed(data, feeding, self._feed_names)
+            out = self.exe.run(program=inference, feed=feed,
+                               fetch_list=[self.cost])
+            bs = len(data) if not isinstance(data, dict) else 1
+            costs += float(np.asarray(out[0]).reshape(())) * bs
+            n += bs
+        return v2_event.TestResult(cost=costs / max(n, 1))
+
+    def save_parameter_to_tar(self, f):
+        self.parameters.to_tar(f)

@@ -1,0 +1,144 @@
+"""v2 high-level API: book-chapter style programs run verbatim over the
+fluid IR (reference: python/paddle/v2 — layer.py, trainer.py:37-249,
+parameters.py:27-404, inference.py)."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def test_fit_a_line_v2_style():
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name='y', type=paddle.data_type.dense_vector(1))
+    y_ = paddle.layer.fc(input=x, size=1, act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=y_, label=y)
+    params = paddle.parameters.create(cost)
+    assert len(params.names()) == 2  # weight + bias
+
+    w_true = np.random.RandomState(0).randn(13, 1).astype('float32')
+
+    def train_reader():
+        rng = np.random.RandomState(1)
+        for _ in range(40):
+            xs = rng.randn(13).astype('float32')
+            yield xs, (xs @ w_true + 0.5).astype('float32')
+
+    events = []
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.01),
+        place=__import__('paddle_tpu').CPUPlace())
+    trainer.train(reader=paddle.batch(train_reader, 20), num_passes=30,
+                  event_handler=events.append, feeding={'x': 0, 'y': 1})
+    end_iters = [e for e in events
+                 if isinstance(e, paddle.event.EndIteration)]
+    assert end_iters[-1].cost < end_iters[0].cost * 0.1
+    assert any(isinstance(e, paddle.event.EndPass) for e in events)
+
+    # inference over the trained params
+    samples = [(np.zeros(13, 'float32'),)]
+    out = paddle.infer(output_layer=y_, parameters=params, input=samples,
+                       feeding={'x': 0})
+    assert out.shape == (1, 1)
+    np.testing.assert_allclose(out[0, 0], 0.5, atol=0.2)
+
+
+def test_recognize_digits_v2_style():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    images = paddle.layer.data(
+        name='pixel', type=paddle.data_type.dense_array(784, [1, 16, 16]))
+    label = paddle.layer.data(name='label',
+                              type=paddle.data_type.integer_value(10))
+    conv_pool = paddle.networks.simple_img_conv_pool(
+        input=images, filter_size=3, num_filters=4, pool_size=2,
+        pool_stride=2, act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=conv_pool, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    params = paddle.parameters.create(cost)
+
+    def reader():
+        rng = np.random.RandomState(2)
+        for _ in range(16):
+            lab = int(rng.randint(10))
+            img = np.full((1, 16, 16), lab / 10.0, 'float32')
+            yield img, lab
+
+    costs = []
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=2e-2),
+        place=__import__('paddle_tpu').CPUPlace())
+    trainer.train(
+        reader=paddle.batch(reader, 16), num_passes=40,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.5
+    result = trainer.test(reader=paddle.batch(reader, 16))
+    assert np.isfinite(result.cost)
+
+
+def test_parameters_get_set_and_tar_roundtrip():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(4))
+    h = paddle.layer.fc(input=x, size=3,
+                        param_attr=paddle.attr.Param(name='v2_w',
+                                                     initial_std=0.1))
+    params = paddle.parameters.create(h)
+    assert 'v2_w' in params
+    assert params.get_shape('v2_w') == (4, 3)
+    w = params['v2_w']
+    assert w.shape == (4, 3)
+    params['v2_w'] = np.ones((4, 3), 'float32')
+    np.testing.assert_array_equal(params['v2_w'], np.ones((4, 3)))
+
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    params['v2_w'] = np.zeros((4, 3), 'float32')
+    buf.seek(0)
+    params.init_from_tar(buf)
+    np.testing.assert_array_equal(params['v2_w'], np.ones((4, 3)))
+
+
+def test_embedding_and_sequence_padding():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    words = paddle.layer.data(
+        name='words', type=paddle.data_type.integer_value_sequence(50))
+    emb = paddle.layer.embedding(input=words, size=8)
+    pooled = paddle.layer.pooling(input=emb,
+                                  pooling_type=paddle.pooling.Sum())
+    probs = paddle.layer.fc(input=pooled, size=2,
+                            act=paddle.activation.Softmax())
+    label = paddle.layer.data(name='label',
+                              type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=probs, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.AdaGrad(learning_rate=0.05),
+        place=__import__('paddle_tpu').CPUPlace())
+
+    def reader():
+        rng = np.random.RandomState(3)
+        for _ in range(8):
+            n = int(rng.randint(2, 6))  # ragged lengths -> padded batch
+            seq = rng.randint(1, 50, n).astype('int64')
+            yield seq, int(seq[0] % 2)
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 8), num_passes=3,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        feeding={'words': 0, 'label': 1})
+    assert np.isfinite(costs).all()
