@@ -311,6 +311,11 @@ class Program(object):
                                   persistable=v.persistable,
                                   stop_gradient=v.stop_gradient,
                                   is_data=v.is_data, trainable=v.trainable)
+                # carry layer-attached annotations (v2 input types,
+                # row_shard hints) through the copy
+                for extra in ('_v2_type', '_v2_len_var', 'row_shard'):
+                    if hasattr(v, extra):
+                        setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv
             for op in b.ops:
                 if for_test and op.type in ('backward_marker',) :

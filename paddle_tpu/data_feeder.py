@@ -33,6 +33,23 @@ class DataFeeder(object):
         for i, var in enumerate(self.feed_vars):
             cols = [row[i] for row in rows]
             dtype = canonical_dtype(var.dtype)
+            v2_type = getattr(var, '_v2_type', None)
+            if v2_type is not None and getattr(v2_type, 'kind', None) in \
+                    ('sparse_binary', 'sparse_float'):
+                # v2 sparse slots: samples are index lists (binary) or
+                # (index, value) pairs (float) — densify to multi-hot
+                # (reference readers yield these for sparse_binary_vector /
+                # sparse_float_vector; the TPU path has no sparse tensor).
+                batch = np.zeros((len(cols), v2_type.dim), dtype=dtype)
+                for j, c in enumerate(cols):
+                    if v2_type.kind == 'sparse_binary':
+                        idx = np.asarray(c, dtype='int64').reshape(-1)
+                        batch[j, idx] = 1.0
+                    else:
+                        for idx, val in c:
+                            batch[j, int(idx)] = val
+                feed[var.name] = batch
+                continue
             if var.lod_level and var.lod_level > 0:
                 arrs = [np.asarray(c) for c in cols]
                 max_len = max(a.shape[0] for a in arrs)

@@ -48,4 +48,4 @@ class SoftRelu(_Act):
 
 
 class STanh(_Act):
-    name = 'tanh'
+    name = 'stanh'  # 1.7159 * tanh(2x/3), reference scaled-tanh

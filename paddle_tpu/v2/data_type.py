@@ -11,11 +11,14 @@ __all__ = [
 
 
 class InputType(object):
-    def __init__(self, dim, seq_type, dtype, shape=None):
+    def __init__(self, dim, seq_type, dtype, shape=None, kind='dense'):
         self.dim = dim
         self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
         self.dtype = dtype
         self.shape = shape if shape is not None else [dim]
+        # 'dense' | 'sparse_binary' (index lists) | 'sparse_float'
+        # ((index, value) pairs) — consumed by DataFeeder densification
+        self.kind = kind
 
 
 def dense_vector(dim, seq_type=0):
@@ -39,10 +42,12 @@ def integer_value_sequence(value_range):
 
 
 def sparse_binary_vector(dim, seq_type=0):
-    # dense one/multi-hot stand-in: the TPU path has no sparse tensor
-    # type; CTR-scale sparsity is handled by row-sharded embeddings.
-    return InputType(dim, seq_type, 'float32')
+    """Samples are lists of active indices (reference data_type) —
+    densified to a multi-hot [dim] row at feed time; CTR-scale sparsity
+    belongs in row-sharded embeddings instead."""
+    return InputType(dim, seq_type, 'float32', kind='sparse_binary')
 
 
 def sparse_float_vector(dim, seq_type=0):
-    return InputType(dim, seq_type, 'float32')
+    """Samples are (index, value) pair lists, densified at feed time."""
+    return InputType(dim, seq_type, 'float32', kind='sparse_float')

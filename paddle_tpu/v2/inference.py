@@ -5,7 +5,7 @@ import numpy as np
 from ..core.executor import Executor
 from ..core.place import TPUPlace
 from ..core.program import default_main_program
-from .trainer import _build_feed
+from .trainer import _build_feed, _user_feed_names
 
 __all__ = ['infer', 'Inference']
 
@@ -15,18 +15,25 @@ class Inference(object):
         self.outputs = output_layer if isinstance(output_layer,
                                                   (list, tuple)) \
             else [output_layer]
-        self.program = default_main_program().clone(for_test=True)
+        # Prune ONCE: repeated infer() calls hit the Executor's compile
+        # cache (keyed on program identity) instead of re-jitting.
+        self.program = default_main_program().clone(
+            for_test=True).prune(self.outputs)
         self.exe = Executor(place if place is not None else TPUPlace(0))
-        self._feed_names = [v.name for v in
-                            self.program.global_block().vars.values()
-                            if getattr(v, 'is_data', False)]
+        # Feed names come from the PRUNED graph, so slots the outputs
+        # don't need (e.g. the label layer) aren't demanded of `input`.
+        from ..core.executor import _op_reads
+        consumed = set()
+        for op in self.program.global_block().ops:
+            consumed.update(_op_reads(op, self.program))
+        self._feed_names = [n for n in _user_feed_names(self.program)
+                            if n in consumed]
 
     def infer(self, input, feeding=None, field='value'):
-        feed = _build_feed(input, feeding, self._feed_names)
-        # drop feeds the pruned inference graph doesn't consume (e.g.
-        # the label slot)
-        outs = self.exe.run(program=self.program.prune(self.outputs),
-                            feed=feed, fetch_list=self.outputs)
+        feed = _build_feed(input, feeding, self._feed_names,
+                           program=self.program)
+        outs = self.exe.run(program=self.program, feed=feed,
+                            fetch_list=self.outputs)
         outs = [np.asarray(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs
 

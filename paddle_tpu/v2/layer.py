@@ -31,18 +31,32 @@ def _act_name(act):
 def data(name, type, height=None, width=None):
     """Input slot (v2/layer.py __data_layer__). `type` is a
     data_type.InputType; sequences get a padded time axis of unspecified
-    length (fed per-batch, bucketed recompile)."""
+    length (fed per-batch, bucketed recompile) plus a companion
+    '<name>_len' int32 vector DataFeeder emits — sequence layers mask
+    pad positions through it (SURVEY §6 LoD stance)."""
     assert isinstance(type, InputType)
     shape = list(type.shape)
     if type.seq_type:
         # padded [T] leading time axis before the per-step shape; T is
         # set by the fed batch (executor recompiles per bucket).
         shape = [-1] + (shape if shape != [1] else [])
-        var = _fl.data(name=name, shape=shape, dtype=type.dtype)
+        var = _fl.data(name=name, shape=shape, dtype=type.dtype,
+                       lod_level=1)
+        var._v2_len_var = _fl.data(name=name + '_len', shape=[],
+                                   dtype='int32')
     else:
         var = _fl.data(name=name, shape=shape, dtype=type.dtype)
     var._v2_type = type
     return var
+
+
+def _propagate_len(src, out):
+    """Sequence-preserving layers carry the length var to their output
+    so downstream sequence ops mask pad positions."""
+    lv = getattr(src, '_v2_len_var', None)
+    if lv is not None:
+        out._v2_len_var = lv
+    return out
 
 
 def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
@@ -65,8 +79,9 @@ def embedding(input, size, param_attr=None, is_sparse=False,
     if vocab is None:
         raise ValueError('embedding needs an input built by v2.layer.data '
                          'with an integer_value type (or pass vocab_size=)')
-    return _fl.embedding(input=input, size=[vocab, size],
-                         is_sparse=is_sparse, param_attr=param_attr)
+    return _propagate_len(input, _fl.embedding(
+        input=input, size=[vocab, size], is_sparse=is_sparse,
+        param_attr=param_attr))
 
 
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
@@ -90,7 +105,8 @@ def concat(input, name=None, **kwargs):
 
 
 def dropout(input, dropout_rate, **kwargs):
-    return _fl.dropout(input, dropout_prob=dropout_rate)
+    return _propagate_len(input, _fl.dropout(input,
+                                             dropout_prob=dropout_rate))
 
 
 def batch_norm(input, act=None, **kwargs):
@@ -98,11 +114,15 @@ def batch_norm(input, act=None, **kwargs):
 
 
 def pooling(input, pooling_type=None, **kwargs):
-    """Sequence pooling over the padded time axis (v2 pooling layer);
-    nonzero-mask semantics are the lod.py stance."""
+    """Sequence pooling over the padded time axis; pad positions are
+    masked through the data layer's '_len' var carried by
+    _propagate_len (avg divides by TRUE length, last takes the last
+    real step)."""
     name = getattr(pooling_type, 'name', pooling_type) or 'sum'
     from ..layers import sequence
-    return sequence.sequence_pool(input=input, pool_type=name)
+    return sequence.sequence_pool(input=input, pool_type=name,
+                                  length=getattr(input, '_v2_len_var',
+                                                 None))
 
 
 def classification_cost(input, label, name=None, **kwargs):

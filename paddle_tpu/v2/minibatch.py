@@ -3,6 +3,11 @@
 __all__ = ['batch']
 
 
-def batch(reader, batch_size, drop_last=True):
+def batch(reader, batch_size, drop_last=False):
+    """Reference v2 minibatch yields the final partial batch, so the
+    default here is drop_last=False (a dataset smaller than batch_size
+    must not silently train zero iterations); the tail batch costs one
+    extra XLA compile for its shape. Pass drop_last=True for fixed-shape
+    SPMD training loops."""
     from ..reader.decorator import batch as _batch
     return _batch(reader, batch_size, drop_last=drop_last)

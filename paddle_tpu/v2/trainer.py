@@ -18,32 +18,32 @@ from ..parallel.multihost import shard_reader
 __all__ = ['SGD']
 
 
-def _build_feed(data_batch, feeding, feed_names):
+def _build_feed(data_batch, feeding, feed_names, program=None):
     """data_batch: list of sample tuples (or dicts). feeding maps data
-    layer name -> position in the tuple."""
+    layer name -> position in the tuple. Delegates to the fluid
+    DataFeeder (ONE feeder implementation): padding + '<name>_len'
+    emission for sequence slots, sparse densification, dtype casts,
+    label [B] -> [B, 1] alignment."""
     if isinstance(data_batch, dict):
         return data_batch
+    from ..data_feeder import DataFeeder
     if feeding is None:
         feeding = {name: i for i, name in enumerate(feed_names)}
-    feed = {}
-    for name, pos in feeding.items():
-        col = [sample[pos] for sample in data_batch]
-        try:
-            arr = np.asarray(col)
-            ragged = arr.dtype == object
-        except ValueError:  # inhomogeneous lengths
-            ragged = True
-        if ragged:
-            # ragged sequence slot -> pad to the batch max (LoD stance)
-            maxlen = max(len(c) for c in col)
-            first = np.asarray(col[0])
-            arr = np.zeros((len(col), maxlen) + first.shape[1:],
-                           first.dtype)
-            for i, c in enumerate(col):
-                c = np.asarray(c)
-                arr[i, :len(c)] = c
-        feed[name] = arr
-    return feed
+    ordered = sorted(feeding.items(), key=lambda kv: kv[1])
+    rows = [tuple(sample[pos] for _, pos in ordered)
+            for sample in data_batch]
+    feeder = DataFeeder([name for name, _ in ordered], program=program)
+    return feeder.feed(rows)
+
+
+def _user_feed_names(program):
+    """Data vars a v2 user feeds, in declaration order — excluding the
+    auto-created '<name>_len' companions (DataFeeder emits those)."""
+    block = program.global_block()
+    names = [v.name for v in block.vars.values()
+             if getattr(v, 'is_data', False)]
+    return [n for n in names
+            if not (n.endswith('_len') and n[:-4] in names)]
 
 
 class SGD(object):
@@ -62,9 +62,7 @@ class SGD(object):
         # the init ops the optimizer just appended (accumulators, lr), so
         # user-set / trained parameter values survive.
         self._init_missing_startup_vars()
-        self._feed_names = [v.name for v in
-                            self.program.global_block().vars.values()
-                            if getattr(v, 'is_data', False)]
+        self._feed_names = _user_feed_names(self.program)
         self._extra = extra_layers or []
 
     def _init_missing_startup_vars(self):
@@ -86,7 +84,8 @@ class SGD(object):
             event_handler(v2_event.BeginPass(pass_id))
             for batch_id, data in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                feed = _build_feed(data, feeding, self._feed_names)
+                feed = _build_feed(data, feeding, self._feed_names,
+                                   program=self.program)
                 outs = self.exe.run(program=self.program, feed=feed,
                                     fetch_list=fetch)
                 cost = float(np.asarray(outs[0]).reshape(()))
@@ -101,7 +100,8 @@ class SGD(object):
         inference = self.program.clone(for_test=True)
         costs, n = 0.0, 0
         for data in reader():
-            feed = _build_feed(data, feeding, self._feed_names)
+            feed = _build_feed(data, feeding, self._feed_names,
+                               program=self.program)
             out = self.exe.run(program=inference, feed=feed,
                                fetch_list=[self.cost])
             bs = len(data) if not isinstance(data, dict) else 1

@@ -39,10 +39,10 @@ def test_fit_a_line_v2_style():
     assert end_iters[-1].cost < end_iters[0].cost * 0.1
     assert any(isinstance(e, paddle.event.EndPass) for e in events)
 
-    # inference over the trained params
+    # inference over the trained params — WITHOUT a feeding map the feed
+    # slots come from the pruned graph (label slot must not be demanded)
     samples = [(np.zeros(13, 'float32'),)]
-    out = paddle.infer(output_layer=y_, parameters=params, input=samples,
-                       feeding={'x': 0})
+    out = paddle.infer(output_layer=y_, parameters=params, input=samples)
     assert out.shape == (1, 1)
     np.testing.assert_allclose(out[0, 0], 0.5, atol=0.2)
 
@@ -142,3 +142,69 @@ def test_embedding_and_sequence_padding():
         if isinstance(e, paddle.event.EndIteration) else None,
         feeding={'words': 0, 'label': 1})
     assert np.isfinite(costs).all()
+
+    # pad positions are MASKED: the same sequence with/without extra
+    # padding (forced by a longer batch-mate) pools identically
+    out_short = paddle.infer(output_layer=pooled,
+                             input=[([3, 4],), ([5],)],
+                             feeding={'words': 0})
+    out_long = paddle.infer(output_layer=pooled,
+                            input=[([3, 4],), ([5, 6, 7, 8, 9],)],
+                            feeding={'words': 0})
+    np.testing.assert_allclose(out_short[0], out_long[0], rtol=1e-5)
+
+
+def test_partial_tail_batch_is_kept():
+    """Reference v2 minibatch yields the ragged tail — a dataset smaller
+    than batch_size must still train (review finding)."""
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(3))
+    y = paddle.layer.data(name='y', type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(
+        input=paddle.layer.fc(input=x, size=1), label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.01),
+        place=__import__('paddle_tpu').CPUPlace())
+
+    def tiny_reader():  # 5 samples, batch 8 -> one partial batch
+        rng = np.random.RandomState(4)
+        for _ in range(5):
+            yield rng.rand(3).astype('f'), rng.rand(1).astype('f')
+
+    iters = []
+    trainer.train(reader=paddle.batch(tiny_reader, 8), num_passes=1,
+                  event_handler=lambda e: iters.append(e)
+                  if isinstance(e, paddle.event.EndIteration) else None,
+                  feeding={'x': 0, 'y': 1})
+    assert len(iters) == 1  # the tail batch trained
+
+
+def test_sparse_binary_vector_densifies():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    feats = paddle.layer.data(
+        name='feats', type=paddle.data_type.sparse_binary_vector(16))
+    out = paddle.layer.fc(input=feats, size=2,
+                          act=paddle.activation.Softmax())
+    paddle.parameters.create(out)
+    got = paddle.infer(output_layer=out,
+                       input=[([1, 3, 5],), ([0, 15],)],
+                       feeding={'feats': 0})
+    assert got.shape == (2, 2)
+    assert np.isfinite(got).all()
+    # float variant: (index, value) pairs
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    feats = paddle.layer.data(
+        name='feats', type=paddle.data_type.sparse_float_vector(8))
+    dense = paddle.layer.fc(input=feats, size=1)
+    paddle.parameters.create(dense)
+    got = paddle.infer(output_layer=dense,
+                       input=[([(2, 0.5), (7, 1.5)],)],
+                       feeding={'feats': 0})
+    assert got.shape == (1, 1)
