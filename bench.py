@@ -88,7 +88,8 @@ def bench_transformer(batch=64, seq=64, vocab=32000, iters=20):
     from paddle_tpu.models import transformer as T
     avg_cost, _ = T.transformer_base(
         src_vocab_size=vocab, trg_vocab_size=vocab,
-        src_seq_len=seq, trg_seq_len=seq, dropout_rate=0.1)
+        src_seq_len=seq, trg_seq_len=seq, dropout_rate=0.1,
+        max_length=max(256, seq))
     fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     fluid.default_main_program().amp = 'bf16'
     exe = fluid.Executor(fluid.TPUPlace(0))

@@ -8,17 +8,18 @@ import os
 def pallas_enabled():
     """Whether to dispatch hot ops to Pallas kernels.
 
-    Default: only on a directly-attached TPU backend. The 'axon' tunnel
-    backend remote-compiles Pallas kernels and (as of this image) hangs
-    on pallas_call lowering — measured: even a trivial kernel never
-    returns — so it is excluded until the relay supports it. Override
-    with PADDLE_TPU_USE_PALLAS=1/0.
+    Default: OFF — opt in with PADDLE_TPU_USE_PALLAS=1. Measured on the
+    v5e chip (round 3, bench.py workloads end-to-end): flash attention
+    is 25% SLOWER than XLA's fused attention at the bench shapes
+    (seq 64: 76.5k vs 102.1k tok/s) and only ties at seq 1024 (73.2k
+    both) — XLA's own attention fusion is already MXU-optimal here, so
+    hand kernels must earn their place per-shape. On-chip numerics
+    parity of both kernels is still checked every bench run
+    (pallas_parity_max_abs_err in the BENCH detail), so the kernels
+    stay correct for shapes where a future chip/toolchain flips the
+    verdict.
     """
-    import jax
     env = os.environ.get('PADDLE_TPU_USE_PALLAS')
     if env is not None:
         return env not in ('0', 'false', 'False')
-    try:
-        return jax.default_backend() == 'tpu'
-    except Exception:
-        return False
+    return False

@@ -26,6 +26,8 @@ def _sequence_pool(ctx):
     else:
         lengths = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
         m = jnp.ones(x.shape[:2], x.dtype)[..., None]
+    if pool_type == 'AVG':
+        pool_type = 'AVERAGE'  # fluid uses 'average', v2 Avg says 'avg'
     if pool_type == 'AVERAGE':
         out = jnp.sum(x * m, axis=1) / jnp.maximum(
             lengths[:, None].astype(x.dtype), 1)

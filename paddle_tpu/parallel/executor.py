@@ -1,0 +1,56 @@
+"""ParallelExecutor facade (reference: the fluid ParallelExecutor —
+paddle/fluid/framework/parallel_executor.cc + python ParallelExecutor —
+which replicated a Program over CUDA devices and allreduced grads with
+NCCL).
+
+TPU-native: there is nothing to replicate by hand — transpile() attaches
+shardings and Executor's GSPMD path compiles ONE program whose
+collectives ride the ICI mesh. This class keeps the reference's API
+shape (build, run(fetch_list), bcast semantics are implicit) so fluid
+ParallelExecutor call sites port unchanged.
+"""
+
+from ..core.executor import Executor
+from ..core.program import default_main_program
+from .mesh import make_mesh
+from .transpiler import ParallelStrategy, transpile
+
+__all__ = ['ParallelExecutor']
+
+
+class ParallelExecutor(object):
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, num_threads=None, mesh=None,
+                 strategy=None, place=None):
+        self.program = main_program if main_program is not None \
+            else default_main_program()
+        if mesh is None:
+            mesh = make_mesh()  # dp over all visible devices
+        self.mesh = mesh
+        transpile(self.program, mesh,
+                  strategy or ParallelStrategy(data_parallel=True))
+        # share_vars_from: the reference shares device-replicated params
+        # with another ParallelExecutor; scope state is global here, so
+        # sharing is automatic — accept and ignore.
+        self.exe = share_vars_from.exe if share_vars_from is not None \
+            else Executor(place)
+        self._loss_name = loss_name
+
+    @property
+    def device_count(self):
+        return self.mesh.size
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        """feed batches are GLOBAL (the dp axis shards them across the
+        mesh); fetches are replicated results, matching the reference's
+        gathered fetch."""
+        feed = feed if feed is not None else feed_dict
+        return self.exe.run(program=self.program, feed=feed or {},
+                            fetch_list=list(fetch_list),
+                            return_numpy=return_numpy)
+
+    def bcast_params(self):
+        # GSPMD keeps replicated params consistent by construction (the
+        # grad psum is part of the compiled step); nothing to broadcast.
+        return None

@@ -301,3 +301,33 @@ def test_multihost_single_host_fallbacks():
     assert multihost.host_local_batch(16) == 16 // multihost.process_count()
     mesh = multihost.global_device_mesh(tp=2)
     assert mesh.shape['tp'] == 2
+
+
+def test_parallel_executor_facade():
+    """ParallelExecutor API over GSPMD: global batch shards over dp,
+    training matches the single-device run (reference ParallelExecutor
+    role, parallel/executor.py)."""
+    from paddle_tpu.parallel import ParallelExecutor
+    loss_1, w1_1 = _train_k_steps(mesh=None)
+
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    loss = _build_mlp_loss()
+    fluid.default_main_program().random_seed = 7
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    pe = ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                          place=fluid.CPUPlace())
+    assert pe.device_count == 8
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 6).astype('float32')
+    ys = rng.randint(0, 4, (16, 1)).astype('int64')
+    final = None
+    for _ in range(3):
+        final = pe.run([loss], feed={'x': xs, 'y': ys})
+    assert abs(float(np.asarray(final[0]).reshape(())) - loss_1) < 1e-4
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find('w1')), w1_1,
+        rtol=1e-4, atol=1e-5)
+    pe.bcast_params()  # no-op, API compatibility
