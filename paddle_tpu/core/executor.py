@@ -252,7 +252,9 @@ class Executor(object):
 
         def run_ops(op_list, env, base_key, start_index=0):
             import jax as _jax
+            import jax.numpy as _jnp
             from jax.sharding import NamedSharding, PartitionSpec
+            from .registry import AMP_BF16_OUT_SLOTS
             for i, op in enumerate(op_list):
                 ctx = LoweringContext(env, op, block, start_index + i,
                                       base_key,
@@ -265,6 +267,13 @@ class Executor(object):
                     raise RuntimeError(
                         'While lowering op %r: missing input %s. '
                         'Feed it or run producers first.' % (op.type, e))
+                if amp == 'bf16' and op.type in AMP_BF16_OUT_SLOTS:
+                    # fp32-stat ops hand activations back to the bf16
+                    # stream (see registry.AMP_BF16_OUT_SLOTS)
+                    for slot in AMP_BF16_OUT_SLOTS[op.type]:
+                        name = op.output(slot)
+                        if name in env and env[name].dtype == _jnp.float32:
+                            env[name] = env[name].astype(_jnp.bfloat16)
                 if mesh is not None:
                     for name in op.output_names():
                         spec = shardings.get(name)
