@@ -245,11 +245,20 @@ def main():
                 ablations['resnet50_layout_winner'] = 'NCHW'
         tok_np, err = _run_workload(
             'transformer', backend, reduced, timeout,
-            env={'PADDLE_TPU_USE_PALLAS': '0'})
+            env={'PADDLE_TPU_USE_PALLAS': '1'})
         if err:
-            errors['transformer_no_pallas'] = err
+            errors['transformer_pallas'] = err
         else:
-            ablations['transformer_tok_per_sec_no_pallas'] = round(tok_np, 1)
+            ablations['transformer_tok_per_sec_pallas'] = round(tok_np, 1)
+        tok_rbg, err = _run_workload(
+            'transformer', backend, reduced, timeout,
+            env={'PADDLE_TPU_PRNG': 'rbg'})
+        if err:
+            errors['transformer_rbg'] = err
+        else:
+            ablations['transformer_tok_per_sec_rbg_prng'] = round(tok_rbg, 1)
+            if tok_s is not None and tok_rbg > tok_s * 1.02:
+                ablations['transformer_prng_winner'] = 'rbg'
         if backend not in ('cpu',):
             parity, err = _run_workload('pallas_parity', backend, reduced,
                                         min(timeout, 150.0))

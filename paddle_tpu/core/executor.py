@@ -282,8 +282,16 @@ class Executor(object):
                                 env[name], NamedSharding(mesh, spec))
             return env
 
+        import os
+        prng_impl = os.environ.get('PADDLE_TPU_PRNG', 'threefry2x32')
+
         def step_fn(scope_vals, feed_vals, step_i):
-            base_key = jax.random.fold_in(jax.random.PRNGKey(seed), step_i)
+            # PADDLE_TPU_PRNG=rbg swaps in the TPU hardware RNG for
+            # dropout-mask generation (threefry is counter-based and
+            # costs real MXU-adjacent cycles per element; rbg trades
+            # strict reproducibility-across-backends for speed).
+            base_key = jax.random.fold_in(
+                jax.random.key(seed, impl=prng_impl), step_i)
             env = {}
             env.update(feed_vals)
             env.update(scope_vals)

@@ -7,3 +7,4 @@ plug in via paddle_tpu.reader.recordio when built.
 
 from .decorator import (batch, buffered, cache, chain, compose,  # noqa
                         firstn, map_readers, shard, shuffle, xmap_readers)
+from . import creator  # noqa: F401

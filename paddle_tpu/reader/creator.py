@@ -1,0 +1,39 @@
+"""Reader creators (reference: python/paddle/v2/reader/creator.py:19-90
+np_array / text_file / recordio; cloud_reader's etcd master is replaced
+by reader.shard — see parallel.multihost.shard_reader)."""
+
+__all__ = ['np_array', 'text_file', 'recordio']
+
+
+def np_array(x):
+    """Yield rows of an ndarray."""
+    import numpy as np
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+    return reader
+
+
+def text_file(path):
+    """Yield lines of a text file (newline stripped)."""
+    def reader():
+        with open(path, 'r') as f:
+            for line in f:
+                yield line.rstrip('\n')
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield raw records from recordio file(s) via the native reader
+    (paddle_tpu/native/recordio.cpp)."""
+    from .recordio import recordio_reader
+    if isinstance(paths, str):
+        paths = paths.split(',')
+
+    def reader():
+        for rec in recordio_reader(list(paths), prefetch=buf_size,
+                                   raw=True)():
+            yield rec
+    return reader

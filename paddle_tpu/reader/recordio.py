@@ -50,9 +50,11 @@ def write_recordio(path, items):
     return n
 
 
-def recordio_reader(paths, shuffle_buf=0, seed=0, prefetch=256):
+def recordio_reader(paths, shuffle_buf=0, seed=0, prefetch=256, raw=False):
     """Returns a v2-style reader() generator factory over recordio files.
-    Decode + shuffle + prefetch happen in the native worker thread."""
+    Decode + shuffle + prefetch happen in the native worker thread.
+    raw=True yields the undecoded record bytes (reader.creator.recordio
+    parity with the reference's raw-record creator)."""
     if isinstance(paths, str):
         paths = [paths]
     joined = '\n'.join(paths).encode()
@@ -71,7 +73,7 @@ def recordio_reader(paths, shuffle_buf=0, seed=0, prefetch=256):
                 if n < 0:
                     raise IOError(lib.recordio_reader_error(h).decode())
                 data = ctypes.string_at(out, n)
-                yield pickle.loads(data)
+                yield data if raw else pickle.loads(data)
         finally:
             lib.recordio_reader_close(h)
 

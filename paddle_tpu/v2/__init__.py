@@ -33,6 +33,7 @@ Typical book-chapter usage works verbatim:
 from . import activation  # noqa: F401
 from . import attr  # noqa: F401
 from . import data_type  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import event  # noqa: F401
 from . import inference  # noqa: F401
 from . import layer  # noqa: F401
@@ -40,6 +41,7 @@ from . import minibatch  # noqa: F401
 from . import networks  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import parameters  # noqa: F401
+from . import plot  # noqa: F401
 from . import pooling  # noqa: F401
 from . import trainer  # noqa: F401
 from .. import dataset  # noqa: F401
@@ -49,9 +51,10 @@ from .inference import infer  # noqa: F401
 from .minibatch import batch  # noqa: F401
 
 __all__ = ['init', 'layer', 'data_type', 'activation', 'attr', 'pooling',
+           'evaluator',
            'parameters', 'trainer', 'event', 'inference', 'infer',
            'minibatch', 'batch', 'networks', 'optimizer', 'dataset',
-           'reader', 'image']
+           'reader', 'image', 'plot']
 
 
 def init(use_gpu=False, trainer_count=1, **kwargs):

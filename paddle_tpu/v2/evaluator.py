@@ -1,0 +1,43 @@
+"""v2 evaluator namespace (reference: python/paddle/v2/evaluator.py,
+which re-exports trainer_config_helpers' *_evaluator functions under
+snake_case names).
+
+The jit-friendly equivalents live in fluid layers/metrics; these shims
+keep v2 config names importable. Evaluators that the reference computes
+in-network map to in-graph metric layers; host-side ones map to the
+metrics module."""
+
+from .. import layers as _fl
+from ..metrics import Auc as _AucMetric
+from ..metrics import DetectionMAP as _MapMetric
+
+__all__ = ['classification_error', 'auc', 'precision_recall',
+           'detection_map', 'chunk']
+
+
+def classification_error(input, label, **kwargs):
+    """Error rate = 1 - accuracy (classification_error_evaluator)."""
+    acc = _fl.accuracy(input=input, label=label)
+    one = _fl.tensor.fill_constant(shape=[1], dtype='float32', value=1.0)
+    return _fl.elementwise_sub(x=one, y=acc)
+
+
+def auc(input, label, **kwargs):
+    """Host-side AUC accumulator over fetched (probs, labels)."""
+    return _AucMetric()
+
+
+def precision_recall(input, label, class_number, **kwargs):
+    """In-graph precision/recall states (precision_recall_evaluator)."""
+    return _fl.precision_recall(input, label, class_number)
+
+
+def detection_map(**kwargs):
+    """Host-side VOC mAP accumulator (detection_map evaluator)."""
+    return _MapMetric(**kwargs)
+
+
+def chunk(input, label, chunk_scheme, num_chunk_types, **kwargs):
+    from ..evaluator import ChunkEvaluator
+    return ChunkEvaluator(chunk_scheme=chunk_scheme,
+                          num_chunk_types=num_chunk_types)

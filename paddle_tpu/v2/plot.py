@@ -1,0 +1,61 @@
+"""v2 training-curve plotter (reference: python/paddle/v2/plot/plot.py
+Ploter). Collects (step, value) series; renders with matplotlib when
+available, else prints — same DISABLE_PLOT contract as the reference."""
+
+import os
+
+__all__ = ['Ploter', 'PlotData']
+
+
+class PlotData(object):
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter(object):
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+
+    def _disabled(self):
+        if os.environ.get('DISABLE_PLOT') == 'True':
+            return True
+        try:
+            import matplotlib  # noqa: F401
+            return False
+        except ImportError:
+            return True
+
+    def append(self, title, step, value):
+        self.__plot_data__[title].append(step, float(value))
+
+    def plot(self, path=None):
+        if self._disabled():
+            for title, d in self.__plot_data__.items():
+                if d.step:
+                    print('%s step %s: %.6f' % (title, d.step[-1],
+                                                d.value[-1]))
+            return
+        import matplotlib
+        matplotlib.use('Agg')
+        import matplotlib.pyplot as plt
+        plt.figure()
+        for title, d in self.__plot_data__.items():
+            plt.plot(d.step, d.value, label=title)
+        plt.legend()
+        if path is not None:
+            plt.savefig(path)
+        plt.close()
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()

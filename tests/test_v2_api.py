@@ -208,3 +208,47 @@ def test_sparse_binary_vector_densifies():
                        input=[([(2, 0.5), (7, 1.5)],)],
                        feeding={'feats': 0})
     assert got.shape == (1, 1)
+
+
+def test_v2_evaluator_namespace():
+    import paddle_tpu as fluid
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = paddle.layer.data(name='x', type=paddle.data_type.dense_vector(4))
+    probs = paddle.layer.fc(input=x, size=3,
+                            act=paddle.activation.Softmax())
+    label = paddle.layer.data(name='l',
+                              type=paddle.data_type.integer_value(3))
+    err = paddle.evaluator.classification_error(input=probs, label=label)
+    paddle.parameters.create(probs)
+    import numpy as np
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={'x': np.zeros((6, 4), 'f'),
+                         'l': np.zeros((6, 1), 'int64')},
+                   fetch_list=[err])
+    assert -1e-6 <= float(np.asarray(got).reshape(())) <= 1.0 + 1e-6
+    auc = paddle.evaluator.auc(probs, label)
+    auc.update(np.array([[0.2, 0.8], [0.7, 0.3]]), np.array([1, 0]))
+    assert 0.0 <= auc.eval() <= 1.0
+
+
+def test_plot_and_reader_creators(tmp_path, monkeypatch):
+    monkeypatch.setenv('DISABLE_PLOT', 'True')
+    ploter = paddle.plot.Ploter('train', 'test')
+    ploter.append('train', 0, 1.5)
+    ploter.append('train', 1, 1.2)
+    ploter.plot()
+    ploter.reset()
+    assert ploter.__plot_data__['train'].step == []
+
+    from paddle_tpu.reader import creator
+    assert list(creator.np_array(np.arange(6).reshape(3, 2))())[1].tolist() \
+        == [2, 3]
+    p = tmp_path / 'lines.txt'
+    p.write_text('a\nb\n')
+    assert list(creator.text_file(str(p))()) == ['a', 'b']
+    from paddle_tpu.reader.recordio import write_recordio
+    rp = str(tmp_path / 'r.rio')
+    write_recordio(rp, [(1,), (2,)])
+    raw = list(creator.recordio(rp)())
+    assert len(raw) == 2 and all(isinstance(r, bytes) for r in raw)
