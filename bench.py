@@ -200,6 +200,7 @@ def _run_workload(workload, backend, reduced, timeout, env=None):
 
 
 def main():
+    t_start = time.time()
     forced = os.environ.get('BENCH_BACKEND')
     if forced:
         backend, degraded = forced, False
@@ -227,39 +228,54 @@ def main():
         errors['resnet50'] = err
         sys.stderr.write('bench: resnet50 failed: %s\n' % err)
 
-    # Ablations (SURVEY §5 / VERDICT r2 #5-6): NHWC conv layout and the
-    # Pallas on/off delta, plus on-chip kernel parity. Skipped on a
-    # degraded relay — the budget belongs to the headline numbers then.
+    # Ablations (SURVEY §5 / VERDICT r2 #5-6): NHWC conv layout, the
+    # Pallas opt-in delta, the rbg PRNG delta, plus on-chip kernel
+    # parity. Skipped on a degraded relay — the budget belongs to the
+    # headline numbers then — and stopped once the total wall budget is
+    # spent (a hanging relay must not starve the JSON line).
+    budget = float(os.environ.get('BENCH_TOTAL_BUDGET', '1500'))
+
+    def over_budget():
+        if time.time() - t_start > budget - timeout:
+            errors.setdefault('ablations', 'skipped: wall budget spent')
+            return True
+        return False
+
     if not reduced and os.environ.get('BENCH_ABLATIONS', '1') != '0':
-        img_nhwc, err = _run_workload(
-            'resnet50', backend, reduced, timeout,
-            env={'PADDLE_TPU_CONV_LAYOUT': 'NHWC'})
-        if err:
-            errors['resnet50_nhwc'] = err
-        else:
-            ablations['resnet50_img_per_sec_nhwc'] = round(img_nhwc, 1)
-            if img_s is not None and img_nhwc > img_s:
-                ablations['resnet50_layout_winner'] = 'NHWC'
-                img_s = img_nhwc  # headline takes the faster layout
+        if not over_budget():
+            img_nhwc, err = _run_workload(
+                'resnet50', backend, reduced, timeout,
+                env={'PADDLE_TPU_CONV_LAYOUT': 'NHWC'})
+            if err:
+                errors['resnet50_nhwc'] = err
             else:
-                ablations['resnet50_layout_winner'] = 'NCHW'
-        tok_np, err = _run_workload(
-            'transformer', backend, reduced, timeout,
-            env={'PADDLE_TPU_USE_PALLAS': '1'})
-        if err:
-            errors['transformer_pallas'] = err
-        else:
-            ablations['transformer_tok_per_sec_pallas'] = round(tok_np, 1)
-        tok_rbg, err = _run_workload(
-            'transformer', backend, reduced, timeout,
-            env={'PADDLE_TPU_PRNG': 'rbg'})
-        if err:
-            errors['transformer_rbg'] = err
-        else:
-            ablations['transformer_tok_per_sec_rbg_prng'] = round(tok_rbg, 1)
-            if tok_s is not None and tok_rbg > tok_s * 1.02:
-                ablations['transformer_prng_winner'] = 'rbg'
-        if backend not in ('cpu',):
+                ablations['resnet50_img_per_sec_nhwc'] = round(img_nhwc, 1)
+                if img_s is not None and img_nhwc > img_s:
+                    ablations['resnet50_layout_winner'] = 'NHWC'
+                    img_s = img_nhwc  # headline takes the faster layout
+                else:
+                    ablations['resnet50_layout_winner'] = 'NCHW'
+        if not over_budget():
+            tok_np, err = _run_workload(
+                'transformer', backend, reduced, timeout,
+                env={'PADDLE_TPU_USE_PALLAS': '1'})
+            if err:
+                errors['transformer_pallas'] = err
+            else:
+                ablations['transformer_tok_per_sec_pallas'] = round(tok_np,
+                                                                    1)
+        if not over_budget():
+            tok_rbg, err = _run_workload(
+                'transformer', backend, reduced, timeout,
+                env={'PADDLE_TPU_PRNG': 'rbg'})
+            if err:
+                errors['transformer_rbg'] = err
+            else:
+                ablations['transformer_tok_per_sec_rbg_prng'] = \
+                    round(tok_rbg, 1)
+                if tok_s is not None and tok_rbg > tok_s * 1.02:
+                    ablations['transformer_prng_winner'] = 'rbg'
+        if backend not in ('cpu',) and not over_budget():
             parity, err = _run_workload('pallas_parity', backend, reduced,
                                         min(timeout, 150.0))
             if err:
