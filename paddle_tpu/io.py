@@ -44,8 +44,20 @@ def _from_numpy(arr, dtype_name):
     return arr
 
 
+def _gather_to_host(value):
+    """Multihost-sharded arrays are not fully addressable from one
+    process; allgather the global value before serializing (the
+    reference's pserver owned whole params — here GSPMD shards them)."""
+    import jax
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        value = multihost_utils.process_allgather(value, tiled=True)
+    return value
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    import jax
     main_program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars()
@@ -57,13 +69,18 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         value = scope.find(v.name)
         if value is None:
             continue
-        arr, dtype_name = _to_numpy(value)
+        arr, dtype_name = _to_numpy(_gather_to_host(value))
         arrays[v.name] = arr
         manifest[v.name] = {'dtype': dtype_name,
                             'shape': list(np.asarray(arr).shape)}
-    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
-    with open(os.path.join(dirname, _MANIFEST_FILE), 'w') as f:
-        json.dump(manifest, f, indent=1)
+    # one writer per pod: every host gathered the same global values
+    if jax.process_index() == 0:
+        np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+        with open(os.path.join(dirname, _MANIFEST_FILE), 'w') as f:
+            json.dump(manifest, f, indent=1)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices('paddle_tpu_save_vars')
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

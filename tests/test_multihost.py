@@ -60,6 +60,30 @@ _CHILD = textwrap.dedent('''
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(np.array([rank + 1]))
     assert sorted(gathered.ravel().tolist()) == [1, 2], gathered
+
+    # train a dp-sharded step over the POD mesh, then checkpoint: the
+    # sharded state gathers to host and exactly one process writes
+    import os
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.transpiler import transpile
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name='mh_w'))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    transpile(fluid.default_main_program(), mesh)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)  # same data everywhere; dp shards it
+    feed = {'x': rng.rand(16, 4).astype('f'),
+            'y': rng.rand(16, 1).astype('f')}
+    val = exe.run(feed=feed, fetch_list=[loss])[0]
+    assert np.isfinite(np.asarray(val)).all()
+    ckpt = sys.argv[3]
+    fluid.io.save_params(exe, ckpt)
+    assert os.path.exists(os.path.join(ckpt, 'params.npz')) or \
+        any(f.endswith('.npz') for f in os.listdir(ckpt))
     print('OK %d' % rank, flush=True)
 ''')
 
@@ -74,7 +98,9 @@ def test_two_process_distributed_cpu(tmp_path):
     env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
     env.pop('JAX_PLATFORMS', None)
-    procs = [subprocess.Popen([sys.executable, str(script), str(r), port],
+    ckpt_dir = str(tmp_path / 'pod_ckpt')
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), port,
+                               ckpt_dir],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True, env=env)
              for r in range(2)]
