@@ -102,3 +102,67 @@ def test_c_client_classifies(tmp_path):
     assert 'row0 argmax=1' in lines[2]  # sum=+4 -> class 1
     assert 'row1 argmax=0' in lines[3]  # sum=-4 -> class 0
     assert lines[-1] == 'OK'
+
+
+def test_capi_via_ctypes_repeated_runs(tmp_path):
+    """Drive the C ABI through ctypes from the host process: repeated
+    runs reuse the cached executable and outputs stay stable; error
+    paths return proper codes."""
+    import ctypes
+
+    from paddle_tpu.native import build_capi
+    model_dir = str(tmp_path / 'model')
+    _save_tiny_classifier(model_dir)
+
+    lib = ctypes.CDLL(build_capi())
+
+    class Tensor(ctypes.Structure):
+        _fields_ = [('dtype', ctypes.c_int), ('ndim', ctypes.c_int32),
+                    ('shape', ctypes.c_int64 * 8),
+                    ('data', ctypes.c_void_p)]
+
+    lib.paddle_predictor_create.restype = ctypes.c_int
+    lib.paddle_predictor_create.argtypes = [ctypes.c_char_p,
+                                            ctypes.POINTER(ctypes.c_void_p)]
+    lib.paddle_predictor_run.restype = ctypes.c_int
+    lib.paddle_predictor_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(Tensor)]
+    lib.paddle_predictor_output.restype = ctypes.c_int
+    lib.paddle_predictor_output.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_int32,
+                                            ctypes.POINTER(Tensor)]
+    lib.paddle_predictor_destroy.restype = ctypes.c_int
+    lib.paddle_tpu_init.restype = ctypes.c_int
+    lib.paddle_tpu_init.argtypes = [ctypes.c_char_p]
+
+    assert lib.paddle_tpu_init(None) == 0  # attaches to THIS interpreter
+    pred = ctypes.c_void_p()
+    assert lib.paddle_predictor_create(model_dir.encode(),
+                                       ctypes.byref(pred)) == 0
+
+    outs = []
+    for rep in range(3):
+        xs = np.full((2, 4), 1.0 - rep, dtype='float32')
+        t = Tensor()
+        t.dtype, t.ndim = 0, 2
+        t.shape[0], t.shape[1] = 2, 4
+        t.data = xs.ctypes.data_as(ctypes.c_void_p)
+        names = (ctypes.c_char_p * 1)(b'x')
+        assert lib.paddle_predictor_run(pred, 1, names,
+                                        ctypes.byref(t)) == 0
+        out = Tensor()
+        assert lib.paddle_predictor_output(pred, 0, ctypes.byref(out)) == 0
+        assert (out.shape[0], out.shape[1]) == (2, 2)
+        buf = np.ctypeslib.as_array(
+            ctypes.cast(out.data, ctypes.POINTER(ctypes.c_float)),
+            shape=(2, 2)).copy()
+        outs.append(buf)
+    # deterministic: same input -> same probs; argmax follows sum(x)
+    assert outs[0][0].argmax() == 1   # sum=+4
+    assert outs[2][0].argmax() == 0   # sum=-4
+    # out-of-range + null errors
+    bad = Tensor()
+    assert lib.paddle_predictor_output(pred, 99, ctypes.byref(bad)) == 2
+    assert lib.paddle_predictor_destroy(pred) == 0
+    assert lib.paddle_predictor_destroy(None) == 1

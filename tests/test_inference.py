@@ -42,3 +42,23 @@ def test_predictor_isolated_scope(tmp_path):
     assert len(list(fluid.global_scope().keys())) == 0  # no leakage
     got = pred({'x': xs})
     np.testing.assert_allclose(got[0], expect, rtol=1e-5)
+
+
+def test_predictor_bf16(tmp_path):
+    """Predictor(bf16=True) — the serving-side AMP path — returns
+    near-identical probabilities to the fp32 predictor."""
+    from paddle_tpu.inference.predictor import Predictor
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    probs = fluid.layers.fc(input=x, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    dirname = str(tmp_path / 'm')
+    fluid.io.save_inference_model(dirname, ['x'], [probs], exe)
+
+    xs = np.random.RandomState(0).rand(5, 8).astype('float32')
+    p32 = Predictor(dirname, place=fluid.CPUPlace())
+    p16 = Predictor(dirname, place=fluid.CPUPlace(), bf16=True)
+    out32 = p32.predict({'x': xs})[0]
+    out16 = p16.predict({'x': xs})[0]
+    np.testing.assert_allclose(out32, out16, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(out16).sum(-1), 1.0, atol=1e-2)
