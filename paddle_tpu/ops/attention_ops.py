@@ -54,38 +54,69 @@ def reference_attention(q, k, v, causal=False, key_length=None,
     return out
 
 
+def _ring_dispatch(q, k, v, mesh, causal):
+    """Sequence-parallel exact attention: shard_map over the mesh's 'sp'
+    axis with K/V rotating on ICI (parallel/ring_attention.py). Called
+    inside the executor's jit — GSPMD reshards q/k/v to the sp layout if
+    the transpiler hasn't already."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.ring_attention import ring_attention
+    spec = P(None, None, 'sp', None)
+    return jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name='sp',
+                                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+def _sp_size(mesh):
+    if mesh is None:
+        return 1
+    return dict(mesh.shape).get('sp', 1)
+
+
 def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
                     query_length=None, dropout_rate=0.0, rng=None,
-                    is_test=False):
+                    is_test=False, mesh=None):
     """q3/k3/v3: [B, T, H*D]. Returns [B, Tq, H*Dv].
 
-    Dispatches to the Pallas TPU flash kernel when profitable (no dropout,
-    long sequence, TPU backend); otherwise the XLA-fused jnp reference.
+    Dispatch order: ring attention when the program runs on a mesh with
+    an active 'sp' axis (long-context sequence parallelism — K/V blocks
+    ride the ICI ring instead of all-gathering); the Pallas flash kernel
+    when opted in and profitable; otherwise the XLA-fused jnp reference.
     """
+    import os
     q = _split_heads(q3, n_head)
     k = _split_heads(k3, n_head)
     v = _split_heads(v3, n_head)
 
+    sp = _sp_size(mesh)
+    use_ring = (sp > 1 and key_length is None and query_length is None and
+                q.shape[-2] % sp == 0 and k.shape[-2] % sp == 0 and
+                os.environ.get('PADDLE_TPU_RING_ATTENTION', '1')
+                not in ('0', 'false'))
+
     use_pallas = False
-    if dropout_rate == 0.0 and key_length is None and \
+    if not use_ring and dropout_rate == 0.0 and key_length is None and \
             query_length is None and q.shape[-2] >= 512 and \
             q.shape[-2] % 512 == 0 and k.shape[-2] % 128 == 0 and \
             q.shape[-1] % 128 == 0:
         from .pallas import pallas_enabled
         use_pallas = pallas_enabled()
-    if use_pallas:
+    if use_ring:
+        out = _ring_dispatch(q, k, v, mesh, causal)
+    elif use_pallas:
         from .pallas.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal)
     else:
         out = reference_attention(q, k, v, causal=causal,
                                   key_length=key_length,
                                   query_length=query_length)
-        if dropout_rate and not is_test:
-            # dropout on attention output (weights-dropout would block the
-            # flash path; output-dropout is the TPU-friendly equivalent)
-            keep = 1.0 - dropout_rate
-            mask = jax.random.bernoulli(rng, keep, out.shape)
-            out = jnp.where(mask, out / keep, 0.0)
+    if not use_pallas and dropout_rate and not is_test:
+        # dropout on attention output (weights-dropout would block the
+        # flash/ring paths; output-dropout is the TPU-friendly equivalent)
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(rng, keep, out.shape)
+        out = jnp.where(mask, out / keep, 0.0)
     return _merge_heads(out)
 
 
@@ -102,8 +133,9 @@ def _fused_attention(ctx):
     causal = ctx.attr('causal', False)
     dropout_rate = ctx.attr('dropout_rate', 0.0)
     rng = ctx.rng_key() if dropout_rate else None
+    mesh = getattr(ctx.block.program, 'mesh', None)
     out = fused_attention(q, k, v, n_head, causal=causal,
                           key_length=key_length, query_length=query_length,
                           dropout_rate=dropout_rate, rng=rng,
-                          is_test=ctx.is_test)
+                          is_test=ctx.is_test, mesh=mesh)
     ctx.set_output('Out', out)
