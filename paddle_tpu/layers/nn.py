@@ -18,7 +18,7 @@ __all__ = [
     'autoincreased_step_counter', 'nce', 'auc', 'group_norm',
     'bilinear_tensor_product', 'pad', 'relu_layer', 'maxout',
     'row_conv', 'huber_loss', 'rank_loss', 'margin_rank_loss', 'hinge_loss', 'log_loss', 'conv_shift', 'spp', 'resize_bilinear', 'resize_nearest', 'dot', 'label_smoothed_cross_entropy',
-    'lrn', 'crop', 'roi_pool', 'max_pool2d_with_index', 'unpool', 'sign', 'l1_norm', 'squared_l2_norm', 'squared_l2_distance', 'modified_huber_loss', 'precision_recall', 'positive_negative_pair',
+    'lrn', 'crop', 'roi_pool', 'max_pool2d_with_index', 'unpool', 'sign', 'l1_norm', 'squared_l2_norm', 'squared_l2_distance', 'modified_huber_loss', 'precision_recall', 'positive_negative_pair', 'edit_distance',
 ]
 
 
@@ -1030,3 +1030,25 @@ def positive_negative_pair(score, label, qid, weight=None, column=0,
                  'NeutralPair': [neu]},
         attrs={'column': column})
     return pos, neg, neu
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Batched Levenshtein distance between padded hyp/ref id sequences
+    (edit_distance_op.cc; lengths per the LoD pad+mask stance). Returns
+    (distance [B, 1], sequence_num [1])."""
+    helper = LayerHelper('edit_distance', **locals())
+    out = helper.create_variable_for_type_inference('float32')
+    seq_num = helper.create_variable_for_type_inference('int64')
+    if input.shape is not None:
+        out.shape = (input.shape[0], 1)
+    seq_num.shape = (1,)
+    inputs = {'Hyps': [input], 'Refs': [label]}
+    if input_length is not None:
+        inputs['HypsLength'] = [input_length]
+    if label_length is not None:
+        inputs['RefsLength'] = [label_length]
+    helper.append_op(type='edit_distance', inputs=inputs,
+                     outputs={'Out': [out], 'SequenceNum': [seq_num]},
+                     attrs={'normalized': normalized})
+    return out, seq_num

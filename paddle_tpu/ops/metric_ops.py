@@ -6,6 +6,7 @@ an O(N^2) pair matrix — static-shaped, so they ride along in the jitted
 step instead of forcing a host round-trip.
 """
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
@@ -95,3 +96,47 @@ def _positive_negative_pair(ctx):
     ctx.set_output('PositivePair', pos.reshape(1))
     ctx.set_output('NegativePair', neg.reshape(1))
     ctx.set_output('NeutralPair', neu.reshape(1))
+
+
+@register('edit_distance')
+def _edit_distance(ctx):
+    """Batched Levenshtein distance (edit_distance_op.cc). Padded [B, T]
+    int sequences + optional length vectors (LoD stance). The classic
+    row-DP recurrence is sequentialized only over hyp positions: the
+    insertion closure along the ref axis is a prefix-min, so each row
+    updates as new = cummin(cand - j) + j — fully vectorized over batch
+    and ref positions (scan depth T1, MXU-free but tiny)."""
+    hyp = ctx.input('Hyps').astype(jnp.int32)    # [B, T1]
+    ref = ctx.input('Refs').astype(jnp.int32)    # [B, T2]
+    b, t1 = hyp.shape
+    t2 = ref.shape[1]
+    hyp_len = ctx.input('HypsLength').reshape(-1).astype(jnp.int32) \
+        if ctx.has_input('HypsLength') else jnp.full((b,), t1, jnp.int32)
+    ref_len = ctx.input('RefsLength').reshape(-1).astype(jnp.int32) \
+        if ctx.has_input('RefsLength') else jnp.full((b,), t2, jnp.int32)
+    normalized = ctx.attr('normalized', True)
+
+    j_idx = jnp.arange(t2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(j_idx, (b, t2 + 1))
+
+    def step(prev, h_i):
+        # h_i: [B] current hyp token; prev: [B, T2+1]
+        sub_cost = (ref != h_i[:, None]).astype(jnp.float32)   # [B, T2]
+        cand_tail = jnp.minimum(prev[:, 1:] + 1.0,
+                                prev[:, :-1] + sub_cost)
+        cand = jnp.concatenate([prev[:, :1] + 1.0, cand_tail], axis=1)
+        closed = jax.lax.associative_scan(jnp.minimum,
+                                          cand - j_idx[None, :], axis=1)
+        new = closed + j_idx[None, :]
+        return new, new
+
+    _, rows = jax.lax.scan(step, row0, hyp.T)          # [T1, B, T2+1]
+    table = jnp.concatenate([row0[None], rows], axis=0)  # [T1+1, B, T2+1]
+    d_row = jnp.take_along_axis(
+        table, hyp_len[None, :, None].astype(jnp.int32), axis=0)[0]
+    dist = jnp.take_along_axis(
+        d_row, ref_len[:, None].astype(jnp.int32), axis=1)  # [B, 1]
+    if normalized:
+        dist = dist / jnp.maximum(ref_len[:, None], 1).astype(dist.dtype)
+    ctx.set_output('Out', dist.astype(jnp.float32))
+    ctx.set_output('SequenceNum', jnp.asarray([b], jnp.int64))

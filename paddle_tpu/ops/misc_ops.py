@@ -33,46 +33,6 @@ def _argsort(ctx):
     ctx.set_output('Out', jnp.sort(x, axis=axis))
 
 
-@register('edit_distance')
-def _edit_distance(ctx):
-    """Levenshtein distance between padded int sequences (edit_distance_op.cc).
-    Computed with a lax.scan DP over the static max length."""
-    hyp = ctx.input('Hyps')  # [b, th] int
-    ref = ctx.input('Refs')  # [b, tr] int
-    hyp_len = ctx.input('HypsLength').reshape(-1) if \
-        ctx.has_input('HypsLength') else \
-        jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
-    ref_len = ctx.input('RefsLength').reshape(-1) if \
-        ctx.has_input('RefsLength') else \
-        jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
-    b, th = hyp.shape
-    tr = ref.shape[1]
-
-    def per_example(h, r, hl, rl):
-        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
-
-        def step(prev_row, i):
-            ins = prev_row[1:] + 1.0
-            sub = prev_row[:-1] + (h[i] != r).astype(jnp.float32)
-            left0 = prev_row[0] + 1.0
-
-            def body(carry, j):
-                dele = carry + 1.0
-                cur = jnp.minimum(jnp.minimum(ins[j], sub[j]), dele)
-                return cur, cur
-
-            _, rest = jax.lax.scan(body, left0, jnp.arange(tr))
-            new_row = jnp.concatenate([left0[None], rest])
-            valid = i < hl
-            return jnp.where(valid, new_row, prev_row), None
-
-        final_row, _ = jax.lax.scan(step, row0, jnp.arange(th))
-        return final_row[rl]
-
-    dist = jax.vmap(per_example)(hyp, ref, hyp_len, ref_len)
-    if ctx.attr('normalized', False):
-        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
-    ctx.set_output('Out', dist.reshape(b, 1))
     ctx.set_output('SequenceNum', jnp.asarray([b], jnp.int64))
 
 

@@ -263,3 +263,50 @@ def test_detection_map_11point():
     m.update(dets, gts)
     # single tp: precision 1 at recall 1 -> all 11 points max precision 1
     np.testing.assert_allclose(m.eval(), 100.0)
+
+
+def _np_levenshtein(a, b):
+    import numpy as _np
+    d = _np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = _np.arange(len(a) + 1)
+    d[0, :] = _np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[-1, -1]
+
+
+def test_edit_distance_matches_numpy():
+    rng = np.random.RandomState(7)
+    seqs = []
+    for _ in range(6):
+        hl, rl = int(rng.randint(1, 8)), int(rng.randint(1, 9))
+        seqs.append((rng.randint(0, 5, hl), rng.randint(0, 5, rl)))
+    t1 = max(len(h) for h, _ in seqs)
+    t2 = max(len(r) for _, r in seqs)
+    hyp = np.zeros((6, t1), 'int64'); ref = np.zeros((6, t2), 'int64')
+    hl = np.zeros(6, 'int64'); rl = np.zeros(6, 'int64')
+    for i, (h, r) in enumerate(seqs):
+        hyp[i, :len(h)] = h; ref[i, :len(r)] = r
+        hl[i], rl[i] = len(h), len(r)
+    hv = fluid.layers.data(name='h', shape=[t1], dtype='int64')
+    rv = fluid.layers.data(name='r', shape=[t2], dtype='int64')
+    hlv = fluid.layers.data(name='hl', shape=[], dtype='int64')
+    rlv = fluid.layers.data(name='rl', shape=[], dtype='int64')
+    dist, n = fluid.layers.edit_distance(hv, rv, normalized=False,
+                                         input_length=hlv,
+                                         label_length=rlv)
+    got_d, got_n = run_startup_and(
+        {'h': hyp, 'r': ref, 'hl': hl, 'rl': rl}, [dist, n])
+    want = np.array([[_np_levenshtein(list(h), list(r))]
+                     for h, r in seqs])
+    np.testing.assert_allclose(got_d, want)
+    assert got_n[0] == 6
+    # normalized variant divides by ref length
+    dist_n, _ = fluid.layers.edit_distance(hv, rv, normalized=True,
+                                           input_length=hlv,
+                                           label_length=rlv)
+    got_dn = run_startup_and(
+        {'h': hyp, 'r': ref, 'hl': hl, 'rl': rl}, [dist_n])[0]
+    np.testing.assert_allclose(got_dn, want / rl[:, None], rtol=1e-6)
