@@ -135,3 +135,35 @@ def test_scope_guard_isolates_state():
                       scope=my_scope)
     assert res[0].shape == (2, 2)
     assert len(list(fluid.global_scope().keys())) == 0
+
+
+def test_bogus_fetch_target_raises_keyerror():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(KeyError):
+        exe.run(feed={'x': np.zeros((2, 3), 'f')},
+                fetch_list=['no_such_var'])
+
+
+def test_batch_size_change_recompiles_correctly():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    out = fluid.layers.reduce_sum(x, dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    a = exe.run(feed={'x': np.ones((2, 3), 'f')}, fetch_list=[out])[0]
+    b = exe.run(feed={'x': np.ones((5, 3), 'f')}, fetch_list=[out])[0]
+    assert a.shape == (2,) and b.shape == (5,)
+    np.testing.assert_allclose(b, 3.0)
+
+
+def test_wrong_dtype_feed_autocasts():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    out = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got = exe.run(feed={'x': np.ones((2, 3), dtype='float64')},
+                  fetch_list=[out], return_numpy=False)[0]
+    import jax.numpy as jnp
+    assert got.dtype == jnp.float32
