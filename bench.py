@@ -168,6 +168,12 @@ def _run_workload_child(workload, backend, reduced):
     if workload == 'transformer':
         kw = dict(batch=8, seq=32, vocab=4096, iters=5) if reduced else {}
         val = bench_transformer(**kw)
+    elif workload == 'transformer_seq256':
+        # long-sequence config (SURVEY §7.10): same 4096 tokens/step as
+        # the base config so the two tok/s numbers are comparable.
+        kw = dict(batch=2, seq=256, vocab=4096, iters=5) if reduced \
+            else dict(batch=16, seq=256)
+        val = bench_transformer(**kw)
     else:
         kw = dict(batch=4, image=64, iters=5) if reduced else {}
         val = bench_resnet50(**kw)
@@ -242,6 +248,7 @@ def main():
         return False
 
     if not reduced and os.environ.get('BENCH_ABLATIONS', '1') != '0':
+        layout_env = {}
         if not over_budget():
             img_nhwc, err = _run_workload(
                 'resnet50', backend, reduced, timeout,
@@ -252,9 +259,32 @@ def main():
                 ablations['resnet50_img_per_sec_nhwc'] = round(img_nhwc, 1)
                 if img_s is not None and img_nhwc > img_s:
                     ablations['resnet50_layout_winner'] = 'NHWC'
+                    layout_env = {'PADDLE_TPU_CONV_LAYOUT': 'NHWC'}
                     img_s = img_nhwc  # headline takes the faster layout
                 else:
                     ablations['resnet50_layout_winner'] = 'NCHW'
+        if not over_budget():
+            # carries the winning layout so only the BN compute differs
+            img_bn, err = _run_workload(
+                'resnet50', backend, reduced, timeout,
+                env=dict(layout_env, PADDLE_TPU_BN_COMPUTE='fp32'))
+            if err:
+                errors['resnet50_bn_fp32'] = err
+            else:
+                ablations['resnet50_img_per_sec_bn_fp32'] = round(img_bn, 1)
+                if img_s is not None and img_bn > img_s * 1.02:
+                    ablations['resnet50_bn_winner'] = 'fp32'
+                    img_s = img_bn  # headline takes the faster BN compute
+                else:
+                    ablations['resnet50_bn_winner'] = 'bf16'
+        if not over_budget():
+            tok_256, err = _run_workload(
+                'transformer_seq256', backend, reduced, timeout)
+            if err:
+                errors['transformer_seq256'] = err
+            else:
+                ablations['transformer_tok_per_sec_seq256'] = round(tok_256,
+                                                                    1)
         if not over_budget():
             tok_np, err = _run_workload(
                 'transformer', backend, reduced, timeout,
@@ -333,7 +363,8 @@ if __name__ == '__main__':
         import argparse
         p = argparse.ArgumentParser()
         p.add_argument('--workload',
-                       choices=['transformer', 'resnet50', 'pallas_parity'])
+                       choices=['transformer', 'transformer_seq256',
+                                'resnet50', 'pallas_parity'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

@@ -102,6 +102,67 @@ def test_bf16_resnet_tiny_e2e():
     assert last < first, (first, last)
 
 
+def _train_bn(steps=10, seed=3):
+    """conv->bn->fc under amp; returns (losses, bn_out_var)."""
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    img = fluid.layers.data(name='img', shape=[3, 12, 12], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    conv = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                               bias_attr=False)
+    bn = fluid.layers.batch_norm(input=conv, act='relu')
+    logits = fluid.layers.fc(input=bn, size=10, act='softmax')
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=logits, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(16, 3, 12, 12).astype('float32')
+    ys = (xs.sum((1, 2, 3))[:, None] > 216).astype('int64')
+    losses = []
+    for _ in range(steps):
+        losses.append(float(np.asarray(exe.run(
+            feed={'img': xs, 'label': ys},
+            fetch_list=[loss])[0]).reshape(())))
+    return losses, bn
+
+
+def test_bn_bf16_compute_default(monkeypatch):
+    """Under amp the BN elementwise path stays bf16 (the +13% on-chip
+    lever, norm_ops._bn_bf16_compute): the BN activation is bfloat16
+    in-graph while running statistics stay fp32 in the scope."""
+    import jax.numpy as jnp
+    monkeypatch.delenv('PADDLE_TPU_BN_COMPUTE', raising=False)
+    losses, bn = _train_bn()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(4)
+    out = exe.run(program=fluid.default_main_program(),
+                  feed={'img': rng.rand(4, 3, 12, 12).astype('float32'),
+                        'label': np.zeros((4, 1), 'int64')},
+                  fetch_list=[bn], return_numpy=False)[0]
+    assert out.dtype == jnp.bfloat16, out.dtype
+    # running statistics (persistable scope state) remain fp32
+    stats = [n for n in fluid.global_scope().keys()
+             if 'batch_norm' in n and ('mean' in n or 'variance' in n)]
+    assert stats, 'no BN statistics vars found in scope'
+    for n in stats:
+        assert np.asarray(fluid.global_scope().find(n)).dtype == np.float32
+
+
+def test_bn_bf16_tracks_fp32_compute(monkeypatch):
+    """PADDLE_TPU_BN_COMPUTE=fp32 (the ablation knob) must follow the
+    same training trajectory as the bf16 default."""
+    monkeypatch.delenv('PADDLE_TPU_BN_COMPUTE', raising=False)
+    l16, _ = _train_bn()
+    monkeypatch.setenv('PADDLE_TPU_BN_COMPUTE', 'fp32')
+    l32, _ = _train_bn()
+    np.testing.assert_allclose(l16, l32, rtol=5e-2, atol=5e-3)
+
+
 def test_nhwc_conv_layout_matches_nchw(monkeypatch):
     """PADDLE_TPU_CONV_LAYOUT=NHWC is numerics-identical (the bench
     ablation flag, SURVEY §5)."""
