@@ -286,6 +286,15 @@ def main():
                 ablations['transformer_tok_per_sec_seq256'] = round(tok_256,
                                                                     1)
         if not over_budget():
+            tok_scan, err = _run_workload(
+                'transformer', backend, reduced, timeout,
+                env={'PADDLE_TPU_SCAN_LAYERS': '1'})
+            if err:
+                errors['transformer_scan_layers'] = err
+            else:
+                ablations['transformer_tok_per_sec_scan_layers'] = \
+                    round(tok_scan, 1)
+        if not over_budget():
             tok_np, err = _run_workload(
                 'transformer', backend, reduced, timeout,
                 env={'PADDLE_TPU_USE_PALLAS': '1'})

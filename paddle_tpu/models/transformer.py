@@ -139,15 +139,100 @@ def decoder_layer(x, enc_out, n_head, d_key, d_value, d_model, d_inner,
     return _post_process(x, ffn, dropout_rate, name=name + '_pp3')
 
 
+def _stack_param(name, shape, fan_in, fan_out, constant=None):
+    """[n_layer, ...] stacked parameter. Xavier fans are passed explicitly
+    (the leading layer axis must not enter the fan computation)."""
+    from ..initializer import Constant, Xavier
+    init = Constant(constant) if constant is not None else \
+        Xavier(uniform=True, fan_in=fan_in, fan_out=fan_out)
+    return layers.create_parameter(
+        shape=shape, dtype='float32', name=name,
+        attr=ParamAttr(name=name, initializer=init))
+
+
+def _stacked_layer_params(prefix, n_layer, n_head, d_key, d_value, d_model,
+                          d_inner, decoder=False):
+    """The transformer_layer_stack op's weight pytree, stacked on a
+    leading [n_layer] axis (ops/transformer_ops.py slot layout)."""
+    L = n_layer
+    p = {}
+
+    def attn(pre):
+        p[pre + '_q'] = _stack_param('%s_%s_q.w' % (prefix, pre),
+                                     [L, d_model, d_key * n_head],
+                                     d_model, d_key * n_head)
+        p[pre + '_k'] = _stack_param('%s_%s_k.w' % (prefix, pre),
+                                     [L, d_model, d_key * n_head],
+                                     d_model, d_key * n_head)
+        p[pre + '_v'] = _stack_param('%s_%s_v.w' % (prefix, pre),
+                                     [L, d_model, d_value * n_head],
+                                     d_model, d_value * n_head)
+        p[pre + '_o'] = _stack_param('%s_%s_o.w' % (prefix, pre),
+                                     [L, d_value * n_head, d_model],
+                                     d_value * n_head, d_model)
+
+    def ln(slot):
+        p[slot + '_w'] = _stack_param('%s_%s.w' % (prefix, slot),
+                                      [L, d_model], 0, 0, constant=1.0)
+        p[slot + '_b'] = _stack_param('%s_%s.b' % (prefix, slot),
+                                      [L, d_model], 0, 0, constant=0.0)
+
+    attn('slf')
+    ln('ln1')
+    if decoder:
+        attn('cross')
+        ln('ln2')
+    p['ffn_w1'] = _stack_param('%s_ffn_1.w' % prefix,
+                               [L, d_model, d_inner], d_model, d_inner)
+    p['ffn_b1'] = _stack_param('%s_ffn_1.b' % prefix, [L, d_inner],
+                               0, 0, constant=0.0)
+    p['ffn_w2'] = _stack_param('%s_ffn_2.w' % prefix,
+                               [L, d_inner, d_model], d_inner, d_model)
+    p['ffn_b2'] = _stack_param('%s_ffn_2.b' % prefix, [L, d_model],
+                               0, 0, constant=0.0)
+    ln('ln3' if decoder else 'ln2')
+    return p
+
+
+def _layer_stack(x, params, n_head, dropout_rate, enc_out=None,
+                 src_length=None, name='stack'):
+    from ..layers.helper import LayerHelper
+    from ..ops.transformer_ops import _slot_to_input
+    helper = LayerHelper('transformer_layer_stack', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    inputs = {'X': [x]}
+    if enc_out is not None:
+        inputs['EncOut'] = [enc_out]
+    if src_length is not None:
+        inputs['SrcLength'] = [src_length]
+    for slot, param in params.items():
+        inputs[_slot_to_input(slot)] = [param]
+    helper.append_op(type='transformer_layer_stack', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'n_head': n_head,
+                            'dropout_rate': dropout_rate})
+    return out
+
+
 def transformer(src_vocab_size, trg_vocab_size, max_length=256,
                 n_layer=6, n_head=8, d_key=64, d_value=64, d_model=512,
                 d_inner=2048, dropout_rate=0.1, label_smooth_eps=0.1,
                 src_seq_len=None, trg_seq_len=None, batch_size=None,
-                weight_sharing=False):
+                weight_sharing=False, scan_layers=None):
     """Build the full training graph. Feeds: src_word [B,S] int64,
     src_length [B] int64, trg_word [B,T] int64 (decoder input),
     lbl_word [B,T] int64 (shifted target), lbl_weight [B,T] float32
-    (1 for real tokens, 0 for pads). Returns (avg_cost, logits)."""
+    (1 for real tokens, 0 for pads). Returns (avg_cost, logits).
+
+    scan_layers: None reads PADDLE_TPU_SCAN_LAYERS (default off). When
+    on, the n_layer encoder/decoder stacks become ONE
+    transformer_layer_stack op each (lax.scan over [n_layer, ...]
+    stacked weights) — XLA compiles the layer body once, so compile
+    time stays flat as stacks deepen."""
+    import os
+    if scan_layers is None:
+        scan_layers = os.environ.get('PADDLE_TPU_SCAN_LAYERS') == '1'
     src_word = layers.data(name='src_word', shape=[src_seq_len],
                            dtype='int64')
     src_length = layers.data(name='src_length', shape=[], dtype='int64')
@@ -163,20 +248,34 @@ def transformer(src_vocab_size, trg_vocab_size, max_length=256,
     enc_in = _prepare_input(src_word, src_vocab_size, d_model, max_length,
                             dropout_rate, 'src_emb', pos_table)
     x = enc_in
-    for i in range(n_layer):
-        x = encoder_layer(x, n_head, d_key, d_value, d_model, d_inner,
-                          dropout_rate, src_length=src_length,
-                          name='enc_%d' % i)
+    if scan_layers:
+        enc_params = _stacked_layer_params(
+            'enc_stack', n_layer, n_head, d_key, d_value, d_model, d_inner)
+        x = _layer_stack(x, enc_params, n_head, dropout_rate,
+                         src_length=src_length, name='enc_stack')
+    else:
+        for i in range(n_layer):
+            x = encoder_layer(x, n_head, d_key, d_value, d_model, d_inner,
+                              dropout_rate, src_length=src_length,
+                              name='enc_%d' % i)
     enc_out = x
 
     dec_emb_name = 'src_emb' if weight_sharing else 'trg_emb'
     dec_in = _prepare_input(trg_word, trg_vocab_size, d_model, max_length,
                             dropout_rate, dec_emb_name, pos_table)
     y = dec_in
-    for i in range(n_layer):
-        y = decoder_layer(y, enc_out, n_head, d_key, d_value, d_model,
-                          d_inner, dropout_rate, src_length=src_length,
-                          name='dec_%d' % i)
+    if scan_layers:
+        dec_params = _stacked_layer_params(
+            'dec_stack', n_layer, n_head, d_key, d_value, d_model, d_inner,
+            decoder=True)
+        y = _layer_stack(y, dec_params, n_head, dropout_rate,
+                         enc_out=enc_out, src_length=src_length,
+                         name='dec_stack')
+    else:
+        for i in range(n_layer):
+            y = decoder_layer(y, enc_out, n_head, d_key, d_value, d_model,
+                              d_inner, dropout_rate, src_length=src_length,
+                              name='dec_%d' % i)
 
     logits = layers.fc(input=y, size=trg_vocab_size, num_flatten_dims=2,
                        bias_attr=False,
@@ -230,16 +329,26 @@ def make_fake_batch(batch_size, src_seq_len, trg_seq_len, src_vocab_size,
 # ---------------------------------------------------------------- inference
 def _decode_prefix(prefix_ids, enc_out, src_length, cfg):
     """Run the decoder stack over a [B*, t] prefix; returns last-position
-    logits [B*, V]. Parameter names match the training graph, so a
+    logits [B*, V]. Parameter names match the training graph (including
+    the stacked 'dec_stack_*' names when cfg['scan_layers'] is on), so a
     trained scope decodes directly."""
     dec_in = _prepare_input(prefix_ids, cfg['trg_vocab_size'],
                             cfg['d_model'], cfg['max_length'], 0.0,
                             cfg['dec_emb_name'], cfg['pos_table'])
     y = dec_in
-    for i in range(cfg['n_layer']):
-        y = decoder_layer(y, enc_out, cfg['n_head'], cfg['d_key'],
-                          cfg['d_value'], cfg['d_model'], cfg['d_inner'],
-                          0.0, src_length=src_length, name='dec_%d' % i)
+    if cfg['scan_layers']:
+        dec_params = _stacked_layer_params(
+            'dec_stack', cfg['n_layer'], cfg['n_head'], cfg['d_key'],
+            cfg['d_value'], cfg['d_model'], cfg['d_inner'], decoder=True)
+        y = _layer_stack(y, dec_params, cfg['n_head'], 0.0,
+                         enc_out=enc_out, src_length=src_length,
+                         name='dec_stack')
+    else:
+        for i in range(cfg['n_layer']):
+            y = decoder_layer(y, enc_out, cfg['n_head'], cfg['d_key'],
+                              cfg['d_value'], cfg['d_model'],
+                              cfg['d_inner'], 0.0, src_length=src_length,
+                              name='dec_%d' % i)
     logits = layers.fc(input=y, size=cfg['trg_vocab_size'],
                        num_flatten_dims=2, bias_attr=False,
                        param_attr=ParamAttr(name='out_proj.w'))
@@ -249,12 +358,17 @@ def _decode_prefix(prefix_ids, enc_out, src_length, cfg):
 
 
 def _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer, n_head,
-               d_key, d_value, d_model, d_inner, weight_sharing):
+               d_key, d_value, d_model, d_inner, weight_sharing,
+               scan_layers=None):
+    import os
+    if scan_layers is None:
+        scan_layers = os.environ.get('PADDLE_TPU_SCAN_LAYERS') == '1'
     return dict(trg_vocab_size=trg_vocab_size, d_model=d_model,
                 max_length=max_length, n_layer=n_layer, n_head=n_head,
                 d_key=d_key, d_value=d_value, d_inner=d_inner,
                 dec_emb_name='src_emb' if weight_sharing else 'trg_emb',
-                pos_table=position_encoding_table(max_length, d_model))
+                pos_table=position_encoding_table(max_length, d_model),
+                scan_layers=scan_layers)
 
 
 def _build_encoder(src_word, src_length, src_vocab_size, cfg):
@@ -262,10 +376,18 @@ def _build_encoder(src_word, src_length, src_vocab_size, cfg):
                             cfg['max_length'], 0.0, 'src_emb',
                             cfg['pos_table'])
     x = enc_in
-    for i in range(cfg['n_layer']):
-        x = encoder_layer(x, cfg['n_head'], cfg['d_key'], cfg['d_value'],
-                          cfg['d_model'], cfg['d_inner'], 0.0,
-                          src_length=src_length, name='enc_%d' % i)
+    if cfg['scan_layers']:
+        enc_params = _stacked_layer_params(
+            'enc_stack', cfg['n_layer'], cfg['n_head'], cfg['d_key'],
+            cfg['d_value'], cfg['d_model'], cfg['d_inner'])
+        x = _layer_stack(x, enc_params, cfg['n_head'], 0.0,
+                         src_length=src_length, name='enc_stack')
+    else:
+        for i in range(cfg['n_layer']):
+            x = encoder_layer(x, cfg['n_head'], cfg['d_key'],
+                              cfg['d_value'], cfg['d_model'],
+                              cfg['d_inner'], 0.0,
+                              src_length=src_length, name='enc_%d' % i)
     return x
 
 
@@ -273,7 +395,8 @@ def transformer_greedy_infer(src_vocab_size, trg_vocab_size,
                              max_out_len=16, bos_id=0, eos_id=1,
                              src_seq_len=16, max_length=256, n_layer=6,
                              n_head=8, d_key=64, d_value=64, d_model=512,
-                             d_inner=2048, weight_sharing=False):
+                             d_inner=2048, weight_sharing=False,
+                             scan_layers=None):
     """Unrolled greedy decode (static shapes per step, one XLA program).
     Feeds: src_word [B, S], src_length [B]. Returns out_ids [B, T].
     Reference analog: the transformer infer program built with
@@ -281,7 +404,7 @@ def transformer_greedy_infer(src_vocab_size, trg_vocab_size,
     dynamic shapes (round-2: cached incremental While decode)."""
     cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
                      n_head, d_key, d_value, d_model, d_inner,
-                     weight_sharing)
+                     weight_sharing, scan_layers)
     src_word = layers.data(name='src_word', shape=[src_seq_len],
                            dtype='int64')
     src_length = layers.data(name='src_length', shape=[], dtype='int64')
@@ -319,13 +442,14 @@ def transformer_beam_infer(src_vocab_size, trg_vocab_size, beam_size=4,
                            max_out_len=16, bos_id=0, eos_id=1,
                            src_seq_len=16, max_length=256, n_layer=6,
                            n_head=8, d_key=64, d_value=64, d_model=512,
-                           d_inner=2048, weight_sharing=False):
+                           d_inner=2048, weight_sharing=False,
+                           scan_layers=None):
     """Unrolled beam-search decode over the beam_search/beam_gather/
     beam_search_decode ops. Returns (sentence_ids [B, beam, T],
     sentence_scores [B, beam])."""
     cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
                      n_head, d_key, d_value, d_model, d_inner,
-                     weight_sharing)
+                     weight_sharing, scan_layers)
     src_word = layers.data(name='src_word', shape=[src_seq_len],
                            dtype='int64')
     src_length = layers.data(name='src_length', shape=[], dtype='int64')

@@ -1,0 +1,126 @@
+"""Scan-over-layers transformer stack op.
+
+Reference parity: the reference transformer config unrolls its 6 encoder /
+decoder layers into the ProgramDesc op list (one op chain per layer).
+TPU-first design: identical layers are ONE `lax.scan` over weights stacked
+along a leading [n_layer, ...] axis — XLA compiles the layer body once
+instead of n_layer times, so compile time stays flat as stacks deepen
+(SURVEY §5 "scan-over-layers" lever). The per-layer math exactly mirrors
+models/transformer.py encoder_layer/decoder_layer (fused attention →
+residual+LN → FFN → residual+LN, dropout in the same places with the same
+downgrade_in_infer scheme layers.dropout uses).
+
+Emitted by models/transformer.py when scan_layers=True; parity with the
+unrolled graph is asserted in tests/test_transformer_scan.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .attention_ops import fused_attention
+
+
+def _dropout(x, rate, key, is_test):
+    """layers.dropout default (downgrade_in_infer) semantics."""
+    if not rate:
+        return x
+    if is_test:
+        return x * (1.0 - rate)
+    mask = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return x * mask.astype(x.dtype)
+
+
+def _post_process(prev, out, p, rate, key, is_test, ln_slot):
+    # fused_layer_norm: fp32 statistics, activation handed back in x's
+    # dtype, Pallas kernel when profitable — the same path the
+    # layer_norm op lowering dispatches through.
+    from .pallas.layer_norm import fused_layer_norm
+    out = _dropout(out, rate, key, is_test)
+    return fused_layer_norm(prev + out, p[ln_slot + '_w'],
+                            p[ln_slot + '_b'], eps=1e-5,
+                            begin_norm_axis=-1)
+
+
+def _attn(x, mem, p, pre, n_head, causal, key_length, rate, key, is_test,
+          mesh):
+    q3 = x @ p[pre + '_q']
+    k3 = mem @ p[pre + '_k']
+    v3 = mem @ p[pre + '_v']
+    out = fused_attention(q3, k3, v3, n_head, causal=causal,
+                          key_length=key_length, dropout_rate=rate,
+                          rng=key, is_test=is_test, mesh=mesh)
+    return out @ p[pre + '_o']
+
+
+def _ffn(x, p, rate, key, is_test):
+    h = jax.nn.relu(x @ p['ffn_w1'] + p['ffn_b1'])
+    h = _dropout(h, rate, key, is_test)
+    return h @ p['ffn_w2'] + p['ffn_b2']
+
+
+ENC_SLOTS = ('slf_q', 'slf_k', 'slf_v', 'slf_o', 'ln1_w', 'ln1_b',
+             'ffn_w1', 'ffn_b1', 'ffn_w2', 'ffn_b2', 'ln2_w', 'ln2_b')
+DEC_SLOTS = ('slf_q', 'slf_k', 'slf_v', 'slf_o', 'ln1_w', 'ln1_b',
+             'cross_q', 'cross_k', 'cross_v', 'cross_o', 'ln2_w', 'ln2_b',
+             'ffn_w1', 'ffn_b1', 'ffn_w2', 'ffn_b2', 'ln3_w', 'ln3_b')
+
+
+def _slot_to_input(slot):
+    """'slf_q' -> the op input slot name 'SlfQ'."""
+    return ''.join(part.capitalize() for part in slot.split('_'))
+
+
+@register('transformer_layer_stack')
+def _transformer_layer_stack(ctx):
+    x = ctx.input('X')
+    is_decoder = ctx.has_input('EncOut')
+    enc_out = ctx.input('EncOut') if is_decoder else None
+    key_length = ctx.input('SrcLength') if ctx.has_input('SrcLength') \
+        else None
+    n_head = ctx.attr('n_head', 1)
+    rate = ctx.attr('dropout_rate', 0.0)
+    is_test = ctx.attr('is_test', False) or ctx.is_test
+    mesh = getattr(ctx.block.program, 'mesh', None)
+
+    slots = DEC_SLOTS if is_decoder else ENC_SLOTS
+    params = {s: ctx.env[ctx.op.input(_slot_to_input(s))] for s in slots}
+    n_layer = next(iter(params.values())).shape[0]
+
+    if ctx.amp == 'bf16':
+        x = x.astype(jnp.bfloat16)
+        if enc_out is not None:
+            enc_out = enc_out.astype(jnp.bfloat16)
+        for s in slots:
+            # matmul operands ride the MXU in bf16; LN params stay fp32
+            # (their math runs in fp32 inside _layer_norm)
+            if not s.startswith('ln'):
+                params[s] = params[s].astype(jnp.bfloat16)
+
+    # one folded key per (layer, dropout site); scanned alongside params
+    n_sites = 6 if is_decoder else 4
+    if rate and not is_test:
+        site_keys = jax.random.split(
+            ctx.rng_key(), n_layer * n_sites).reshape(n_layer, n_sites)
+        xs = (params, site_keys)
+    else:
+        xs = (params,)
+
+    def body(h, sl):
+        p = sl[0]
+        kk = list(sl[1]) if len(sl) > 1 else [None] * n_sites
+        slf = _attn(h, h, p, 'slf', n_head, is_decoder,
+                    None if is_decoder else key_length,
+                    rate, kk[0], is_test, mesh)
+        h = _post_process(h, slf, p, rate, kk[1], is_test, 'ln1')
+        if is_decoder:
+            cross = _attn(h, enc_out, p, 'cross', n_head, False,
+                          key_length, rate, kk[4], is_test, mesh)
+            h = _post_process(h, cross, p, rate, kk[5], is_test, 'ln2')
+        ffn = _ffn(h, p, rate, kk[2], is_test)
+        h = _post_process(h, ffn, p, rate, kk[3], is_test,
+                          'ln3' if is_decoder else 'ln2')
+        return h, None
+
+    out, _ = jax.lax.scan(body, x, xs)
+    ctx.set_output('Out', out)
