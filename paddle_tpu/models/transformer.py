@@ -12,6 +12,8 @@ TPU-first differences from the reference build:
   one XLA program; bf16-friendly (all matmuls hit the MXU).
 """
 
+import re
+
 import numpy as np
 
 from .. import layers
@@ -326,6 +328,59 @@ def make_fake_batch(batch_size, src_seq_len, trg_seq_len, src_vocab_size,
     }
 
 
+_UNROLLED_PARAM_RE = re.compile(
+    r'^(enc|dec)_(\d+)_(slf|cross)_(q|k|v|out)\.w$|'
+    r'^(enc|dec)_(\d+)_pp(\d)_ln\.(w|b)$|'
+    r'^(enc|dec)_(\d+)_ffn_(1|2)\.(w|b)$')
+
+
+def _unrolled_to_stacked_name(name):
+    """Map an unrolled per-layer param name ('enc_0_slf_q.w',
+    'dec_3_pp1_ln.w', 'enc_1_ffn_2.b') to its stacked equivalent
+    ('enc_stack_slf_q.w', layer index). Returns (None, None) for
+    non-layer params (embeddings, pos tables, out_proj)."""
+    m = _UNROLLED_PARAM_RE.match(name)
+    if not m:
+        return None, None
+    if m.group(1):
+        side, i = m.group(1), int(m.group(2))
+        slot = '%s_%s.w' % (m.group(3),
+                            'o' if m.group(4) == 'out' else m.group(4))
+    elif m.group(5):
+        side, i = m.group(5), int(m.group(6))
+        slot = 'ln%s.%s' % (m.group(7), m.group(8))
+    else:
+        side, i = m.group(9), int(m.group(10))
+        slot = 'ffn_%s.%s' % (m.group(11), m.group(12))
+    return '%s_stack_%s' % (side, slot), i
+
+
+def stack_trained_weights(scope, n_layer):
+    """Convert an unrolled-trained scope in place: np.stack every
+    per-layer parameter onto the stacked '[enc|dec]_stack_*' names the
+    scan/incremental paths read. Non-layer params (embeddings, pos
+    tables, out_proj) already share names. Returns the stacked names."""
+    stacks = {}
+    for name in scope.keys():
+        val = scope.find(name)
+        if val is None:
+            continue
+        sname, i = _unrolled_to_stacked_name(name)
+        if sname is not None:
+            if i >= n_layer:
+                raise ValueError(
+                    'stack_trained_weights: %r has layer index %d but '
+                    'n_layer=%d' % (name, i, n_layer))
+            stacks.setdefault(sname, [None] * n_layer)[i] = np.asarray(val)
+    for sname, parts in stacks.items():
+        missing = [i for i, p in enumerate(parts) if p is None]
+        if missing:
+            raise ValueError('stack_trained_weights: %r missing layers %s'
+                             % (sname, missing))
+        scope.set(sname, np.stack(parts, axis=0))
+    return sorted(stacks)
+
+
 # ---------------------------------------------------------------- inference
 def _decode_prefix(prefix_ids, enc_out, src_length, cfg):
     """Run the decoder stack over a [B*, t] prefix; returns last-position
@@ -391,17 +446,92 @@ def _build_encoder(src_word, src_length, src_vocab_size, cfg):
     return x
 
 
+def _incremental_decode_inputs(enc_out, src_length, cfg):
+    """Shared inputs dict for the KV-cached decode ops: stacked decoder
+    params ('dec_stack_*' — natively present for scan_layers-trained
+    scopes; stack_trained_weights converts unrolled-trained ones) plus
+    embedding / position / output-projection params under the training
+    graph's names."""
+    from ..ops.transformer_ops import _slot_to_input
+
+    dec_params = _stacked_layer_params(
+        'dec_stack', cfg['n_layer'], cfg['n_head'], cfg['d_key'],
+        cfg['d_value'], cfg['d_model'], cfg['d_inner'], decoder=True)
+    emb = layers.create_parameter(
+        shape=[cfg['trg_vocab_size'], cfg['d_model']], dtype='float32',
+        name=cfg['dec_emb_name'],
+        attr=ParamAttr(name=cfg['dec_emb_name'],
+                       initializer=Normal(0., cfg['d_model'] ** -0.5)))
+    pos_enc = layers.create_parameter(
+        shape=[cfg['max_length'], cfg['d_model']], dtype='float32',
+        name=cfg['dec_emb_name'] + '_pos_enc',
+        attr=ParamAttr(name=cfg['dec_emb_name'] + '_pos_enc',
+                       initializer=NumpyArrayInitializer(cfg['pos_table']),
+                       trainable=False))
+    wout = layers.create_parameter(
+        shape=[cfg['d_model'], cfg['trg_vocab_size']], dtype='float32',
+        name='out_proj.w', attr=ParamAttr(name='out_proj.w'))
+    inputs = {'EncOut': [enc_out], 'Emb': [emb], 'PosEnc': [pos_enc],
+              'OutProj': [wout]}
+    if src_length is not None:
+        inputs['SrcLength'] = [src_length]
+    for slot, param in dec_params.items():
+        inputs[_slot_to_input(slot)] = [param]
+    return inputs
+
+
+def _incremental_greedy(enc_out, src_length, cfg, max_out_len, bos_id,
+                        eos_id):
+    """Emit the KV-cached transformer_greedy_decode op: one lax.scan
+    over positions instead of max_out_len prefix re-runs."""
+    from ..layers.helper import LayerHelper
+    inputs = _incremental_decode_inputs(enc_out, src_length, cfg)
+    helper = LayerHelper('transformer_greedy_decode', name='greedy_decode')
+    out = helper.create_variable_for_type_inference('int64')
+    out.shape = (enc_out.shape[0], max_out_len)
+    helper.append_op(type='transformer_greedy_decode', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'n_head': cfg['n_head'],
+                            'max_out_len': max_out_len,
+                            'bos_id': bos_id, 'eos_id': eos_id})
+    return out
+
+
+def _incremental_beam(enc_out, src_length, cfg, beam_size, max_out_len,
+                      bos_id, eos_id):
+    """Emit the KV-cached transformer_beam_decode op (one lax.scan;
+    caches reordered by parent index each step)."""
+    from ..layers.helper import LayerHelper
+    inputs = _incremental_decode_inputs(enc_out, src_length, cfg)
+    helper = LayerHelper('transformer_beam_decode', name='beam_decode')
+    sent = helper.create_variable_for_type_inference('int64')
+    sent.shape = (enc_out.shape[0], beam_size, max_out_len - 1)
+    scores = helper.create_variable_for_type_inference('float32')
+    scores.shape = (enc_out.shape[0], beam_size)
+    helper.append_op(type='transformer_beam_decode', inputs=inputs,
+                     outputs={'SentenceIds': [sent],
+                              'SentenceScores': [scores]},
+                     attrs={'n_head': cfg['n_head'],
+                            'max_out_len': max_out_len,
+                            'beam_size': beam_size,
+                            'bos_id': bos_id, 'eos_id': eos_id})
+    return sent, scores
+
+
 def transformer_greedy_infer(src_vocab_size, trg_vocab_size,
                              max_out_len=16, bos_id=0, eos_id=1,
                              src_seq_len=16, max_length=256, n_layer=6,
                              n_head=8, d_key=64, d_value=64, d_model=512,
                              d_inner=2048, weight_sharing=False,
-                             scan_layers=None):
-    """Unrolled greedy decode (static shapes per step, one XLA program).
-    Feeds: src_word [B, S], src_length [B]. Returns out_ids [B, T].
-    Reference analog: the transformer infer program built with
-    layers.While + beam ops; unrolling trades graph size for zero
-    dynamic shapes (round-2: cached incremental While decode)."""
+                             scan_layers=None, incremental=False):
+    """Greedy decode. incremental=True (TPU-native default path for long
+    outputs) uses the KV-cached transformer_greedy_decode op — one
+    lax.scan over positions, O(T) compute, flat compile time; decoder
+    weights are read in the stacked layout (stack_trained_weights
+    converts an unrolled-trained scope). incremental=False unrolls one
+    decoder re-run per position (static shapes per step, one XLA
+    program; the shape the reference's While-based infer program takes).
+    Feeds: src_word [B, S], src_length [B]. Returns out_ids [B, T]."""
     cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
                      n_head, d_key, d_value, d_model, d_inner,
                      weight_sharing, scan_layers)
@@ -409,6 +539,10 @@ def transformer_greedy_infer(src_vocab_size, trg_vocab_size,
                            dtype='int64')
     src_length = layers.data(name='src_length', shape=[], dtype='int64')
     enc_out = _build_encoder(src_word, src_length, src_vocab_size, cfg)
+    if incremental:
+        ids = _incremental_greedy(enc_out, src_length, cfg, max_out_len,
+                                  bos_id, eos_id)
+        return ids, ['src_word', 'src_length']
 
     bos = layers.fill_constant_batch_size_like(
         src_word, shape=[1, 1], dtype='int64', value=bos_id)
@@ -443,10 +577,13 @@ def transformer_beam_infer(src_vocab_size, trg_vocab_size, beam_size=4,
                            src_seq_len=16, max_length=256, n_layer=6,
                            n_head=8, d_key=64, d_value=64, d_model=512,
                            d_inner=2048, weight_sharing=False,
-                           scan_layers=None):
-    """Unrolled beam-search decode over the beam_search/beam_gather/
-    beam_search_decode ops. Returns (sentence_ids [B, beam, T],
-    sentence_scores [B, beam])."""
+                           scan_layers=None, incremental=False):
+    """Beam-search decode. incremental=False unrolls one decoder re-run
+    per position over the beam_search/beam_gather/beam_search_decode
+    ops; incremental=True emits the KV-cached transformer_beam_decode
+    op (one lax.scan, caches reordered by parent — same sequences, O(T)
+    compute). Returns (sentence_ids [B, beam, T], sentence_scores
+    [B, beam])."""
     cfg = _infer_cfg(src_vocab_size, trg_vocab_size, max_length, n_layer,
                      n_head, d_key, d_value, d_model, d_inner,
                      weight_sharing, scan_layers)
@@ -454,6 +591,10 @@ def transformer_beam_infer(src_vocab_size, trg_vocab_size, beam_size=4,
                            dtype='int64')
     src_length = layers.data(name='src_length', shape=[], dtype='int64')
     enc_out = _build_encoder(src_word, src_length, src_vocab_size, cfg)
+    if incremental:
+        out = _incremental_beam(enc_out, src_length, cfg, beam_size,
+                                max_out_len, bos_id, eos_id)
+        return out, ['src_word', 'src_length']
 
     # tile encoder state over the beam: [B, S, D] -> [B*beam, S, D]
     enc_beam = layers.expand(layers.unsqueeze(enc_out, axes=[1]),

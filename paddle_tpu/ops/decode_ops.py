@@ -206,47 +206,34 @@ def _crf_decoding(ctx):
 
 
 # -------------------------------------------------------------- beam search
-@register('beam_search')
-def _beam_search(ctx):
-    """One decode step: expand each live beam's top-K candidates and keep
-    the best `beam_size` per example. Static [B, beam] layout (the
-    reference walks LoD levels; beam_search_op.cc)."""
-    pre_ids = ctx.input('pre_ids')          # [B, beam] int
-    pre_scores = ctx.input('pre_scores')    # [B, beam] f32
-    ids = ctx.input('ids')                  # [B, beam, K] int candidates
-    scores = ctx.input('scores')            # [B, beam, K] f32 log-probs
-    beam_size = ctx.attr('beam_size')
-    end_id = ctx.attr('end_id')
-
-    b, beam, k = ids.shape
+def beam_search_step(pre_ids, pre_scores, cand_ids, cand_scores, beam_size,
+                     end_id):
+    """Pure-jnp core of one beam step (shared by the beam_search op and
+    transformer_beam_decode): expand each live beam's top-K candidates,
+    keep the best `beam_size` per example. Returns (sel_ids [B, beam],
+    sel_scores [B, beam], parent [B, beam])."""
+    b, beam, k = cand_ids.shape
     finished = pre_ids == end_id
     # finished beams contribute exactly one candidate: end_id at their
     # frozen score; live beams add candidate log-probs.
     total = pre_scores[:, :, None] + jnp.where(finished[:, :, None],
-                                               0.0, scores)
-    cand_ids = jnp.where(finished[:, :, None], end_id, ids)
+                                               0.0, cand_scores)
+    cand_ids = jnp.where(finished[:, :, None], end_id, cand_ids)
     # suppress duplicate candidates of finished beams (keep slot 0)
     dup_mask = finished[:, :, None] & (jnp.arange(k) > 0)[None, None, :]
     total = jnp.where(dup_mask, _NEG, total)
 
-    flat_scores = total.reshape(b, beam * k)
-    flat_ids = cand_ids.reshape(b, beam * k)
-    top_scores, top_pos = jax.lax.top_k(flat_scores, beam_size)
-    sel_ids = jnp.take_along_axis(flat_ids, top_pos, axis=1)
-    parent = (top_pos // k).astype(_i64())
-    ctx.set_output('selected_ids', sel_ids.astype(_i64()))
-    ctx.set_output('selected_scores', top_scores)
-    ctx.set_output('parent_idx', parent)
+    top_scores, top_pos = jax.lax.top_k(total.reshape(b, beam * k),
+                                        beam_size)
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(b, beam * k), top_pos,
+                                  axis=1)
+    return sel_ids, top_scores, top_pos // k
 
 
-@register('beam_search_decode')
-def _beam_search_decode(ctx):
-    """Backtrack stacked per-step (ids, parents) into full sequences.
-    StepIds/StepParents: [T, B, beam]; outputs SentenceIds [B, beam, T]
-    (end_id-padded) and SentenceScores passthrough of the final scores."""
-    step_ids = ctx.input('StepIds')
-    step_parents = ctx.input('StepParents')
-    end_id = ctx.attr('end_id')
+def beam_backtrack(step_ids, step_parents, end_id):
+    """Pure-jnp core of beam_search_decode: backtrack stacked per-step
+    (ids, parents) [T, B, beam] into sequences [B, beam, T], everything
+    after the first end_id frozen to end_id."""
     t, b, beam = step_ids.shape
 
     def back(carry, xs):
@@ -260,9 +247,29 @@ def _beam_search_decode(ctx):
     _, toks = jax.lax.scan(back, init, (step_ids, step_parents),
                            reverse=True)
     seq = jnp.moveaxis(toks, 0, -1)          # [B, beam, T]
-    # everything after the first end_id becomes end_id
     seen_end = jnp.cumsum((seq == end_id).astype(jnp.int32), axis=-1)
-    seq = jnp.where((seen_end >= 1) & (seq != end_id), end_id, seq)
+    return jnp.where((seen_end >= 1) & (seq != end_id), end_id, seq)
+
+
+@register('beam_search')
+def _beam_search(ctx):
+    """One decode step over static [B, beam] layout (the reference walks
+    LoD levels; beam_search_op.cc)."""
+    sel_ids, sel_scores, parent = beam_search_step(
+        ctx.input('pre_ids'), ctx.input('pre_scores'), ctx.input('ids'),
+        ctx.input('scores'), ctx.attr('beam_size'), ctx.attr('end_id'))
+    ctx.set_output('selected_ids', sel_ids.astype(_i64()))
+    ctx.set_output('selected_scores', sel_scores)
+    ctx.set_output('parent_idx', parent.astype(_i64()))
+
+
+@register('beam_search_decode')
+def _beam_search_decode(ctx):
+    """Backtrack stacked per-step (ids, parents) into full sequences.
+    StepIds/StepParents: [T, B, beam]; outputs SentenceIds [B, beam, T]
+    (end_id-padded) and SentenceScores passthrough of the final scores."""
+    seq = beam_backtrack(ctx.input('StepIds'), ctx.input('StepParents'),
+                         ctx.attr('end_id'))
     ctx.set_output('SentenceIds', seq.astype(_i64()))
     if ctx.has_input('FinalScores'):
         ctx.set_output('SentenceScores', ctx.input('FinalScores'))

@@ -124,3 +124,198 @@ def _transformer_layer_stack(ctx):
 
     out, _ = jax.lax.scan(body, x, xs)
     ctx.set_output('Out', out)
+
+
+# --------------------------------------------------------- incremental decode
+def _mha_one_step(q1, kc, vc, n_head, live):
+    """One-query attention against a cached key/value buffer.
+
+    q1: [B, HD] (the current position), kc/vc: [B, Tmax, HD] head-merged
+    caches, live: [B] or scalar — number of valid cache positions; the
+    rest are masked. Returns [B, HD]. fp32 softmax."""
+    b, tmax, hd = kc.shape
+    d = hd // n_head
+    q = q1.reshape(b, n_head, 1, d)
+    k = kc.reshape(b, tmax, n_head, d).transpose(0, 2, 1, 3)
+    v = vc.reshape(b, tmax, n_head, d).transpose(0, 2, 1, 3)
+    logits = jnp.einsum('bhqd,bhkd->bhqk', (q * d ** -0.5), k)
+    mask = jnp.arange(tmax)[None, :] < jnp.reshape(live, (-1, 1))
+    logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', w.astype(v.dtype), v)
+    return out.transpose(0, 2, 1, 3).reshape(b, hd)
+
+
+def _incremental_layer_scan(params, n_head, cross_live, x, kcs, vcs, ck,
+                            cv, t):
+    """One decoder step through all layers (inner lax.scan): append this
+    position's K/V into the caches, self-attend over live cache, cross-
+    attend over the precomputed encoder K/V, FFN; residual+LN as in
+    decoder_layer. Returns (h, new kcaches, new vcaches)."""
+    from .pallas.layer_norm import fused_layer_norm
+
+    def ln(h, p, slot):
+        return fused_layer_norm(h, p[slot + '_w'], p[slot + '_b'],
+                                eps=1e-5, begin_norm_axis=-1)
+
+    def body(h, sl):
+        p, kc, vc, ckl, cvl = sl
+        kc = jax.lax.dynamic_update_slice(
+            kc, (h @ p['slf_k'])[:, None, :], (0, t, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, (h @ p['slf_v'])[:, None, :], (0, t, 0))
+        slf = _mha_one_step(h @ p['slf_q'], kc, vc, n_head, t + 1)
+        h = ln(h + slf @ p['slf_o'], p, 'ln1')
+        cross = _mha_one_step(h @ p['cross_q'], ckl, cvl, n_head,
+                              cross_live)
+        h = ln(h + cross @ p['cross_o'], p, 'ln2')
+        ffn = jax.nn.relu(h @ p['ffn_w1'] + p['ffn_b1']) \
+            @ p['ffn_w2'] + p['ffn_b2']
+        h = ln(h + ffn, p, 'ln3')
+        return h, (kc, vc)
+
+    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs, ck, cv))
+    return h, kcs, vcs
+
+
+def _decode_op_inputs(ctx):
+    """Shared input unpack + amp policy for the incremental decode ops."""
+    enc_out = ctx.input('EncOut')
+    src_len = ctx.input('SrcLength') if ctx.has_input('SrcLength') else None
+    emb = ctx.input('Emb')
+    pos = ctx.input('PosEnc')
+    wout = ctx.input('OutProj')
+    params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
+              for s in DEC_SLOTS}
+    if ctx.amp == 'bf16':
+        enc_out = enc_out.astype(jnp.bfloat16)
+        emb = emb.astype(jnp.bfloat16)
+        wout = wout.astype(jnp.bfloat16)
+        pos = pos.astype(jnp.bfloat16)
+        for s in DEC_SLOTS:
+            if not s.startswith('ln'):
+                params[s] = params[s].astype(jnp.bfloat16)
+    return enc_out, src_len, emb, pos, wout, params
+
+
+@register('transformer_greedy_decode')
+def _transformer_greedy_decode(ctx):
+    """KV-cached greedy decode: ONE lax.scan over output positions (inner
+    scan over decoder layers), instead of re-running the decoder over the
+    whole prefix per emitted token as the reference's While-based infer
+    program does. Compute drops from O(T^2 L) to O(T L); compile time is
+    flat in max_out_len. Emitted by
+    models.transformer.transformer_greedy_infer(incremental=True)."""
+    enc_out, src_len, emb, pos, wout, params = _decode_op_inputs(ctx)
+    n_head = ctx.attr('n_head', 1)
+    t_max = ctx.attr('max_out_len')
+    bos_id = ctx.attr('bos_id', 0)
+    eos_id = ctx.attr('eos_id', 1)
+    d_model = emb.shape[-1]
+
+    b = enc_out.shape[0]
+    n_layer = params['slf_q'].shape[0]
+    hdk = params['slf_q'].shape[-1]
+    hdv = params['slf_v'].shape[-1]
+    s_len = enc_out.shape[1]
+    cross_live = src_len if src_len is not None else s_len
+
+    # cross-attention K/V never change over time: compute once per layer
+    ck = jnp.einsum('bsd,ldh->lbsh', enc_out, params['cross_k'])
+    cv = jnp.einsum('bsd,ldh->lbsh', enc_out, params['cross_v'])
+
+    kc0 = jnp.zeros((n_layer, b, t_max, hdk), enc_out.dtype)
+    vc0 = jnp.zeros((n_layer, b, t_max, hdv), enc_out.dtype)
+    ids0 = jnp.full((b,), bos_id, jnp.int32)
+
+    def step(carry, t):
+        ids, kcs, vcs = carry
+        x = jnp.take(emb, ids, axis=0) * (d_model ** 0.5) + \
+            jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)
+        h, kcs, vcs = _incremental_layer_scan(
+            params, n_head, cross_live, x, kcs, vcs, ck, cv, t)
+        logits = (h @ wout).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kcs, vcs), nxt
+
+    _, steps = jax.lax.scan(step, (ids0, kc0, vc0),
+                            jnp.arange(t_max - 1))
+    ids = jnp.concatenate([jnp.full((b, 1), bos_id, jnp.int32),
+                           steps.T], axis=1)          # [B, T]
+    # freeze everything after the first EOS to EOS
+    is_eos = (ids == eos_id).astype(jnp.int32)
+    before = jnp.cumsum(is_eos, axis=1) - is_eos
+    ids = jnp.where(before > 0, eos_id, ids)
+    ctx.set_output('Out', ids.astype(ctx.out_dtype('Out', 'int64')))
+
+
+@register('transformer_beam_decode')
+def _transformer_beam_decode(ctx):
+    """KV-cached beam search in ONE lax.scan: the per-step candidate
+    expansion/pruning is the exact math of the beam_search op
+    (decode_ops.py), caches are reordered by parent in place of the
+    unrolled graph's prefix beam_gather + full re-run, and the final
+    backtrack is the beam_search_decode recurrence. Emits identical
+    sequences to the unrolled transformer_beam_infer graph."""
+    enc_out, src_len, emb, pos, wout, params = _decode_op_inputs(ctx)
+    n_head = ctx.attr('n_head', 1)
+    t_max = ctx.attr('max_out_len')
+    beam = ctx.attr('beam_size', 4)
+    bos_id = ctx.attr('bos_id', 0)
+    eos_id = ctx.attr('eos_id', 1)
+    d_model = emb.shape[-1]
+
+    b = enc_out.shape[0]
+    n_layer = params['slf_q'].shape[0]
+    hdk = params['slf_q'].shape[-1]
+    hdv = params['slf_v'].shape[-1]
+    s_len = enc_out.shape[1]
+
+    # tile examples over the beam: [B, S, D] -> [B*beam, S, D]
+    enc_beam = jnp.repeat(enc_out, beam, axis=0)
+    cross_live = jnp.repeat(src_len, beam, axis=0) \
+        if src_len is not None else s_len
+    ck = jnp.einsum('bsd,ldh->lbsh', enc_beam, params['cross_k'])
+    cv = jnp.einsum('bsd,ldh->lbsh', enc_beam, params['cross_v'])
+
+    kc0 = jnp.zeros((n_layer, b * beam, t_max, hdk), enc_out.dtype)
+    vc0 = jnp.zeros((n_layer, b * beam, t_max, hdv), enc_out.dtype)
+    last0 = jnp.full((b * beam,), bos_id, jnp.int32)
+    pre_ids0 = jnp.full((b, beam), bos_id, jnp.int32)
+    # only beam slot 0 live at t=0 (all beams start identical)
+    pre_scores0 = jnp.where(jnp.arange(beam)[None, :] == 0, 0.0, -1e9) * \
+        jnp.ones((b, 1), jnp.float32)
+
+    def gather_caches(c, parent):
+        # c: [L, B*beam, Tmax, HD]; parent: [B, beam] — reorder beams
+        cb = c.reshape(n_layer, b, beam, t_max, c.shape[-1])
+        idx = parent[None, :, :, None, None]
+        return jnp.take_along_axis(cb, idx, axis=2).reshape(c.shape)
+
+    def step(carry, t):
+        last, pre_ids, pre_scores, kcs, vcs = carry
+        x = jnp.take(emb, last, axis=0) * (d_model ** 0.5) + \
+            jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)
+        h, kcs, vcs = _incremental_layer_scan(
+            params, n_head, cross_live, x, kcs, vcs, ck, cv, t)
+        logp = jax.nn.log_softmax((h @ wout).astype(jnp.float32), axis=-1)
+        top_scores, top_ids = jax.lax.top_k(logp, beam)
+        from .decode_ops import beam_search_step
+        sel_ids, sel_scores, parent = beam_search_step(
+            pre_ids, pre_scores, top_ids.reshape(b, beam, beam),
+            top_scores.reshape(b, beam, beam), beam, eos_id)
+        kcs = gather_caches(kcs, parent)
+        vcs = gather_caches(vcs, parent)
+        carry = (sel_ids.reshape(-1).astype(jnp.int32), sel_ids,
+                 sel_scores, kcs, vcs)
+        return carry, (sel_ids, parent)
+
+    (_, _, final_scores, _, _), (step_ids, step_parents) = jax.lax.scan(
+        step, (last0, pre_ids0, pre_scores0, kc0, vc0),
+        jnp.arange(t_max - 1))
+
+    from .decode_ops import beam_backtrack
+    seq = beam_backtrack(step_ids, step_parents, eos_id)  # [B, beam, T-1]
+    ctx.set_output('SentenceIds',
+                   seq.astype(ctx.out_dtype('SentenceIds', 'int64')))
+    ctx.set_output('SentenceScores', final_scores)

@@ -71,6 +71,62 @@ def test_beam_infer_matches_greedy_top1():
     assert (np.diff(got_scores, axis=1) <= 1e-5).all()
 
 
+def test_incremental_greedy_matches_unrolled():
+    """KV-cached incremental decode (transformer_greedy_decode op) must
+    emit exactly the ids the unrolled per-prefix decode emits; the
+    unrolled-trained scope converts via stack_trained_weights."""
+    from paddle_tpu.models import transformer as T
+    seq_len, vocab = 6, 16
+    exe, src, loss = _overfit_copy_task(seq_len, vocab)
+    feed = {'src_word': src,
+            'src_length': np.full((8,), seq_len, 'int64')}
+    kw = dict(max_out_len=seq_len + 1, src_seq_len=seq_len,
+              max_length=32, n_layer=1, n_head=2, d_key=8, d_value=8,
+              d_model=16, d_inner=32)
+    unrolled_prog = fluid.Program()
+    with fluid.program_guard(unrolled_prog, fluid.Program()):
+        ids_u, _ = T.transformer_greedy_infer(vocab, vocab, **kw)
+    got_u = exe.run(program=unrolled_prog, feed=feed,
+                    fetch_list=[ids_u])[0]
+    T.stack_trained_weights(fluid.global_scope(), n_layer=1)
+    inc_prog = fluid.Program()
+    with fluid.program_guard(inc_prog, fluid.Program()):
+        ids_i, _ = T.transformer_greedy_infer(vocab, vocab,
+                                              incremental=True, **kw)
+    got_i = exe.run(program=inc_prog, feed=feed, fetch_list=[ids_i])[0]
+    np.testing.assert_array_equal(got_i, got_u)
+    acc = (got_i[:, 1:] == src).mean()
+    assert acc > 0.9, (acc, got_i[:2], src[:2])
+
+
+def test_incremental_beam_matches_unrolled():
+    """transformer_beam_decode (KV-cached, single scan) must emit the
+    same sentences and scores as the unrolled beam graph."""
+    from paddle_tpu.models import transformer as T
+    seq_len, vocab = 5, 12
+    exe, src, loss = _overfit_copy_task(seq_len, vocab, steps=80)
+    feed = {'src_word': src,
+            'src_length': np.full((8,), seq_len, 'int64')}
+    kw = dict(beam_size=3, max_out_len=seq_len + 1, src_seq_len=seq_len,
+              max_length=32, n_layer=1, n_head=2, d_key=8, d_value=8,
+              d_model=16, d_inner=32, eos_id=1)
+    unrolled_prog = fluid.Program()
+    with fluid.program_guard(unrolled_prog, fluid.Program()):
+        (sent_u, scores_u), _ = T.transformer_beam_infer(vocab, vocab,
+                                                         **kw)
+    got_u, sc_u = exe.run(program=unrolled_prog, feed=feed,
+                          fetch_list=[sent_u, scores_u])
+    T.stack_trained_weights(fluid.global_scope(), n_layer=1)
+    inc_prog = fluid.Program()
+    with fluid.program_guard(inc_prog, fluid.Program()):
+        (sent_i, scores_i), _ = T.transformer_beam_infer(
+            vocab, vocab, incremental=True, **kw)
+    got_i, sc_i = exe.run(program=inc_prog, feed=feed,
+                          fetch_list=[sent_i, scores_i])
+    np.testing.assert_array_equal(got_i, got_u)
+    np.testing.assert_allclose(sc_i, sc_u, rtol=1e-4, atol=1e-5)
+
+
 def test_infer_graph_fresh_scope():
     """The infer graphs must be self-contained: fresh scope, run startup,
     decode — no prior training graph in the process (regression: a [B,1]

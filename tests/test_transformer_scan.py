@@ -5,8 +5,6 @@ lax.scan body over [n_layer, ...] stacked weights
 scanned graph must follow the unrolled graph's training trajectory
 exactly (same losses step by step => same gradients)."""
 
-import re
-
 import numpy as np
 
 import paddle_tpu as fluid
@@ -28,30 +26,6 @@ def _build(scan):
     return avg_cost, exe, fluid.default_main_program()
 
 
-_STACK_RE = re.compile(
-    r'^(enc|dec)_(\d+)_(slf|cross)_(q|k|v|out)\.w$|'
-    r'^(enc|dec)_(\d+)_pp(\d)_ln\.(w|b)$|'
-    r'^(enc|dec)_(\d+)_ffn_(1|2)\.(w|b)$')
-
-
-def _stacked_name(name):
-    """unrolled per-layer param name -> (stacked name, layer index)."""
-    m = _STACK_RE.match(name)
-    if not m:
-        return None, None
-    if m.group(1):  # attention projection
-        side, i, pre, wo = m.group(1), int(m.group(2)), m.group(3), \
-            m.group(4)
-        slot = '%s_%s.w' % (pre, 'o' if wo == 'out' else wo)
-    elif m.group(5):  # post-process layer norm: pp1->ln1, pp2->ln2, ...
-        side, i = m.group(5), int(m.group(6))
-        slot = 'ln%s.%s' % (m.group(7), m.group(8))
-    else:  # ffn
-        side, i = m.group(9), int(m.group(10))
-        slot = 'ffn_%s.%s' % (m.group(11), m.group(12))
-    return '%s_stack_%s' % (side, slot), i
-
-
 def _snapshot(scope):
     return {n: np.asarray(scope.find(n)) for n in scope.keys()
             if scope.find(n) is not None}
@@ -59,11 +33,12 @@ def _snapshot(scope):
 
 def _copy_weights(src_vals, dst_scope, n_layer):
     """Copy the unrolled model's weights into the scan model's scope:
-    per-layer params are np.stack'ed onto the leading layer axis, the
-    rest (embeddings, pos table, out_proj) share names verbatim."""
+    per-layer params are np.stack'ed onto the leading layer axis (the
+    production stack_trained_weights mapping), the rest (embeddings,
+    pos table, out_proj) share names verbatim."""
     stacks = {}
     for name, val in src_vals.items():
-        sname, i = _stacked_name(name)
+        sname, i = T._unrolled_to_stacked_name(name)
         if sname is None:
             if dst_scope.find(name) is not None:
                 dst_scope.set(name, val)
