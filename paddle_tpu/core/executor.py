@@ -139,19 +139,7 @@ class Executor(object):
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in fetch_list]
 
-        # Normalize feed values to arrays with the declared (canonicalized)
-        # dtype. Values already on device (jax Arrays) are passed through
-        # untouched — np.asarray would round-trip them through host memory.
-        feed_vals = {}
-        for name, value in feed.items():
-            var = block._find_var_recursive(name)
-            dtype = to_jnp_dtype(var.dtype) if var is not None else None
-            arr = value if isinstance(value, jax.Array) \
-                else np.asarray(value)
-            if dtype is not None and arr.dtype != dtype:
-                arr = arr.astype(dtype)
-            feed_vals[name] = arr
-
+        feed_vals = self._normalize_feed(block, feed)
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, program.amp,
@@ -162,24 +150,8 @@ class Executor(object):
             if use_program_cache:
                 self._cache[key] = compiled
 
-        missing = [n for n in compiled.feed_names if n not in feed_vals]
-        if missing:
-            raise ValueError('Executor.run: missing feed for data vars %s'
-                             % missing)
-
-        scope_vals = {}
-        for name in compiled.scope_in_names:
-            value = scope.find(name)
-            if value is None:
-                raise RuntimeError(
-                    'Variable %r is not initialized in scope. Run the '
-                    'startup program first.' % name)
-            scope_vals[name] = value
-
-        mesh = program.mesh
-        if mesh is not None:
-            scope_vals = self._shard_values(program, mesh, scope_vals)
-            feed_vals = self._shard_values(program, mesh, feed_vals)
+        scope_vals, feed_vals = self._prepare_inputs(
+            'Executor.run', program, compiled, scope, feed_vals)
 
         step_i = np.int32(self._step)
         self._step += 1
@@ -192,8 +164,150 @@ class Executor(object):
             return [np.asarray(v) for v in fetches]
         return list(fetches)
 
+    # ---------------------------------------------------------- multi-step
+    def run_steps(self, steps, program=None, feed=None, fetch_list=None,
+                  scope=None, return_numpy=True, stacked_feed=False):
+        """Run `steps` training steps as ONE XLA execution: the compiled
+        step function is wrapped in a lax.scan, so per-dispatch overhead
+        (host->device feed, dispatch latency — ~5 ms through a tunneled
+        backend) is paid once per `steps` instead of per step. State
+        (params, optimizer accumulators, BN stats) chains through the
+        scan carry exactly as it chains through the scope across
+        Executor.run calls; the per-op PRNG keys fold the true global
+        step index, so dropout masks differ per step exactly as they do
+        in the one-step path.
+
+        feed values are constant across steps by default (microbench /
+        full-batch training); with stacked_feed=True every feed array
+        carries a leading [steps, ...] axis (a prefetched superbatch —
+        reader.prefetch_to_device pairs with this). Fetches come back
+        stacked over the steps axis.
+
+        Reference analog: the trainer's inner batch loop
+        (python/paddle/v2/trainer.py:1 train loop); TPU-first, the loop
+        itself compiles into the program."""
+        import jax
+        import jax.numpy as jnp
+
+        _ensure_ops_imported()
+        program = program if program is not None else default_main_program()
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in fetch_list]
+
+        feed_vals = self._normalize_feed(block, feed or {})
+        if stacked_feed:
+            for name, arr in feed_vals.items():
+                if arr.shape[0] != steps:
+                    raise ValueError(
+                        'run_steps(stacked_feed=True): feed %r leading '
+                        'dim %d != steps %d' % (name, arr.shape[0], steps))
+
+        sig_shape = {n: (v.shape[1:] if stacked_feed else v.shape)
+                     for n, v in feed_vals.items()}
+        feed_sig = tuple(sorted((n, sig_shape[n], str(v.dtype))
+                                for n, v in feed_vals.items()))
+        key = ('multi', id(program), program._version, program.amp,
+               program.remat_policy, feed_sig, tuple(fetch_names),
+               steps, stacked_feed)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            base = self._compile(program, sorted(feed_vals), fetch_names)
+
+            # state that is read each step chains through the scan carry;
+            # written-only persistables (no reader) are ALSO carried —
+            # seeded with zeros of their traced shape and overwritten
+            # every step — so only their final value occupies memory
+            # (stacking them in the ys would cost steps x size).
+            written_only = [n for n in base.scope_out_names
+                            if n not in set(base.scope_in_names)]
+
+            def multi_fn(scope_vals, feeds, step0):
+                f0 = {n: v[0] for n, v in feeds.items()} \
+                    if stacked_feed else feeds
+                _, ns_shapes = jax.eval_shape(base.raw_fn, scope_vals,
+                                              f0, step0)
+                wo0 = {n: jnp.zeros(ns_shapes[n].shape,
+                                    ns_shapes[n].dtype)
+                       for n in written_only if n in ns_shapes}
+
+                def body(carry, t):
+                    sc, wo = carry
+                    f = {n: v[t] for n, v in feeds.items()} \
+                        if stacked_feed else feeds
+                    fetches, new_scope = base.raw_fn(sc, f, step0 + t)
+                    return ({n: new_scope[n] for n in sc},
+                            {n: new_scope[n] for n in wo}), fetches
+
+                (final_sc, final_wo), stacked = jax.lax.scan(
+                    body, (scope_vals, wo0),
+                    jnp.arange(steps, dtype=jnp.int32))
+                final_scope = dict(final_sc)
+                final_scope.update(final_wo)
+                return stacked, final_scope
+
+            jit_multi = jax.jit(multi_fn, donate_argnums=(0,))
+            compiled = _Compiled(jit_multi, base.raw_fn,
+                                 base.scope_in_names, base.scope_out_names,
+                                 base.feed_names, base.fetch_names)
+            self._cache[key] = compiled
+
+        scope_vals, feed_vals = self._prepare_inputs(
+            'Executor.run_steps', program, compiled, scope, feed_vals,
+            feed_stack_axis=stacked_feed)
+        step0 = np.int32(self._step)
+        self._step += steps
+        fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
+        for name, value in new_scope.items():
+            scope.set(name, value)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
     # -------------------------------------------------------------- helpers
-    def _shard_values(self, program, mesh, vals):
+    def _normalize_feed(self, block, feed):
+        """Normalize feed values to arrays with the declared
+        (canonicalized) dtype. Values already on device (jax Arrays) are
+        passed through untouched — np.asarray would round-trip them
+        through host memory."""
+        import jax
+        feed_vals = {}
+        for name, value in feed.items():
+            var = block._find_var_recursive(name)
+            dtype = to_jnp_dtype(var.dtype) if var is not None else None
+            arr = value if isinstance(value, jax.Array) \
+                else np.asarray(value)
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            feed_vals[name] = arr
+        return feed_vals
+
+    def _prepare_inputs(self, who, program, compiled, scope, feed_vals,
+                        feed_stack_axis=False):
+        """Missing-feed check, scope gather, and mesh sharding shared by
+        run / run_steps / compile_step."""
+        missing = [n for n in compiled.feed_names if n not in feed_vals]
+        if missing:
+            raise ValueError('%s: missing feed for data vars %s'
+                             % (who, missing))
+        scope_vals = {}
+        for name in compiled.scope_in_names:
+            value = scope.find(name)
+            if value is None:
+                raise RuntimeError(
+                    'Variable %r is not initialized in scope. Run the '
+                    'startup program first.' % name)
+            scope_vals[name] = value
+        mesh = program.mesh
+        if mesh is not None:
+            scope_vals = self._shard_values(program, mesh, scope_vals)
+            feed_vals = self._shard_values(program, mesh, feed_vals,
+                                           stack_axis=feed_stack_axis)
+        return scope_vals, feed_vals
+
+    def _shard_values(self, program, mesh, vals, stack_axis=False):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
         out = {}
@@ -201,6 +315,10 @@ class Executor(object):
             spec = program.var_shardings.get(name)
             if spec is None:
                 spec = PartitionSpec()
+            elif stack_axis:
+                # stacked_feed superbatch: the var's spec describes the
+                # per-step array; the leading [steps] axis is replicated
+                spec = PartitionSpec(None, *spec)
             sharding = NamedSharding(mesh, spec)
             already = getattr(value, 'sharding', None)
             if already == sharding:
@@ -367,25 +485,8 @@ class Executor(object):
         block = program.global_block()
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
-        feed_vals = {}
-        for name, value in (feed or {}).items():
-            var = block._find_var_recursive(name)
-            dtype = to_jnp_dtype(var.dtype) if var is not None else None
-            arr = np.asarray(value)
-            if dtype is not None and arr.dtype != dtype:
-                arr = arr.astype(dtype)
-            feed_vals[name] = arr
+        feed_vals = self._normalize_feed(block, feed or {})
         compiled = self._compile(program, sorted(feed_vals), fetch_names)
-        scope_vals = {}
-        for name in compiled.scope_in_names:
-            value = scope.find(name)
-            if value is None:
-                raise RuntimeError(
-                    'Variable %r not initialized; run startup program first.'
-                    % name)
-            scope_vals[name] = value
-        mesh = program.mesh
-        if mesh is not None:
-            scope_vals = self._shard_values(program, mesh, scope_vals)
-            feed_vals = self._shard_values(program, mesh, feed_vals)
+        scope_vals, feed_vals = self._prepare_inputs(
+            'Executor.compile_step', program, compiled, scope, feed_vals)
         return compiled.raw_fn, scope_vals, feed_vals

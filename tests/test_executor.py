@@ -167,3 +167,84 @@ def test_wrong_dtype_feed_autocasts():
                   fetch_list=[out], return_numpy=False)[0]
     import jax.numpy as jnp
     assert got.dtype == jnp.float32
+
+
+def _mlp_with_dropout():
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=16, act='relu',
+                        param_attr=fluid.ParamAttr(name='ms_w1'))
+    h = fluid.layers.dropout(h, dropout_prob=0.3)
+    pred = fluid.layers.fc(input=h, size=1,
+                           param_attr=fluid.ParamAttr(name='ms_w2'))
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+        cost)
+    return cost
+
+
+def test_run_steps_matches_per_step_trajectory():
+    """Executor.run_steps (training loop compiled into the XLA program
+    via lax.scan) must reproduce the per-step Executor.run trajectory
+    EXACTLY — including dropout masks (the per-op PRNG folds the same
+    global step index on both paths) and optimizer accumulator state."""
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(16, 8).astype('f'),
+            'y': rng.randn(16, 1).astype('f')}
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        fluid.reset_default_programs()
+        cost = _mlp_with_dropout()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        single = [float(np.asarray(exe.run(
+            feed=feed, fetch_list=[cost])[0]).reshape(()))
+            for _ in range(5)]
+        w1 = np.asarray(s1.find('ms_w1'))
+    with fluid.scope_guard(s2):
+        fluid.reset_default_programs()
+        cost = _mlp_with_dropout()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        multi = np.asarray(exe.run_steps(
+            5, feed=feed, fetch_list=[cost])[0]).reshape(-1)
+        w2 = np.asarray(s2.find('ms_w1'))
+    np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_stacked_feed():
+    """stacked_feed=True: each step consumes its own slice of a
+    [steps, ...] superbatch — equal to feeding the batches one by one."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(4, 16, 8).astype('f')
+    ys = rng.randn(4, 16, 1).astype('f')
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        fluid.reset_default_programs()
+        cost = _mlp_with_dropout()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        single = [float(np.asarray(exe.run(
+            feed={'x': xs[i], 'y': ys[i]},
+            fetch_list=[cost])[0]).reshape(())) for i in range(4)]
+    with fluid.scope_guard(s2):
+        fluid.reset_default_programs()
+        cost = _mlp_with_dropout()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        multi = np.asarray(exe.run_steps(
+            4, feed={'x': xs, 'y': ys}, fetch_list=[cost],
+            stacked_feed=True)[0]).reshape(-1)
+    np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_stacked_feed_wrong_leading_dim():
+    fluid.reset_default_programs()
+    cost = _mlp_with_dropout()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match='leading'):
+        exe.run_steps(3, feed={'x': np.zeros((2, 16, 8), 'f'),
+                               'y': np.zeros((2, 16, 1), 'f')},
+                      fetch_list=[cost], stacked_feed=True)

@@ -378,3 +378,36 @@ def test_parallel_executor_facade():
         np.asarray(fluid.global_scope().find('w1')), w1_1,
         rtol=1e-4, atol=1e-5)
     pe.bcast_params()  # no-op, API compatibility
+
+
+def test_run_steps_on_mesh_with_stacked_feed():
+    """run_steps(stacked_feed=True) on a dp mesh: the var's PartitionSpec
+    describes the per-step batch, so the superbatch shards with a
+    replicated leading [steps] axis (steps need not divide the mesh) and
+    the trajectory equals per-step dispatch."""
+    steps = 3  # deliberately not divisible by the 8-way dp axis
+    rng = np.random.RandomState(3)
+    xs = rng.rand(steps, 16, 6).astype('float32')
+    ys = rng.randint(0, 4, (steps, 16, 1)).astype('int64')
+
+    def build():
+        fluid.reset_default_programs()
+        fluid.global_scope().clear()
+        loss = _build_mlp_loss()
+        fluid.default_main_program().random_seed = 7
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        transpile(fluid.default_main_program(), make_mesh(dp=8),
+                  ParallelStrategy(data_parallel=True))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return loss, exe
+
+    loss, exe = build()
+    single = [float(np.asarray(exe.run(
+        feed={'x': xs[i], 'y': ys[i]}, fetch_list=[loss])[0]).reshape(()))
+        for i in range(steps)]
+    loss, exe = build()
+    multi = np.asarray(exe.run_steps(
+        steps, feed={'x': xs, 'y': ys}, fetch_list=[loss],
+        stacked_feed=True)[0]).reshape(-1)
+    np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
