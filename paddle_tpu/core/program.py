@@ -313,7 +313,8 @@ class Program(object):
                                   is_data=v.is_data, trainable=v.trainable)
                 # carry layer-attached annotations (v2 input types,
                 # row_shard hints) through the copy
-                for extra in ('_v2_type', '_v2_len_var', 'row_shard'):
+                for extra in ('_v2_type', '_v2_len_var', 'row_shard',
+                              'expert_shard'):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

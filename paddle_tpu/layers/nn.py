@@ -18,7 +18,7 @@ __all__ = [
     'autoincreased_step_counter', 'nce', 'auc', 'group_norm',
     'bilinear_tensor_product', 'pad', 'relu_layer', 'maxout',
     'row_conv', 'huber_loss', 'rank_loss', 'margin_rank_loss', 'hinge_loss', 'log_loss', 'conv_shift', 'spp', 'resize_bilinear', 'resize_nearest', 'dot', 'label_smoothed_cross_entropy',
-    'lrn', 'crop', 'roi_pool', 'max_pool2d_with_index', 'unpool', 'sign', 'l1_norm', 'squared_l2_norm', 'squared_l2_distance', 'modified_huber_loss', 'precision_recall', 'positive_negative_pair', 'edit_distance',
+    'lrn', 'crop', 'roi_pool', 'max_pool2d_with_index', 'unpool', 'sign', 'l1_norm', 'squared_l2_norm', 'squared_l2_distance', 'modified_huber_loss', 'precision_recall', 'positive_negative_pair', 'edit_distance', 'switch_moe',
 ]
 
 
@@ -1052,3 +1052,65 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
                      outputs={'Out': [out], 'SequenceNum': [seq_num]},
                      attrs={'normalized': normalized})
     return out, seq_num
+
+
+def switch_moe(input, num_experts, d_inner, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Switch-style mixture-of-experts FFN (top-1 routing, capacity
+    limit, load-balancing aux loss). No reference analog — the
+    expert-parallel scaling component (mesh axis 'ep'): expert weights
+    are stacked [E, ...] and marked for expert-sharding, so under a mesh
+    with an active 'ep' axis each chip holds E/ep experts and the
+    dispatch/combine einsums become the token all-to-all over ICI
+    (ops/moe_ops.py). Returns (out, aux_loss); add
+    `aux_weight * aux_loss` (Switch uses 1e-2) to the training loss."""
+    import copy
+    from ..param_attr import ParamAttr
+    helper = LayerHelper('switch_moe', **locals())
+    dtype = input.dtype
+    d_model = input.shape[-1]
+    weight_attr = ParamAttr.to_attr(param_attr) if param_attr is not None \
+        else None
+    base = (weight_attr.name if weight_attr is not None and
+            weight_attr.name else name)
+
+    def _attr(suffix, bias=False):
+        # five distinct parameters: a shared explicit name would collide,
+        # so the attr/layer name becomes a prefix; weight attrs keep the
+        # caller's initializer/regularizer/lr fields, biases stay default
+        a = ParamAttr() if (bias or weight_attr is None) \
+            else copy.copy(weight_attr)
+        a.name = '%s_%s' % (base, suffix) if base is not None else None
+        return a
+
+    gate_w = helper.create_parameter(
+        attr=_attr('gate.w'), shape=[d_model, num_experts], dtype=dtype)
+    w1 = helper.create_parameter(
+        attr=_attr('1.w'), shape=[num_experts, d_model, d_inner],
+        dtype=dtype,
+        default_initializer=Xavier(uniform=True, fan_in=d_model,
+                                   fan_out=d_inner))
+    b1 = helper.create_parameter(attr=_attr('1.b', bias=True),
+                                 shape=[num_experts, d_inner],
+                                 dtype=dtype, is_bias=True)
+    w2 = helper.create_parameter(
+        attr=_attr('2.w'), shape=[num_experts, d_inner, d_model],
+        dtype=dtype,
+        default_initializer=Xavier(uniform=True, fan_in=d_inner,
+                                   fan_out=d_model))
+    b2 = helper.create_parameter(attr=_attr('2.b', bias=True),
+                                 shape=[num_experts, d_model],
+                                 dtype=dtype, is_bias=True)
+    for p in (w1, b1, w2, b2):
+        p.expert_shard = True  # consumed by parallel.transpiler
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    aux = helper.create_variable_for_type_inference('float32')
+    aux.shape = ()
+    helper.append_op(
+        type='switch_moe',
+        inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
+                'W2': [w2], 'B2': [b2]},
+        outputs={'Out': [out], 'AuxLoss': [aux]},
+        attrs={'capacity_factor': capacity_factor})
+    return out, aux

@@ -1,0 +1,74 @@
+"""Switch-Transformer language model: causal self-attention blocks with
+mixture-of-experts FFNs (layers.switch_moe).
+
+No reference analog (the reference predates MoE); this is the flagship
+exercise of the mesh's expert-parallel 'ep' axis — expert weights shard
+E/ep per chip and the router's dispatch/combine einsums ride ICI. Pair
+with parallel.transpile on a mesh with ep > 1 (tests/test_moe.py;
+__graft_entry__.dryrun_multichip runs one ep-sharded step).
+"""
+
+from .. import layers
+from ..initializer import Normal, NumpyArrayInitializer
+from ..param_attr import ParamAttr
+from .transformer import _multi_head_attention, position_encoding_table
+
+
+def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
+                          d_model=64, d_inner=128, num_experts=4,
+                          capacity_factor=1.25, aux_weight=1e-2,
+                          dropout_rate=0.0, max_length=512):
+    """Causal LM: feeds word [B, T] int64 and label [B, T] int64;
+    returns (avg_cost, logits). Every block: causal fused attention ->
+    residual+LN -> Switch-MoE FFN -> residual+LN; the MoE aux losses are
+    added to the CE at `aux_weight` (Switch Transformer's 1e-2)."""
+    word = layers.data(name='word', shape=[seq_len], dtype='int64')
+    label = layers.data(name='label', shape=[seq_len], dtype='int64')
+
+    emb = layers.embedding(
+        input=word, size=[vocab_size, d_model], dtype='float32',
+        param_attr=ParamAttr(name='moe_emb',
+                             initializer=Normal(0., d_model ** -0.5)))
+    pos = layers.create_parameter(
+        shape=[max_length, d_model], dtype='float32', name='moe_pos_enc',
+        attr=ParamAttr(name='moe_pos_enc',
+                       initializer=NumpyArrayInitializer(
+                           position_encoding_table(max_length, d_model)),
+                       trainable=False))
+    pos_slice = layers.reshape(
+        x=layers.slice(pos, axes=[0], starts=[0], ends=[seq_len]),
+        shape=[1, seq_len, d_model])
+    x = layers.elementwise_add(x=emb, y=pos_slice)
+
+    aux_losses = []
+    for i in range(n_layer):
+        d_head = d_model // n_head
+        proj = _multi_head_attention(
+            x, x, x, d_head, d_head, d_model, n_head, dropout_rate,
+            causal=True, name='moe_%d_slf' % i)
+        x = layers.layer_norm(
+            layers.elementwise_add(x=x, y=proj),
+            begin_norm_axis=2,
+            param_attr=ParamAttr(name='moe_%d_ln1.w' % i),
+            bias_attr=ParamAttr(name='moe_%d_ln1.b' % i))
+        ffn, aux = layers.switch_moe(
+            x, num_experts=num_experts, d_inner=d_inner,
+            capacity_factor=capacity_factor,
+            param_attr=ParamAttr(name='moe_%d_exp' % i))
+        aux_losses.append(aux)
+        x = layers.layer_norm(
+            layers.elementwise_add(x=x, y=ffn),
+            begin_norm_axis=2,
+            param_attr=ParamAttr(name='moe_%d_ln2.w' % i),
+            bias_attr=ParamAttr(name='moe_%d_ln2.b' % i))
+
+    logits = layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
+                       bias_attr=False,
+                       param_attr=ParamAttr(name='moe_out.w'))
+    lbl3 = layers.unsqueeze(label, axes=[2])
+    ce = layers.softmax_with_cross_entropy(logits=logits, label=lbl3)
+    avg_cost = layers.mean(ce)
+    for aux in aux_losses:
+        avg_cost = layers.elementwise_add(
+            x=avg_cost, y=layers.scale(aux, scale=aux_weight))
+    return avg_cost, logits

@@ -16,6 +16,7 @@ from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import transformer_ops  # noqa: F401
+from . import moe_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401

@@ -1,0 +1,92 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism.
+
+No reference analog (the reference predates MoE): this is the
+TPU-first expert-parallel component the framework's scaling story
+requires (mesh axis 'ep', parallel/mesh.py). Design follows the dense
+dispatch/combine einsum formulation (Mesh-TensorFlow / Switch
+Transformer): top-1 routing with a capacity limit, tokens over capacity
+are dropped (the surrounding residual carries them through), a
+load-balancing auxiliary loss keeps routing uniform. Under a mesh whose
+'ep' axis is active the [E, ...] expert tensors are sharding-constrained
+onto 'ep', so GSPMD turns the dispatch/combine einsums into the
+all-to-all token exchange over ICI.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+def switch_moe_reference(x2, gate_w, w1, b1, w2, b2, capacity):
+    """Dense-dispatch Switch MoE on flattened tokens x2 [S, D].
+    Returns (out [S, D], aux_loss scalar, expert_index [S]).
+    Pure function reused by the op lowering and tests."""
+    s, d = x2.shape
+    e = gate_w.shape[-1]
+    logits = (x2 @ gate_w).astype(jnp.float32)          # router in fp32
+    probs = jax.nn.softmax(logits, axis=-1)             # [S, E]
+    expert = jnp.argmax(probs, axis=-1)                 # [S]
+    gate = jnp.max(probs, axis=-1)                      # [S]
+
+    mask = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [S, E]
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask       # position in expert
+    keep = mask * (pos < capacity)
+    # dispatch[s, e, c] = 1 iff token s occupies slot c of expert e
+    dispatch = keep[:, :, None] * \
+        jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                       dtype=jnp.float32)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+
+    dtype = x2.dtype
+    expert_in = jnp.einsum('sec,sd->ecd', dispatch.astype(dtype), x2)
+    h = jax.nn.relu(jnp.einsum('ecd,edh->ech', expert_in, w1)
+                    + b1[:, None, :])
+    expert_out = jnp.einsum('ech,ehd->ecd', h, w2) + b2[:, None, :]
+    out = jnp.einsum('sec,ecd->sd', combine.astype(dtype), expert_out)
+
+    # Switch load-balancing loss: E * sum_e f_e * P_e
+    frac = jnp.mean(mask, axis=0)                       # tokens per expert
+    prob = jnp.mean(probs, axis=0)                      # mean router prob
+    aux = e * jnp.sum(frac * prob)
+    return out, aux, expert
+
+
+@register('switch_moe')
+def _switch_moe(ctx):
+    x = ctx.input('X')                                  # [B, T, D] or [S, D]
+    gate_w = ctx.env[ctx.op.input('GateW')]             # router stays fp32
+    w1 = ctx.input('W1')                                # [E, D, H]
+    b1 = ctx.input('B1')
+    w2 = ctx.input('W2')                                # [E, H, D]
+    b2 = ctx.input('B2')
+    cap_factor = ctx.attr('capacity_factor', 1.25)
+    if ctx.amp == 'bf16':
+        x = x.astype(jnp.bfloat16)
+        w1, b1 = w1.astype(jnp.bfloat16), b1.astype(jnp.bfloat16)
+        w2, b2 = w2.astype(jnp.bfloat16), b2.astype(jnp.bfloat16)
+
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    s = x2.shape[0]
+    e = gate_w.shape[-1]
+    capacity = max(1, int(cap_factor * s / e + 0.999999))
+
+    mesh = getattr(ctx.block.program, 'mesh', None)
+    ep = dict(mesh.shape).get('ep', 1) if mesh is not None else 1
+
+    if ep > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def c(v, spec):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec))
+        w1 = c(w1, P('ep'))
+        w2 = c(w2, P('ep'))
+        b1 = c(b1, P('ep'))
+        b2 = c(b2, P('ep'))
+
+    out2, aux, _ = switch_moe_reference(x2, gate_w, w1, b1, w2, b2,
+                                        capacity)
+    ctx.set_output('Out', out2.reshape(shape))
+    ctx.set_output('AuxLoss', aux)

@@ -109,6 +109,16 @@ def _row_shard_spec_for(param, mesh):
     return P(*([axis] + [None] * (len(param.shape) - 1)))
 
 
+def _expert_shard_spec_for(param, mesh):
+    """[E, ...] expert-stacked weights (layers.switch_moe) shard their
+    leading expert axis over 'ep' — each chip holds E/ep experts."""
+    if not getattr(param, 'expert_shard', False):
+        return None
+    if dict(mesh.shape).get('ep', 1) <= 1:
+        return None
+    return P(*(['ep'] + [None] * (len(param.shape) - 1)))
+
+
 def transpile(program, mesh, strategy=None):
     """Attach shardings for `mesh` to `program` in place; returns program."""
     strategy = strategy or ParallelStrategy()
@@ -127,6 +137,8 @@ def transpile(program, mesh, strategy=None):
             if strategy.tensor_parallel:
                 spec = _tp_spec_for(var, strategy.tp_rules) \
                     if strategy.tp_rules else auto_tp.get(var.name)
+            if spec is None:
+                spec = _expert_shard_spec_for(var, mesh)
             if spec is None and strategy.shard_embeddings:
                 spec = _row_shard_spec_for(var, mesh)
             shardings[var.name] = spec if spec is not None else P()

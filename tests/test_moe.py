@@ -1,0 +1,117 @@
+"""Switch-MoE: routing semantics, e2e training, and expert-parallel
+sharding on the 8-virtual-device CPU mesh (mesh axis 'ep')."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
+
+
+def _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, capacity):
+    """Independent numpy re-derivation of the Switch dispatch."""
+    s, d = x2.shape
+    e = gate_w.shape[-1]
+    logits = x2 @ gate_w
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    expert = p.argmax(-1)
+    gate = p.max(-1)
+    out = np.zeros_like(x2)
+    count = np.zeros(e, np.int64)
+    for si in range(s):                      # sequential capacity filling
+        ei = expert[si]
+        if count[ei] >= capacity:
+            continue                         # dropped token -> zero output
+        count[ei] += 1
+        h = np.maximum(x2[si] @ w1[ei] + b1[ei], 0.0)
+        out[si] = gate[si] * (h @ w2[ei] + b2[ei])
+    frac = np.eye(e)[expert].mean(0)
+    aux = e * float((frac * p.mean(0)).sum())
+    return out, aux
+
+
+def test_switch_moe_matches_numpy_reference():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.moe_ops import switch_moe_reference
+    rng = np.random.RandomState(0)
+    s, d, e, h, cap = 16, 8, 4, 12, 3    # capacity binds for some experts
+    x2 = rng.randn(s, d).astype('float32')
+    gate_w = rng.randn(d, e).astype('float32')
+    w1 = rng.randn(e, d, h).astype('float32') * 0.3
+    b1 = rng.randn(e, h).astype('float32') * 0.1
+    w2 = rng.randn(e, h, d).astype('float32') * 0.3
+    b2 = rng.randn(e, d).astype('float32') * 0.1
+    got, aux, _ = switch_moe_reference(
+        jnp.asarray(x2), jnp.asarray(gate_w), jnp.asarray(w1),
+        jnp.asarray(b1), jnp.asarray(w2), jnp.asarray(b2), cap)
+    want, aux_want = _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, cap)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(aux), aux_want, rtol=1e-5)
+
+
+def _train_moe_lm(mesh=None, steps=5, seed=0, num_experts=4):
+    from paddle_tpu.models.moe import switch_transformer_lm
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    vocab, seq = 32, 8
+    avg, _ = switch_transformer_lm(vocab, seq, n_layer=2, n_head=2,
+                                   d_model=16, d_inner=32,
+                                   num_experts=num_experts)
+    fluid.default_main_program().random_seed = 7
+    fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg)
+    if mesh is not None:
+        transpile(fluid.default_main_program(), mesh,
+                  ParallelStrategy(data_parallel=True))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(seed)
+    words = rng.randint(1, vocab, (8, seq)).astype('int64')
+    labels = np.roll(words, -1, axis=1)
+    losses = []
+    for _ in range(steps):
+        losses.append(float(np.asarray(exe.run(
+            feed={'word': words, 'label': labels},
+            fetch_list=[avg])[0]).reshape(())))
+    return losses
+
+
+def test_moe_lm_trains():
+    losses = _train_moe_lm(steps=8)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_expert_parallel_matches_unsharded():
+    """dp=2 x ep=4 sharded run follows the unsharded trajectory: expert
+    weights [E, ...] shard E/ep per device, routing/dispatch numerics
+    unchanged (GSPMD exchanges tokens, never reroutes them)."""
+    base = _train_moe_lm(mesh=None)
+    mesh = make_mesh(dp=2, ep=4)
+    ep = _train_moe_lm(mesh=mesh)
+    np.testing.assert_allclose(ep, base, rtol=2e-4, atol=1e-5)
+
+
+def test_moe_params_marked_and_sharded():
+    from paddle_tpu.models.moe import switch_transformer_lm
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    avg, _ = switch_transformer_lm(32, 8, n_layer=1, n_head=2,
+                                   d_model=16, d_inner=32, num_experts=4)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    mesh = make_mesh(dp=2, ep=4)
+    prog = transpile(fluid.default_main_program(), mesh,
+                     ParallelStrategy(data_parallel=True))
+    expert_params = [v for v in prog.list_vars()
+                     if getattr(v, 'expert_shard', False)]
+    assert len(expert_params) == 4, [v.name for v in expert_params]
+    for v in expert_params:
+        spec = prog.var_shardings[v.name]
+        assert tuple(spec)[0] == 'ep', (v.name, spec)
+    # the router gate stays replicated
+    gates = [v for v in prog.list_vars() if v.name.endswith('gate.w')]
+    assert gates and all(
+        tuple(prog.var_shardings[g.name]) in ((), (None,) * 2)
+        for g in gates)
