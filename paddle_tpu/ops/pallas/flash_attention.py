@@ -8,23 +8,35 @@ the MXU back-to-back without an HBM round-trip.
 
 Design follows the standard flash-attention-v2 recurrence (running max m,
 running denominator l, rescaled accumulator); written against the Pallas
-TPU API per /opt/skills/guides/pallas_guide.md. The backward pass uses a
-rematerializing XLA recompute (custom_vjp) — a Pallas backward kernel is a
-planned optimization.
+TPU API per /opt/skills/guides/pallas_guide.md. The backward pass is the
+FA2 two-kernel recompute form (dK/dV kernel accumulating over query
+blocks, dQ kernel accumulating over key blocks) driven by the forward's
+saved logsumexp; PADDLE_TPU_PALLAS_BWD=0 falls back to a rematerializing
+XLA recompute. PADDLE_TPU_PALLAS_INTERPRET=1 runs the kernels in
+interpret mode (CPU test parity, tests/test_pallas_kernels.py).
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+
+from . import interpret_mode
 
 DEFAULT_BLOCK_Q = 512
 BLOCK_K = 128  # = one lane tile; keeps m/l lane-replication trivial
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                sm_scale, causal, block_q, block_k, num_k_blocks):
+def _pallas_bwd():
+    return os.environ.get('PADDLE_TPU_PALLAS_BWD', '1') not in ('0',
+                                                                'false')
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, sm_scale, causal, block_q, block_k,
+                num_k_blocks):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -77,9 +89,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         denom = l_scr[:][:, :1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:][:, 0] + jnp.log(denom[:, 0])
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q):
+    """Returns (out [B,H,Tq,D], lse [B*H, Tq]) — lse feeds the backward."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -99,7 +113,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q):
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_k=block_k, num_k_blocks=num_k_blocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -107,9 +121,14 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -117,8 +136,177 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret_mode(),
     )(qr, kr, vr)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse
+
+
+def _bwd_tile(q, k, v, do, lse, delta, qi, ki, *, sm_scale, causal,
+              block_q, block_k):
+    """Shared [bq, bk] tile math of the FA2 backward: recompute p from
+    the saved logsumexp, then ds = p * (dp - delta) * scale."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale          # [bq, bk]
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jnp.exp(s - lse.reshape(block_q, 1))                    # [bq, bk]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                     # [bq, bk]
+    ds = p * (dp - delta.reshape(block_q, 1)) * sm_scale
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k, num_q_blocks):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True if not causal else \
+        (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_tile(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                          sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, d]
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k,
+                   num_k_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True if not causal else \
+        (qi * block_q + block_q - 1) >= (ki * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_tile(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                          sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bq, d]
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal, sm_scale, block_q):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(BLOCK_K, tk)
+    num_q_blocks = tq // block_q
+    num_k_blocks = tk // block_k
+
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    dor = g.reshape(b * h, tq, d)
+    # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(dor.astype(jnp.float32) *
+                    o.reshape(b * h, tq, d).astype(jnp.float32), axis=-1)
+
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_q_blocks=num_q_blocks),
+        grid=(b * h, num_k_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret_mode(),
+    )(qr, kr, vr, dor, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_k_blocks=num_k_blocks),
+        grid=(b * h, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret_mode(),
+    )(qr, kr, vr, dor, lse, delta)
+
+    shape = (b, h, tq, d)
+    return (dq.reshape(shape), dkv[0].reshape(b, h, tk, d),
+            dkv[1].reshape(b, h, tk, d))
 
 
 def _reference(q, k, v, causal, sm_scale):
@@ -136,17 +324,21 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q):
     """q,k,v: [B, H, T, D]. Returns [B, H, Tq, D]."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    return _flash_fwd(q, k, v, causal, scale, block_q)
+    return _flash_fwd(q, k, v, causal, scale, block_q)[0]
 
 
 def _vjp_fwd(q, k, v, causal, sm_scale, block_q):
-    return flash_attention(q, k, v, causal, sm_scale, block_q), (q, k, v)
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, sm_scale, block_q, res, g):
-    # Rematerialized XLA backward; the forward stays flash.
-    q, k, v = res
+    q, k, v, o, lse = res
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if _pallas_bwd():
+        return _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q)
+    # Rematerialized XLA backward (PADDLE_TPU_PALLAS_BWD=0).
     _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal,
                                                    scale), q, k, v)
     return vjp(g)
