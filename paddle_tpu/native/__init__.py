@@ -50,6 +50,36 @@ def _build_lib():
     return build_native('recordio')
 
 
+_STAGING = None
+
+
+def load_staging():
+    """Compile (if needed) and load the host staging ring
+    (staging.cpp); thread-safe."""
+    global _STAGING
+    with _LOCK:
+        if _STAGING is not None:
+            return _STAGING
+        lib = ctypes.CDLL(build_native('staging'))
+        lib.staging_open.restype = ctypes.c_void_p
+        lib.staging_open.argtypes = [ctypes.c_uint64, ctypes.c_int]
+        lib.staging_capacity.restype = ctypes.c_uint64
+        lib.staging_capacity.argtypes = [ctypes.c_void_p]
+        lib.staging_acquire_fill.restype = ctypes.c_void_p
+        lib.staging_acquire_fill.argtypes = [ctypes.c_void_p]
+        lib.staging_commit.restype = ctypes.c_int
+        lib.staging_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.staging_acquire_read.restype = ctypes.c_void_p
+        lib.staging_acquire_read.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.staging_release.restype = ctypes.c_int
+        lib.staging_release.argtypes = [ctypes.c_void_p]
+        lib.staging_close_ring.argtypes = [ctypes.c_void_p]
+        lib.staging_free.argtypes = [ctypes.c_void_p]
+        _STAGING = lib
+        return lib
+
+
 def python_embed_flags():
     """g++ flags to embed the CPython interpreter (for capi.cpp)."""
     out = subprocess.run(

@@ -30,8 +30,8 @@ _NEG_INF = -1e30
 
 
 def _pallas_bwd():
-    return os.environ.get('PADDLE_TPU_PALLAS_BWD', '1') not in ('0',
-                                                                'false')
+    return os.environ.get('PADDLE_TPU_PALLAS_BWD', '1') not in (
+        '0', 'false', 'False')
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,

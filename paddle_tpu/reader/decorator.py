@@ -176,6 +176,23 @@ def batch(reader, batch_size, drop_last=True):
     return batch_reader
 
 
+def resolve_device(place):
+    """paddle place / jax device / None -> jax device (None = default)."""
+    import jax
+    if place is None:
+        return None
+    if hasattr(place, 'device_id'):  # a paddle_tpu Place
+        return jax.devices()[place.device_id]
+    return place
+
+
+def feed_normalizer(first, feed_names):
+    """Returns item -> feed-dict fn for readers yielding dicts or tuples."""
+    if feed_names is not None and not isinstance(first, dict):
+        return lambda item: dict(zip(feed_names, item))
+    return lambda item: item
+
+
 def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
     """Overlap host->HBM transfer with compute: device_put the next
     batch(es) while the current one trains (the flax prefetch pattern —
@@ -187,20 +204,17 @@ def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
     """
     import jax
 
-    device = None
-    if place is not None:
-        if hasattr(place, 'device_id'):  # a paddle_tpu Place
-            device = jax.devices()[place.device_id]
-        else:
-            device = place
+    device = resolve_device(place)
 
     def device_reader():
         import collections
         queue = collections.deque()
+        norm = [None]
 
         def put(item):
-            if feed_names is not None and not isinstance(item, dict):
-                item = dict(zip(feed_names, item))
+            if norm[0] is None:
+                norm[0] = feed_normalizer(item, feed_names)
+            item = norm[0](item)
             queue.append({k: jax.device_put(v, device)
                           for k, v in item.items()})
 

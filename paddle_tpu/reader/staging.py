@@ -1,0 +1,145 @@
+"""Host staging feeder: native aligned-buffer ring + superbatch packing.
+
+Reference analog: the pinned-memory double buffering of the reference's
+DataProvider (paddle/fluid/memory pinned allocations). TPU-native shape:
+a background thread packs `steps` consecutive batches CONTIGUOUSLY into
+one page-aligned C++ staging buffer (native/staging.cpp) while the
+current window trains; the consumer wraps the buffer zero-copy with
+np.frombuffer and issues ONE jax.device_put per feed per window. Pairs
+with Executor.run_steps(stacked_feed=True): one dispatch and one h2d
+transfer per `steps` training steps.
+"""
+
+import ctypes
+
+import numpy as np
+
+__all__ = ['staged_superbatch']
+
+
+def _load():
+    from ..native import load_staging
+    return load_staging()
+
+
+def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
+                      place=None):
+    """Wrap `reader` (yielding per-step feed dicts, or tuples zipped with
+    feed_names) into a generator of device-resident superbatch dicts:
+    every yielded value maps name -> jax.Array of shape [steps, *batch]
+    for Executor.run_steps(steps, feed=..., stacked_feed=True).
+    Trailing batches that do not fill a window are dropped (static
+    shapes; same stance as reader.batch(drop_last=True))."""
+    import jax
+    import queue as _q
+    import threading
+
+    from .decorator import feed_normalizer, resolve_device
+
+    device = resolve_device(place)
+
+    def gen():
+        lib = _load()
+        it = iter(reader())
+        try:
+            first = next(it)
+        except StopIteration:
+            return
+        norm = feed_normalizer(first, feed_names)
+        first = norm(first)
+        names = sorted(first)
+        specs = {n: (np.asarray(first[n]).shape,
+                     np.asarray(first[n]).dtype) for n in names}
+        sizes = {n: int(np.prod(specs[n][0])) * specs[n][1].itemsize
+                 for n in names}
+        # each field's region starts page-aligned within the slot so
+        # every per-field h2d copy stays on the aligned-DMA path
+        align = 4096
+        offs, total = {}, 0
+        for n in names:
+            offs[n] = total
+            total += -(-(sizes[n] * steps) // align) * align
+
+        ring = lib.staging_open(total, n_buffers)
+        if not ring:
+            raise MemoryError('staging_open failed (%d bytes x %d)'
+                              % (total, n_buffers))
+        err = _q.Queue()
+
+        def produce():
+            try:
+                batches, stream = [first], it
+                for item in stream:
+                    batches.append(norm(item))
+                    if len(batches) < steps:
+                        continue
+                    buf = lib.staging_acquire_fill(ring)
+                    if not buf:
+                        return  # consumer closed the ring early
+                    for n in names:
+                        shape, dtype = specs[n]
+                        for i, b in enumerate(batches):
+                            arr = np.ascontiguousarray(
+                                np.asarray(b[n], dtype=dtype))
+                            if arr.shape != shape:
+                                raise ValueError(
+                                    'staged_superbatch: feed %r shape %s '
+                                    '!= first batch %s' %
+                                    (n, arr.shape, shape))
+                            ctypes.memmove(buf + offs[n] + i * sizes[n],
+                                           arr.ctypes.data, sizes[n])
+                    if lib.staging_commit(ring, total):
+                        raise RuntimeError('staging_commit failed')
+                    batches = []
+            except Exception as e:  # surfaced on the consumer side
+                err.put(e)
+            finally:
+                lib.staging_close_ring(ring)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                out_len = ctypes.c_uint64()
+                buf = lib.staging_acquire_read(ring,
+                                               ctypes.byref(out_len))
+                if not buf:
+                    if not err.empty():
+                        raise err.get()
+                    return
+                raw = ctypes.cast(
+                    ctypes.c_void_p(buf),
+                    ctypes.POINTER(ctypes.c_uint8 * out_len.value))
+                target = device if device is not None else jax.devices()[0]
+                window = {}
+                for n in names:
+                    shape, dtype = specs[n]
+                    flat = np.frombuffer(
+                        raw.contents, dtype=dtype,
+                        count=steps * int(np.prod(shape)),
+                        offset=offs[n])
+                    arr = flat.reshape((steps,) + shape)
+                    if target.platform == 'cpu':
+                        # CPU jax zero-copies aligned host arrays — the
+                        # "device" array would alias the reusable slot
+                        arr = arr.copy()
+                    window[n] = jax.device_put(arr, target)
+                # the h2d copy must finish before the slot is reused
+                for v in window.values():
+                    v.block_until_ready()
+                if lib.staging_release(ring):
+                    raise RuntimeError('staging_release failed')
+                yield window
+        finally:
+            lib.staging_close_ring(ring)
+            t.join(timeout=5.0)
+            if t.is_alive():
+                # producer is stuck inside the user reader; freeing now
+                # would hand it a dangling ring -> leak the ring instead
+                import warnings
+                warnings.warn('staged_superbatch: producer thread did not '
+                              'exit; leaking one staging ring')
+            else:
+                lib.staging_free(ring)
+
+    return gen

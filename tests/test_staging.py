@@ -1,0 +1,114 @@
+"""Host staging ring (native/staging.cpp) + staged_superbatch feeder."""
+
+import ctypes
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.native import load_staging
+from paddle_tpu.reader.staging import staged_superbatch
+
+
+def test_ring_roundtrip_ordering():
+    lib = load_staging()
+    ring = lib.staging_open(1 << 12, 3)
+    assert ring
+    payloads = [bytes([i] * 100 + [255 - i]) for i in range(7)]
+
+    def produce():
+        for p in payloads:
+            buf = lib.staging_acquire_fill(ring)
+            assert buf
+            ctypes.memmove(buf, p, len(p))
+            assert lib.staging_commit(ring, len(p)) == 0
+        lib.staging_close_ring(ring)
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = []
+    while True:
+        n = ctypes.c_uint64()
+        buf = lib.staging_acquire_read(ring, ctypes.byref(n))
+        if not buf:
+            break
+        got.append(ctypes.string_at(buf, n.value))
+        assert lib.staging_release(ring) == 0
+    t.join()
+    lib.staging_free(ring)
+    assert got == payloads  # FIFO, bytes intact, no tearing
+
+
+def test_ring_misuse_returns_error():
+    lib = load_staging()
+    assert not lib.staging_open(0, 3)       # zero capacity
+    assert not lib.staging_open(1024, 1)    # fewer than 2 buffers
+    ring = lib.staging_open(1024, 2)
+    assert lib.staging_commit(ring, 10) == -1   # commit without fill
+    assert lib.staging_release(ring) == -1      # release without read
+    buf = lib.staging_acquire_fill(ring)
+    assert lib.staging_commit(ring, 4096) == -1  # over capacity
+    lib.staging_close_ring(ring)
+    lib.staging_free(ring)
+
+
+def _batches(n, batch=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'x': rng.randn(batch, 8).astype('f'),
+             'y': rng.randn(batch, 1).astype('f')} for _ in range(n)]
+
+
+def test_staged_superbatch_windows_match_stack():
+    data = _batches(7)          # 7 batches, steps=3 -> 2 windows, 1 dropped
+
+    def reader():
+        return iter(data)
+
+    windows = list(staged_superbatch(reader, steps=3)())
+    assert len(windows) == 2
+    for w, start in zip(windows, (0, 3)):
+        for nme in ('x', 'y'):
+            want = np.stack([data[start + i][nme] for i in range(3)])
+            np.testing.assert_array_equal(np.asarray(w[nme]), want)
+
+
+def test_staged_superbatch_feeds_run_steps():
+    """Windows drive Executor.run_steps(stacked_feed=True) to the same
+    trajectory as feeding the batches one Executor.run at a time."""
+    data = _batches(6, seed=3)
+
+    def build():
+        fluid.reset_default_programs()
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return cost, exe
+
+    with fluid.scope_guard(fluid.Scope()):
+        cost, exe = build()
+        single = [float(np.asarray(exe.run(
+            feed=b, fetch_list=[cost])[0]).reshape(())) for b in data]
+    with fluid.scope_guard(fluid.Scope()):
+        cost, exe = build()
+        staged = []
+        for window in staged_superbatch(lambda: iter(data), steps=3)():
+            staged.extend(np.asarray(exe.run_steps(
+                3, feed=window, fetch_list=[cost],
+                stacked_feed=True)[0]).reshape(-1).tolist())
+    np.testing.assert_allclose(staged, single, rtol=1e-5, atol=1e-6)
+
+
+def test_staged_superbatch_mismatched_shape_raises():
+    data = _batches(3)
+    data[2]['x'] = np.zeros((5, 8), 'f')    # batch-size drift mid-stream
+
+    def reader():
+        return iter(data)
+
+    import pytest
+    with pytest.raises(ValueError, match='shape'):
+        list(staged_superbatch(reader, steps=3)())
