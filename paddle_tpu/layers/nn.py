@@ -1055,17 +1055,22 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
 
 
 def switch_moe(input, num_experts, d_inner, capacity_factor=1.25,
-               param_attr=None, name=None):
-    """Switch-style mixture-of-experts FFN (top-1 routing, capacity
-    limit, load-balancing aux loss). No reference analog — the
-    expert-parallel scaling component (mesh axis 'ep'): expert weights
-    are stacked [E, ...] and marked for expert-sharding, so under a mesh
-    with an active 'ep' axis each chip holds E/ep experts and the
-    dispatch/combine einsums become the token all-to-all over ICI
-    (ops/moe_ops.py). Returns (out, aux_loss); add
-    `aux_weight * aux_loss` (Switch uses 1e-2) to the training loss."""
+               top_k=1, param_attr=None, name=None):
+    """Mixture-of-experts FFN (capacity limit, load-balancing aux
+    loss): top_k=1 is Switch routing (raw router prob as the gate),
+    top_k>=2 is GShard-style with renormalized gates and choice-major
+    capacity filling. No reference analog — the expert-parallel scaling
+    component (mesh axis 'ep'): expert weights are stacked [E, ...] and
+    marked for expert-sharding, so under a mesh with an active 'ep'
+    axis each chip holds E/ep experts and the dispatch/combine einsums
+    become the token all-to-all over ICI (ops/moe_ops.py). Returns
+    (out, aux_loss); add `aux_weight * aux_loss` (Switch uses 1e-2) to
+    the training loss."""
     import copy
     from ..param_attr import ParamAttr
+    if not 1 <= top_k <= num_experts:
+        raise ValueError('switch_moe: top_k=%d must be in [1, '
+                         'num_experts=%d]' % (top_k, num_experts))
     helper = LayerHelper('switch_moe', **locals())
     dtype = input.dtype
     d_model = input.shape[-1]
@@ -1112,5 +1117,5 @@ def switch_moe(input, num_experts, d_inner, capacity_factor=1.25,
         inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
                 'W2': [w2], 'B2': [b2]},
         outputs={'Out': [out], 'AuxLoss': [aux]},
-        attrs={'capacity_factor': capacity_factor})
+        attrs={'capacity_factor': capacity_factor, 'top_k': top_k})
     return out, aux

@@ -16,7 +16,7 @@ from .transformer import _multi_head_attention, position_encoding_table
 
 def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
                           d_model=64, d_inner=128, num_experts=4,
-                          capacity_factor=1.25, aux_weight=1e-2,
+                          capacity_factor=1.25, top_k=1, aux_weight=1e-2,
                           dropout_rate=0.0, max_length=512):
     """Causal LM: feeds word [B, T] int64 and label [B, T] int64;
     returns (avg_cost, logits). Every block: causal fused attention ->
@@ -53,7 +53,7 @@ def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
             bias_attr=ParamAttr(name='moe_%d_ln1.b' % i))
         ffn, aux = layers.switch_moe(
             x, num_experts=num_experts, d_inner=d_inner,
-            capacity_factor=capacity_factor,
+            capacity_factor=capacity_factor, top_k=top_k,
             param_attr=ParamAttr(name='moe_%d_exp' % i))
         aux_losses.append(aux)
         x = layers.layer_norm(

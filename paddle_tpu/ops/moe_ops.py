@@ -18,25 +18,41 @@ import jax.numpy as jnp
 from ..core.registry import register
 
 
-def switch_moe_reference(x2, gate_w, w1, b1, w2, b2, capacity):
-    """Dense-dispatch Switch MoE on flattened tokens x2 [S, D].
-    Returns (out [S, D], aux_loss scalar, expert_index [S]).
-    Pure function reused by the op lowering and tests."""
+def switch_moe_reference(x2, gate_w, w1, b1, w2, b2, capacity, k=1):
+    """Dense-dispatch MoE on flattened tokens x2 [S, D].
+    Returns (out [S, D], aux_loss scalar, expert_index [S, k]).
+    Pure function reused by the op lowering and tests.
+
+    k=1 is Switch routing (gate = raw router prob of the argmax
+    expert); k>=2 is GShard-style top-k with the selected gates
+    renormalized to sum to 1. Capacity fills choice-major: all
+    first-choice tokens claim slots before any second-choice token
+    (the GShard convention), and over-capacity assignments drop."""
     s, d = x2.shape
     e = gate_w.shape[-1]
     logits = (x2 @ gate_w).astype(jnp.float32)          # router in fp32
     probs = jax.nn.softmax(logits, axis=-1)             # [S, E]
-    expert = jnp.argmax(probs, axis=-1)                 # [S]
-    gate = jnp.max(probs, axis=-1)                      # [S]
+    top_gates, top_idx = jax.lax.top_k(probs, k)        # [S, k]
+    if k > 1:
+        top_gates = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
 
-    mask = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [S, E]
-    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask       # position in expert
-    keep = mask * (pos < capacity)
-    # dispatch[s, e, c] = 1 iff token s occupies slot c of expert e
-    dispatch = keep[:, :, None] * \
-        jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
-                       dtype=jnp.float32)[:, None, :]
-    combine = dispatch * gate[:, None, None]
+    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)          # slots used so far
+    first_mask = None
+    for j in range(k):
+        mask = jax.nn.one_hot(top_idx[:, j], e, dtype=jnp.float32)
+        if first_mask is None:
+            first_mask = mask
+        pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask + counts[None] * mask
+        keep = mask * (pos < capacity)
+        # dispatch[s, e, c] = 1 iff token s occupies slot c of expert e
+        disp = keep[:, :, None] * \
+            jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                           dtype=jnp.float32)[:, None, :]
+        dispatch = dispatch + disp
+        combine = combine + disp * top_gates[:, j][:, None, None]
+        counts = counts + jnp.sum(mask, axis=0)
 
     dtype = x2.dtype
     expert_in = jnp.einsum('sec,sd->ecd', dispatch.astype(dtype), x2)
@@ -45,11 +61,11 @@ def switch_moe_reference(x2, gate_w, w1, b1, w2, b2, capacity):
     expert_out = jnp.einsum('ech,ehd->ecd', h, w2) + b2[:, None, :]
     out = jnp.einsum('sec,ecd->sd', combine.astype(dtype), expert_out)
 
-    # Switch load-balancing loss: E * sum_e f_e * P_e
-    frac = jnp.mean(mask, axis=0)                       # tokens per expert
-    prob = jnp.mean(probs, axis=0)                      # mean router prob
+    # load-balancing loss over FIRST choices: E * sum_e f_e * P_e
+    frac = jnp.mean(first_mask, axis=0)            # tokens per expert
+    prob = jnp.mean(probs, axis=0)                 # mean router prob
     aux = e * jnp.sum(frac * prob)
-    return out, aux, expert
+    return out, aux, top_idx
 
 
 @register('switch_moe')
@@ -61,6 +77,7 @@ def _switch_moe(ctx):
     w2 = ctx.input('W2')                                # [E, H, D]
     b2 = ctx.input('B2')
     cap_factor = ctx.attr('capacity_factor', 1.25)
+    k = ctx.attr('top_k', 1)
     if ctx.amp == 'bf16':
         x = x.astype(jnp.bfloat16)
         w1, b1 = w1.astype(jnp.bfloat16), b1.astype(jnp.bfloat16)
@@ -70,7 +87,7 @@ def _switch_moe(ctx):
     x2 = x.reshape(-1, shape[-1])
     s = x2.shape[0]
     e = gate_w.shape[-1]
-    capacity = max(1, int(cap_factor * s / e + 0.999999))
+    capacity = max(1, int(cap_factor * k * s / e + 0.999999))
 
     mesh = getattr(ctx.block.program, 'mesh', None)
     ep = dict(mesh.shape).get('ep', 1) if mesh is not None else 1
@@ -87,6 +104,6 @@ def _switch_moe(ctx):
         b2 = c(b2, P('ep'))
 
     out2, aux, _ = switch_moe_reference(x2, gate_w, w1, b1, w2, b2,
-                                        capacity)
+                                        capacity, k=k)
     ctx.set_output('Out', out2.reshape(shape))
     ctx.set_output('AuxLoss', aux)

@@ -9,30 +9,36 @@ from paddle_tpu.parallel.mesh import make_mesh
 from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
 
 
-def _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, capacity):
-    """Independent numpy re-derivation of the Switch dispatch."""
+def _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, capacity, k=1):
+    """Independent numpy re-derivation of the top-k dispatch: choice-
+    major capacity filling (all first choices claim slots first),
+    gates renormalized for k>=2, dropped assignments contribute zero."""
     s, d = x2.shape
     e = gate_w.shape[-1]
     logits = x2 @ gate_w
     p = np.exp(logits - logits.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
-    expert = p.argmax(-1)
-    gate = p.max(-1)
+    top_idx = np.argsort(-p, axis=-1)[:, :k]             # [S, k]
+    top_gates = np.take_along_axis(p, top_idx, axis=-1)
+    if k > 1:
+        top_gates = top_gates / top_gates.sum(-1, keepdims=True)
     out = np.zeros_like(x2)
     count = np.zeros(e, np.int64)
-    for si in range(s):                      # sequential capacity filling
-        ei = expert[si]
-        if count[ei] >= capacity:
-            continue                         # dropped token -> zero output
-        count[ei] += 1
-        h = np.maximum(x2[si] @ w1[ei] + b1[ei], 0.0)
-        out[si] = gate[si] * (h @ w2[ei] + b2[ei])
-    frac = np.eye(e)[expert].mean(0)
+    for j in range(k):                       # choice-major
+        for si in range(s):                  # sequential capacity filling
+            ei = top_idx[si, j]
+            if count[ei] >= capacity:
+                continue                     # dropped -> zero contribution
+            count[ei] += 1
+            h = np.maximum(x2[si] @ w1[ei] + b1[ei], 0.0)
+            out[si] += top_gates[si, j] * (h @ w2[ei] + b2[ei])
+    frac = np.eye(e)[top_idx[:, 0]].mean(0)
     aux = e * float((frac * p.mean(0)).sum())
     return out, aux
 
 
-def test_switch_moe_matches_numpy_reference():
+@pytest.mark.parametrize('k', [1, 2])
+def test_switch_moe_matches_numpy_reference(k):
     import jax.numpy as jnp
     from paddle_tpu.ops.moe_ops import switch_moe_reference
     rng = np.random.RandomState(0)
@@ -45,21 +51,22 @@ def test_switch_moe_matches_numpy_reference():
     b2 = rng.randn(e, d).astype('float32') * 0.1
     got, aux, _ = switch_moe_reference(
         jnp.asarray(x2), jnp.asarray(gate_w), jnp.asarray(w1),
-        jnp.asarray(b1), jnp.asarray(w2), jnp.asarray(b2), cap)
-    want, aux_want = _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, cap)
+        jnp.asarray(b1), jnp.asarray(w2), jnp.asarray(b2), cap, k=k)
+    want, aux_want = _numpy_switch_moe(x2, gate_w, w1, b1, w2, b2, cap,
+                                       k=k)
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
                                atol=1e-5)
     np.testing.assert_allclose(float(aux), aux_want, rtol=1e-5)
 
 
-def _train_moe_lm(mesh=None, steps=5, seed=0, num_experts=4):
+def _train_moe_lm(mesh=None, steps=5, seed=0, num_experts=4, top_k=1):
     from paddle_tpu.models.moe import switch_transformer_lm
     fluid.reset_default_programs()
     fluid.global_scope().clear()
     vocab, seq = 32, 8
     avg, _ = switch_transformer_lm(vocab, seq, n_layer=2, n_head=2,
                                    d_model=16, d_inner=32,
-                                   num_experts=num_experts)
+                                   num_experts=num_experts, top_k=top_k)
     fluid.default_main_program().random_seed = 7
     fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg)
     if mesh is not None:
@@ -84,13 +91,14 @@ def test_moe_lm_trains():
     assert losses[-1] < losses[0], losses
 
 
-def test_moe_expert_parallel_matches_unsharded():
+@pytest.mark.parametrize('top_k', [1, 2])
+def test_moe_expert_parallel_matches_unsharded(top_k):
     """dp=2 x ep=4 sharded run follows the unsharded trajectory: expert
     weights [E, ...] shard E/ep per device, routing/dispatch numerics
     unchanged (GSPMD exchanges tokens, never reroutes them)."""
-    base = _train_moe_lm(mesh=None)
+    base = _train_moe_lm(mesh=None, top_k=top_k)
     mesh = make_mesh(dp=2, ep=4)
-    ep = _train_moe_lm(mesh=mesh)
+    ep = _train_moe_lm(mesh=mesh, top_k=top_k)
     np.testing.assert_allclose(ep, base, rtol=2e-4, atol=1e-5)
 
 
