@@ -264,15 +264,16 @@ def main():
         errors['resnet50'] = err
         sys.stderr.write('bench: resnet50 failed: %s\n' % err)
 
-    # Ablations (SURVEY §5 / VERDICT r2 #5-6): NHWC conv layout, the
-    # Pallas opt-in delta, the rbg PRNG delta, plus on-chip kernel
-    # parity. Skipped on a degraded relay — the budget belongs to the
-    # headline numbers then — and stopped once the total wall budget is
-    # spent (a hanging relay must not starve the JSON line).
-    budget = float(os.environ.get('BENCH_TOTAL_BUDGET', '1500'))
+    # Ablations (SURVEY §5.1): conv layout, BN compute dtype, dispatch
+    # mode, seq-256, scan-over-layers, the threefry-PRNG cost, plus
+    # on-chip kernel parity. Skipped on a degraded relay — the budget
+    # belongs to the headline numbers then — and stopped once the total
+    # wall budget is spent (a hanging relay must not starve the JSON
+    # line).
+    budget = float(os.environ.get('BENCH_TOTAL_BUDGET', '2000'))
 
-    def over_budget():
-        if time.time() - t_start > budget - timeout:
+    def over_budget(extra=0.0):
+        if time.time() - t_start > budget - timeout - extra:
             errors.setdefault('ablations', 'skipped: wall budget spent')
             return True
         return False
@@ -316,9 +317,11 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_single_dispatch'] = \
                     round(tok_1d, 1)
-        if not over_budget():
+        if not over_budget(extra=150.0):
+            # seq-256 compile (run_steps scan over a longer-attention
+            # graph) can exceed the standard watchdog — give it slack
             tok_256, err = _run_workload(
-                'transformer_seq256', backend, reduced, timeout)
+                'transformer_seq256', backend, reduced, timeout + 150)
             if err:
                 errors['transformer_seq256'] = err
             else:
@@ -333,26 +336,22 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_scan_layers'] = \
                     round(tok_scan, 1)
-        if not over_budget():
-            tok_np, err = _run_workload(
+        # (no PADDLE_TPU_USE_PALLAS ablation: at the bench's seq 64 the
+        # attention-op gate never dispatches Pallas — seq < 512 — so the
+        # run would measure the identical XLA path; kernel health is
+        # covered by the pallas_parity workload below)
+        if backend not in ('cpu',) and not over_budget():
+            # default PRNG on TPU is now rbg (executor._default_prng);
+            # this ablation records what threefry costs (on cpu the
+            # default already IS threefry — nothing to compare)
+            tok_tf, err = _run_workload(
                 'transformer', backend, reduced, timeout,
-                env={'PADDLE_TPU_USE_PALLAS': '1'})
+                env={'PADDLE_TPU_PRNG': 'threefry2x32'})
             if err:
-                errors['transformer_pallas'] = err
+                errors['transformer_threefry'] = err
             else:
-                ablations['transformer_tok_per_sec_pallas'] = round(tok_np,
-                                                                    1)
-        if not over_budget():
-            tok_rbg, err = _run_workload(
-                'transformer', backend, reduced, timeout,
-                env={'PADDLE_TPU_PRNG': 'rbg'})
-            if err:
-                errors['transformer_rbg'] = err
-            else:
-                ablations['transformer_tok_per_sec_rbg_prng'] = \
-                    round(tok_rbg, 1)
-                if tok_s is not None and tok_rbg > tok_s * 1.02:
-                    ablations['transformer_prng_winner'] = 'rbg'
+                ablations['transformer_tok_per_sec_threefry_prng'] = \
+                    round(tok_tf, 1)
         if backend not in ('cpu',) and not over_budget():
             parity, err = _run_workload('pallas_parity', backend, reduced,
                                         min(timeout, 150.0))

@@ -23,6 +23,27 @@ def _ensure_ops_imported():
     from .. import ops as _ops  # noqa: F401  (registers lowerings)
 
 
+def _default_prng():
+    """Dropout-mask PRNG implementation. On TPU the hardware
+    RngBitGenerator ('rbg') is the default: measured +62% transformer
+    tok/s over threefry (174.8k vs 108.2k, bench r3 rehearsal) — the
+    counter-based threefry mask generation was the single largest
+    non-matmul cost of the step. rbg is deterministic for a fixed
+    (seed, step) on a given backend/version; threefry remains the
+    default off-TPU and the cross-backend-reproducible choice
+    (PADDLE_TPU_PRNG=threefry2x32|rbg overrides)."""
+    import os
+    env = os.environ.get('PADDLE_TPU_PRNG')
+    if env:
+        return env
+    import jax
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return 'threefry2x32'
+    return 'rbg' if backend == 'tpu' else 'threefry2x32'
+
+
 def _remat_policy(name):
     import jax
     if name in ('full', 'nothing_saveable'):
@@ -400,8 +421,7 @@ class Executor(object):
                                 env[name], NamedSharding(mesh, spec))
             return env
 
-        import os
-        prng_impl = os.environ.get('PADDLE_TPU_PRNG', 'threefry2x32')
+        prng_impl = _default_prng()
 
         def step_fn(scope_vals, feed_vals, step_i):
             # PADDLE_TPU_PRNG=rbg swaps in the TPU hardware RNG for

@@ -99,7 +99,7 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
     if not use_ring and dropout_rate == 0.0 and key_length is None and \
             query_length is None and q.shape[-2] >= 512 and \
             q.shape[-2] % 512 == 0 and k.shape[-2] % 128 == 0 and \
-            q.shape[-1] % 128 == 0:
+            q.shape[-1] % 64 == 0:
         from .pallas import pallas_enabled
         use_pallas = pallas_enabled()
     if use_ring:

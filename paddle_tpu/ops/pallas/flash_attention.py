@@ -89,11 +89,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         denom = l_scr[:][:, :1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:][:, 0] + jnp.log(denom[:, 0])
+        # lse rides a [bh, 1, tq] array so its (1, block_q) block tile
+        # satisfies the TPU (8, 128)-or-equal constraint
+        lse_ref[0] = (m_scr[:][:, 0] +
+                      jnp.log(denom[:, 0])).reshape(1, block_q)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q):
-    """Returns (out [B,H,Tq,D], lse [B*H, Tq]) — lse feeds the backward."""
+    """Returns (out [B,H,Tq,D], lse [B*H, 1, Tq]) — lse feeds the
+    backward (row-vector layout per the TPU block-tile constraint)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -123,11 +127,11 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -248,9 +252,11 @@ def _flash_bwd(q, k, v, o, lse, g, causal, sm_scale, block_q):
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
     dor = g.reshape(b * h, tq, d)
-    # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it;
+    # [bh, 1, tq] row-vector layout like lse (TPU block-tile constraint)
     delta = jnp.sum(dor.astype(jnp.float32) *
-                    o.reshape(b * h, tq, d).astype(jnp.float32), axis=-1)
+                    o.reshape(b * h, tq, d).astype(jnp.float32),
+                    axis=-1).reshape(b * h, 1, tq)
 
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
@@ -262,8 +268,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, sm_scale, block_q):
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -292,8 +298,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, sm_scale, block_q):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, qi, ki: (bh, qi, 0)),

@@ -248,3 +248,33 @@ def test_run_steps_stacked_feed_wrong_leading_dim():
         exe.run_steps(3, feed={'x': np.zeros((2, 16, 8), 'f'),
                                'y': np.zeros((2, 16, 1), 'f')},
                       fetch_list=[cost], stacked_feed=True)
+
+
+def test_rbg_prng_dropout_semantics(monkeypatch):
+    """PADDLE_TPU_PRNG=rbg (the TPU default, executor._default_prng —
+    +62% tok/s on chip): dropout still zeroes ~p of activations,
+    differs across steps, and a same-seed rerun reproduces the
+    trajectory exactly on a given backend."""
+    monkeypatch.setenv('PADDLE_TPU_PRNG', 'rbg')
+
+    def run_once():
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.reset_default_programs()
+            x = fluid.layers.data(name='x', shape=[512],
+                                  dtype='float32')
+            out = fluid.layers.dropout(x, dropout_prob=0.4)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            ones = np.ones((16, 512), 'f')
+            masks = [exe.run(feed={'x': ones}, fetch_list=[out])[0]
+                     for _ in range(3)]
+        return masks
+
+    a = run_once()
+    b = run_once()
+    for m in a:
+        frac = float((m == 0).mean())
+        assert 0.3 < frac < 0.5, frac          # ~p zeroed
+    assert not np.array_equal(a[0], a[1])       # per-step keys differ
+    for ma, mb in zip(a, b):                    # same-seed reproducible
+        np.testing.assert_array_equal(ma, mb)
