@@ -36,12 +36,8 @@ def _default_prng():
     env = os.environ.get('PADDLE_TPU_PRNG')
     if env:
         return env
-    import jax
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        return 'threefry2x32'
-    return 'rbg' if backend == 'tpu' else 'threefry2x32'
+    from .platform_boot import is_tpu_backend
+    return 'rbg' if is_tpu_backend() else 'threefry2x32'
 
 
 def _remat_policy(name):

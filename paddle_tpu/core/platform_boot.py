@@ -27,3 +27,16 @@ def force_host_cpu(n_devices=None):
     os.environ['JAX_PLATFORMS'] = 'cpu'
     import jax
     jax.config.update('jax_platforms', 'cpu')
+
+
+def is_tpu_backend():
+    """True when the default jax backend is real TPU hardware — the
+    'tpu' platform, or the hosted 'axon' relay in case a jax version
+    reports the relay's own platform name. Shared by the
+    backend-dependent defaults (executor._default_prng dropout RNG,
+    conv_ops._conv_layout) so the detection policy lives in one place."""
+    try:
+        import jax
+        return jax.default_backend() in ('tpu', 'axon')
+    except Exception:
+        return False

@@ -1,11 +1,14 @@
 """Convolution / pooling ops.
 
 Reference: paddle/fluid/operators/{conv_op,conv_transpose_op,pool_op}.cc.
-IR semantics stay NCHW for reference-parity. By default no manual layout
-transposes are inserted (XLA's TPU layout assignment re-tiles
-internally); set PADDLE_TPU_CONV_LAYOUT=NHWC to lower convs/pools with
-channels-last dimension numbers (SURVEY §5 layout experiment — the bench
-records both, the faster one wins).
+IR semantics stay NCHW for reference-parity; the layout knob only
+changes the lax.conv dimension numbers inside the lowering (boundary
+transposes cancel in XLA). On TPU the default is NHWC: with the
+bf16-elementwise BN it measured +8% ResNet-50 img/s (2,436 vs ~2,257,
+r3 rehearsal) — channels-last matches the (8,128) vector tiling.
+PADDLE_TPU_CONV_LAYOUT=NCHW|NHWC overrides; numerics are identical
+either way (tests/test_amp.py::test_nhwc_conv_layout_matches_nchw) and
+the bench records both, the faster one winning the headline.
 """
 
 import os
@@ -17,7 +20,11 @@ from ..core.registry import register
 
 
 def _conv_layout():
-    return os.environ.get('PADDLE_TPU_CONV_LAYOUT', 'NCHW').upper()
+    env = os.environ.get('PADDLE_TPU_CONV_LAYOUT')
+    if env:
+        return env.upper()
+    from ..core.platform_boot import is_tpu_backend
+    return 'NHWC' if is_tpu_backend() else 'NCHW'
 
 
 @register('conv2d')
