@@ -26,13 +26,15 @@ BASE_RESNET_IMG_S = 200.0
 BASE_TRANSFORMER_TOK_S = 4500.0
 
 
-def _probe_backend(attempts=2, first_backoff=10.0, attempt_timeout=60.0):
+def _probe_backend(attempts=3, first_backoff=20.0, attempt_timeout=60.0):
     """Probe TPU backend init in a SUBPROCESS (jax caches init failures
     in-process, so retrying there is useless; and a hung relay init must be
     killable). Returns the platform of the default backend ('tpu'/'axon')
-    or 'cpu' after exhausting retries. Worst case ~130s — a hung relay
-    never resolves within a retry window anyway, and the remaining driver
-    budget is needed for the cpu-fallback bench itself.
+    or 'cpu' after exhausting retries. Worst case ~240s (3 x 60s probes
+    + 20/40s backoffs) — outages of a few minutes do recover (observed
+    late round 3), longer ones don't resolve within any retry window,
+    and the remaining driver budget is needed for the cpu-fallback
+    bench itself.
 
     Returns (platform, degraded): degraded=True means retries were
     exhausted (flaky relay) as opposed to the machine genuinely defaulting

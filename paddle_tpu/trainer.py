@@ -65,8 +65,26 @@ class Trainer(object):
         self.checkpoint_dir = checkpoint_config
         self._step = 0
 
+    def _to_feed(self, data, feeder, feed_order):
+        if feeder is not None:
+            return feeder.feed(data)
+        if isinstance(data, dict):
+            return data
+        return {name: np.asarray([d[i] for d in data])
+                for i, name in enumerate(feed_order)}
+
     def train(self, num_epochs, event_handler=None, reader=None,
-              feed_order=None, feeder=None):
+              feed_order=None, feeder=None, steps_per_dispatch=1):
+        """Event-driven training loop (reference v2 trainer contract).
+
+        steps_per_dispatch > 1 compiles the loop body into the XLA
+        program (Executor.run_steps over stacked feed windows): one
+        device dispatch per window, identical trajectory. Event order
+        within a window necessarily shifts — the window's
+        BeginStepEvents fire before the dispatch and its EndStepEvents
+        (with true per-step metrics) after — since the steps execute as
+        one program. Trailing batches that do not fill a window run
+        per-step."""
         event_handler = event_handler or (lambda e: None)
         if reader is not None:
             # Multihost: each host consumes a disjoint shard of the stream
@@ -74,26 +92,64 @@ class Trainer(object):
             from .parallel.multihost import shard_reader
             reader = shard_reader(reader)
         self.exe.run(self.startup)
+        w = int(steps_per_dispatch)
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))
-            for step, data in enumerate(reader()):
-                event_handler(BeginStepEvent(epoch, step))
-                if feeder is not None:
-                    feed = feeder.feed(data)
-                elif isinstance(data, dict):
-                    feed = data
-                else:
-                    feed = {name: np.asarray([d[i] for d in data])
-                            for i, name in enumerate(feed_order)}
-                metrics = self.exe.run(program=self.program, feed=feed,
-                                       fetch_list=self.fetches)
-                self._step += 1
-                event_handler(EndStepEvent(epoch, step, metrics))
+            step = 0
+            window = []
+            for data in reader():
+                feed = self._to_feed(data, feeder, feed_order)
+                if w <= 1:
+                    step = self._run_one(epoch, step, feed, event_handler)
+                    continue
+                if window and self._feed_sig(feed) != \
+                        self._feed_sig(window[0]):
+                    # shape change mid-window (bucketed readers): the
+                    # collected prefix runs per-step, stacking resumes
+                    for f in window:
+                        step = self._run_one(epoch, step, f,
+                                             event_handler)
+                    window = []
+                window.append(feed)
+                if len(window) == w:
+                    step = self._run_window(epoch, step, window,
+                                            event_handler)
+                    window = []
+            for feed in window:  # trailing partial window: per-step
+                step = self._run_one(epoch, step, feed, event_handler)
             event_handler(EndEpochEvent(epoch))
             if self.checkpoint_dir:
                 _io.save_checkpoint(self.exe, self.checkpoint_dir,
                                     main_program=self.program,
                                     step=self._step)
+
+    @staticmethod
+    def _feed_sig(feed):
+        return {n: np.asarray(v).shape for n, v in feed.items()}
+
+    def _run_one(self, epoch, step, feed, event_handler):
+        event_handler(BeginStepEvent(epoch, step))
+        metrics = self.exe.run(program=self.program, feed=feed,
+                               fetch_list=self.fetches)
+        self._step += 1
+        event_handler(EndStepEvent(epoch, step, metrics))
+        return step + 1
+
+    def _run_window(self, epoch, step0, window, event_handler):
+        w = len(window)
+        for i in range(w):
+            event_handler(BeginStepEvent(epoch, step0 + i))
+        stacked = {name: np.stack([f[name] for f in window])
+                   for name in window[0]}
+        metrics = self.exe.run_steps(w, program=self.program,
+                                     feed=stacked,
+                                     fetch_list=self.fetches,
+                                     stacked_feed=True)
+        self._step += w
+        for i in range(w):
+            event_handler(EndStepEvent(
+                epoch, step0 + i, [np.asarray(m[i]) for m in metrics]))
+        return step0 + w
 
     def save_params(self, dirname):
         _io.save_params(self.exe, dirname, main_program=self.program)

@@ -156,3 +156,89 @@ def test_lod_bucketed_training_bounds_recompiles():
     assert len(exe._cache) == len(lengths_seen) + 1
     assert np.isfinite(losses).all()
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_trainer_windowed_dispatch_matches_per_step():
+    """steps_per_dispatch>1 (run_steps windows, trailing remainder
+    per-step) reproduces the per-step trajectory exactly and fires the
+    same number of step events."""
+    def make(steps_per_dispatch):
+        def train_func():
+            x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            pred = fluid.layers.fc(input=x, size=1)
+            return [fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))]
+
+        rng = np.random.RandomState(3)
+        w = rng.randn(4, 1).astype('float32')
+        batches = []
+        r2 = np.random.RandomState(4)
+        for _ in range(7):          # 7 = 2 windows of 3 + 1 remainder
+            xs = r2.randn(8, 4).astype('float32')
+            batches.append({'x': xs, 'y': xs @ w})
+
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.reset_default_programs()
+            trainer = fluid.Trainer(
+                train_func=train_func,
+                optimizer_func=lambda: fluid.optimizer.SGD(
+                    learning_rate=0.1),
+                place=fluid.CPUPlace())
+            losses, begins = [], []
+            trainer.train(
+                num_epochs=1,
+                event_handler=lambda e: (
+                    losses.append(float(np.asarray(
+                        e.metrics[0]).reshape(())))
+                    if isinstance(e, fluid.trainer.EndStepEvent) else
+                    begins.append(e.step)
+                    if isinstance(e, fluid.trainer.BeginStepEvent)
+                    else None),
+                reader=lambda: iter(batches),
+                steps_per_dispatch=steps_per_dispatch)
+        return losses, begins
+
+    base, base_begins = make(1)
+    win, win_begins = make(3)
+    assert len(base) == len(win) == 7
+    assert sorted(win_begins) == sorted(base_begins)
+    np.testing.assert_allclose(win, base, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_windowed_dispatch_bucketed_shapes():
+    """A mid-window batch-shape change (bucketed readers) flushes the
+    collected prefix per-step instead of crashing np.stack."""
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        return [fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))]
+
+    rng = np.random.RandomState(5)
+    w = rng.randn(4, 1).astype('float32')
+    sizes = [8, 8, 5, 8, 8, 8, 5]      # bucket switches mid-window
+
+    def reader():
+        r = np.random.RandomState(6)
+        for b in sizes:
+            xs = r.randn(b, 4).astype('float32')
+            yield {'x': xs, 'y': xs @ w}
+
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.reset_default_programs()
+        trainer = fluid.Trainer(
+            train_func=train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+            place=fluid.CPUPlace())
+        losses = []
+        trainer.train(
+            num_epochs=1,
+            event_handler=lambda e: (
+                losses.append(float(np.asarray(
+                    e.metrics[0]).reshape(())))
+                if isinstance(e, fluid.trainer.EndStepEvent) else None),
+            reader=reader, steps_per_dispatch=3)
+    assert len(losses) == len(sizes)
+    assert np.isfinite(losses).all()
