@@ -16,14 +16,17 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
-def build_native(name, extra_flags=()):
+def build_native(name, extra_flags=(), includes=()):
     """Compile paddle_tpu/native/<name>.cpp into a .so cached by source
     content hash — a stale or foreign binary can never be loaded (no
-    prebuilt .so ships in the repo; everything is built from source)."""
+    prebuilt .so ships in the repo; everything is built from source).
+    `includes` lists sources the .cpp #includes — they enter the digest
+    so the cache invalidates when any part of the closure changes."""
     src = os.path.join(_HERE, name + '.cpp')
     hasher = hashlib.sha256()
-    with open(src, 'rb') as f:
-        hasher.update(f.read())
+    for piece in (name + '.cpp',) + tuple(includes):
+        with open(os.path.join(_HERE, piece), 'rb') as f:
+            hasher.update(f.read())
     hasher.update(' '.join(extra_flags).encode())
     digest = hasher.hexdigest()[:12]
     out = os.path.join(_HERE, 'lib%s-%s.so' % (name, digest))
@@ -77,6 +80,34 @@ def load_staging():
         lib.staging_close_ring.argtypes = [ctypes.c_void_p]
         lib.staging_free.argtypes = [ctypes.c_void_p]
         _STAGING = lib
+        return lib
+
+
+_PIPELINE = None
+
+
+def load_pipeline():
+    """Compile (if needed) and load the C++-to-C++ feed path
+    (pipeline.cpp: recordio reader -> staging ring); thread-safe."""
+    global _PIPELINE
+    with _LOCK:
+        if _PIPELINE is not None:
+            return _PIPELINE
+        lib = ctypes.CDLL(build_native(
+            'pipeline', includes=('recordio.cpp', 'staging.cpp')))
+        lib.pipeline_start.restype = ctypes.c_void_p
+        lib.pipeline_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+        lib.pipeline_next_window.restype = ctypes.c_void_p
+        lib.pipeline_next_window.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.pipeline_release.restype = ctypes.c_int
+        lib.pipeline_release.argtypes = [ctypes.c_void_p]
+        lib.pipeline_error.restype = ctypes.c_char_p
+        lib.pipeline_error.argtypes = [ctypes.c_void_p]
+        lib.pipeline_stop.argtypes = [ctypes.c_void_p]
+        _PIPELINE = lib
         return lib
 
 

@@ -1,0 +1,129 @@
+// C++-to-C++ feed path: recordio reader -> host staging ring with no
+// Python in the per-record loop.
+//
+// Reference analog: the reference's C++ DataProvider hands decoded
+// batches straight to the trainer thread; here a pump thread drains the
+// recordio reader (its own decode/shuffle thread, recordio.cpp) and
+// packs fixed-size example records contiguously into page-aligned
+// superbatch windows (staging.cpp). Python touches ONE buffer per
+// window: np.frombuffer with a structured dtype splits it into feeds
+// (reader/recordio.py recordio_superbatch).
+//
+// Records must all be exactly record_bytes long (one serialized example
+// of fixed-shape fields) — variable-length records are a schema error
+// surfaced through pipeline_error.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -pthread pipeline.cpp
+
+#include "recordio.cpp"
+#include "staging.cpp"
+
+#include <atomic>
+
+namespace {
+
+struct Pipeline {
+  void* ring = nullptr;      // staging Ring
+  void* reader = nullptr;    // recordio Reader
+  uint64_t record_bytes = 0;
+  uint64_t per_window = 0;
+  std::thread pump;
+  std::mutex err_mu;
+  std::string error;
+
+  void set_error(const std::string& e) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    if (error.empty()) error = e;
+  }
+
+  void run() {
+    for (;;) {
+      uint8_t* buf = staging_acquire_fill(ring);
+      if (!buf) return;  // consumer closed the ring
+      uint64_t filled = 0;
+      while (filled < per_window) {
+        const uint8_t* rec = nullptr;
+        int64_t n = recordio_reader_next(reader, &rec);
+        if (n <= 0) {
+          if (n < 0) set_error(recordio_reader_error(reader));
+          staging_close_ring(ring);  // EOF/error: drop partial window
+          return;
+        }
+        if (static_cast<uint64_t>(n) != record_bytes) {
+          char msg[128];
+          snprintf(msg, sizeof msg,
+                   "record length %lld != schema record_bytes %llu",
+                   static_cast<long long>(n),
+                   static_cast<unsigned long long>(record_bytes));
+          set_error(msg);
+          staging_close_ring(ring);
+          return;
+        }
+        memcpy(buf + filled * record_bytes, rec, record_bytes);
+        filled++;
+      }
+      if (staging_commit(ring, per_window * record_bytes) != 0) {
+        set_error("staging_commit failed");
+        staging_close_ring(ring);
+        return;
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-joined recordio files; records_per_window = steps * batch.
+void* pipeline_start(const char* paths, uint64_t shuffle_buf,
+                     uint64_t seed, uint64_t record_bytes,
+                     uint64_t records_per_window, int n_buffers) {
+  if (!record_bytes || !records_per_window) return nullptr;
+  auto* p = new Pipeline();
+  p->record_bytes = record_bytes;
+  p->per_window = records_per_window;
+  p->ring = staging_open(record_bytes * records_per_window,
+                         n_buffers < 2 ? 3 : n_buffers);
+  if (!p->ring) {
+    delete p;
+    return nullptr;
+  }
+  p->reader = recordio_reader_open(paths, shuffle_buf, seed, 256);
+  p->pump = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Blocks for the next full window; returns nullptr at end of stream
+// (check pipeline_error to distinguish EOF from failure). The window
+// stays valid until pipeline_release.
+const uint8_t* pipeline_next_window(void* h, uint64_t* out_len) {
+  auto* p = static_cast<Pipeline*>(h);
+  return staging_acquire_read(p->ring, out_len);
+}
+
+int pipeline_release(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  return staging_release(p->ring);
+}
+
+const char* pipeline_error(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  std::lock_guard<std::mutex> lk(p->err_mu);
+  return p->error.c_str();
+}
+
+void pipeline_stop(void* h) {
+  auto* p = static_cast<Pipeline*>(h);
+  // Stop order matters: cancel the reader WITHOUT deleting it (the
+  // pump may be inside recordio_reader_next), wake any acquire_fill
+  // wait, join the pump, and only then tear the pieces down.
+  recordio_reader_cancel(p->reader);
+  staging_close_ring(p->ring);
+  if (p->pump.joinable()) p->pump.join();
+  recordio_reader_close(p->reader);
+  staging_free(p->ring);
+  delete p;
+}
+
+}  // extern "C"

@@ -212,6 +212,18 @@ const char* recordio_reader_error(void* h) {
   return r->error.c_str();
 }
 
+// Signal shutdown WITHOUT freeing: wakes both the decode worker and any
+// thread blocked in recordio_reader_next (the worker winds down and
+// sets done). For callers whose own threads hold the handle
+// (pipeline.cpp): cancel, join those threads, then close.
+void recordio_reader_cancel(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->stop = true;
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
 void recordio_reader_close(void* h) {
   auto* r = static_cast<Reader*>(h);
   {

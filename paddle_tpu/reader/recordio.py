@@ -12,7 +12,9 @@ import pickle
 
 from ..native import load_library
 
-__all__ = ['RecordIOWriter', 'write_recordio', 'recordio_reader']
+__all__ = ['RecordIOWriter', 'write_recordio', 'recordio_reader',
+           'example_dtype', 'write_example_recordio',
+           'recordio_superbatch']
 
 
 class RecordIOWriter(object):
@@ -23,7 +25,10 @@ class RecordIOWriter(object):
             raise IOError('cannot open %s for writing' % path)
 
     def write(self, obj):
-        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self.write_raw(pickle.dumps(obj,
+                                    protocol=pickle.HIGHEST_PROTOCOL))
+
+    def write_raw(self, data):
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
         if self._lib.recordio_writer_write(self._h, buf, len(data)) != 0:
             raise IOError('recordio write failed')
@@ -78,3 +83,94 @@ def recordio_reader(paths, shuffle_buf=0, seed=0, prefetch=256, raw=False):
             lib.recordio_reader_close(h)
 
     return reader
+
+
+def example_dtype(specs):
+    """Structured numpy dtype for one fixed-shape example: `specs` is an
+    ordered mapping name -> (shape, dtype). Packed in field order with
+    no padding — exactly the byte layout write_example_recordio emits
+    and the C++ pipeline window parser assumes."""
+    import numpy as np
+    return np.dtype([(n, np.dtype(dt), tuple(shape))
+                     for n, (shape, dt) in specs.items()])
+
+
+def write_example_recordio(path, examples, specs):
+    """Serialize fixed-shape example dicts as raw records (one example =
+    one record of example_dtype(specs).itemsize bytes) for the C++
+    superbatch pipeline. Returns the number of records written."""
+    import numpy as np
+    rec_dtype = example_dtype(specs)
+    n = 0
+    with RecordIOWriter(path) as w:
+        for ex in examples:
+            row = np.zeros((), dtype=rec_dtype)
+            for name, (shape, dt) in specs.items():
+                arr = np.asarray(ex[name], dtype=dt)
+                if tuple(arr.shape) != tuple(shape):
+                    raise ValueError(
+                        'example field %r shape %s != spec %s'
+                        % (name, arr.shape, tuple(shape)))
+                row[name] = arr
+            w.write_raw(row.tobytes())
+            n += 1
+    return n
+
+
+def recordio_superbatch(paths, specs, steps, batch, shuffle_buf=0,
+                        seed=0, n_buffers=3, place=None):
+    """C++-to-C++ feed path: the native pipeline (native/pipeline.cpp)
+    drains recordio files and packs steps*batch fixed-size example
+    records per page-aligned staging window with no Python in the
+    per-record loop; this generator parses each window with ONE
+    np.frombuffer (structured dtype) and yields
+    {name: jax.Array [steps, batch, *shape]} dicts for
+    Executor.run_steps(stacked_feed=True). Trailing records that do not
+    fill a window are dropped (static shapes)."""
+    import numpy as np
+    from ..native import load_pipeline
+    from .decorator import resolve_device
+    from .staging import fields_to_device
+
+    rec_dtype = example_dtype(specs)
+    device = resolve_device(place)
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def gen():
+        import jax
+        lib = load_pipeline()
+        per_window = steps * batch
+        h = lib.pipeline_start('\n'.join(paths).encode(), shuffle_buf,
+                               seed, rec_dtype.itemsize, per_window,
+                               n_buffers)
+        if not h:
+            raise IOError('pipeline_start failed')
+        try:
+            target = device if device is not None else jax.devices()[0]
+            while True:
+                out_len = ctypes.c_uint64()
+                buf = lib.pipeline_next_window(h, ctypes.byref(out_len))
+                if not buf:
+                    err = lib.pipeline_error(h)
+                    if err:
+                        raise IOError('recordio pipeline: %s'
+                                      % err.decode())
+                    return
+                raw = ctypes.cast(
+                    ctypes.c_void_p(buf),
+                    ctypes.POINTER(ctypes.c_uint8 * out_len.value))
+                recs = np.frombuffer(raw.contents, dtype=rec_dtype,
+                                     count=per_window)
+                fields = {
+                    name: recs[name].reshape((steps, batch) +
+                                             tuple(shape))
+                    for name, (shape, _dt) in specs.items()}
+                window = fields_to_device(fields, target)
+                if lib.pipeline_release(h):
+                    raise RuntimeError('pipeline_release failed')
+                yield window
+        finally:
+            lib.pipeline_stop(h)
+
+    return gen

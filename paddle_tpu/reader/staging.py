@@ -14,12 +14,30 @@ import ctypes
 
 import numpy as np
 
-__all__ = ['staged_superbatch']
+__all__ = ['staged_superbatch', 'fields_to_device']
 
 
 def _load():
     from ..native import load_staging
     return load_staging()
+
+
+def fields_to_device(fields, target):
+    """fields: name -> numpy view ALIASING a reusable staging slot.
+    Copies on host-aliasing platforms (CPU jax zero-copies aligned host
+    arrays — the 'device' array would alias the slot), device_puts, and
+    blocks until the h2d transfer completes so the caller may release
+    and reuse the slot. The one home of that invariant — shared by
+    staged_superbatch and recordio_superbatch."""
+    import jax
+    window = {}
+    for name, arr in fields.items():
+        if target.platform == 'cpu':
+            arr = arr.copy()
+        window[name] = jax.device_put(arr, target)
+    for v in window.values():
+        v.block_until_ready()
+    return window
 
 
 def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
@@ -111,22 +129,15 @@ def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
                     ctypes.c_void_p(buf),
                     ctypes.POINTER(ctypes.c_uint8 * out_len.value))
                 target = device if device is not None else jax.devices()[0]
-                window = {}
+                fields = {}
                 for n in names:
                     shape, dtype = specs[n]
                     flat = np.frombuffer(
                         raw.contents, dtype=dtype,
                         count=steps * int(np.prod(shape)),
                         offset=offs[n])
-                    arr = flat.reshape((steps,) + shape)
-                    if target.platform == 'cpu':
-                        # CPU jax zero-copies aligned host arrays — the
-                        # "device" array would alias the reusable slot
-                        arr = arr.copy()
-                    window[n] = jax.device_put(arr, target)
-                # the h2d copy must finish before the slot is reused
-                for v in window.values():
-                    v.block_until_ready()
+                    fields[n] = flat.reshape((steps,) + shape)
+                window = fields_to_device(fields, target)
                 if lib.staging_release(ring):
                     raise RuntimeError('staging_release failed')
                 yield window
