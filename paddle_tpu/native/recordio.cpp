@@ -226,12 +226,7 @@ void recordio_reader_cancel(void* h) {
 
 void recordio_reader_close(void* h) {
   auto* r = static_cast<Reader*>(h);
-  {
-    std::lock_guard<std::mutex> lk(r->mu);
-    r->stop = true;
-    r->not_full.notify_all();
-    r->not_empty.notify_all();
-  }
+  recordio_reader_cancel(h);
   if (r->worker.joinable()) r->worker.join();
   delete r;
 }

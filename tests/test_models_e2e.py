@@ -163,3 +163,53 @@ def test_resnext_tiny():
     xs = rng.rand(2, 3, 32, 32).astype('float32')
     ys = rng.randint(0, 10, (2, 1)).astype('int64')
     _train(loss, lambda i: {'img': xs, 'label': ys}, steps=4)
+
+
+def test_recommender_movielens():
+    """Dual-tower recommender (recommender_system chapter) on the
+    movielens dataset schema: rating regression loss decreases."""
+    from paddle_tpu.models.recommender import recommender
+    from paddle_tpu.dataset import movielens
+    _pred, loss = recommender()
+    users, movies, scores = [], [], []
+    for u, m, s in list(movielens.train()())[:64]:
+        users.append(u), movies.append(m), scores.append(s)
+    rng = np.random.RandomState(11)
+    n = len(users)
+    feed = {'uid': np.asarray(users, 'int64').reshape(-1, 1),
+            'mov_id': np.asarray(movies, 'int64').reshape(-1, 1),
+            'score': np.asarray(scores, 'float32').reshape(-1, 1),
+            'gender': rng.randint(0, 2, (n, 1)).astype('int64'),
+            'age': rng.randint(0, 7, (n, 1)).astype('int64'),
+            'job': rng.randint(0, 21, (n, 1)).astype('int64'),
+            'category': rng.randint(0, 19, (n, 1)).astype('int64')}
+    _train(loss, lambda i: feed, steps=10,
+           opt=fluid.optimizer.Adam(learning_rate=5e-3))
+
+
+def test_srl_crf_tagger_trains_and_decodes():
+    """BiGRU + linear-chain CRF SRL (label_semantic_roles chapter):
+    the CRF loss decreases and Viterbi decode on the trained emissions
+    recovers the dominant tag structure of a synthetic rule."""
+    from paddle_tpu.models.srl import srl_decode, srl_tagger
+    vocab, labels, t = 30, 5, 8
+    word = fluid.layers.data(name='word', shape=[t], dtype='int64')
+    mark = fluid.layers.data(name='mark', shape=[t], dtype='int64')
+    target = fluid.layers.data(name='target', shape=[t], dtype='int64')
+    length = fluid.layers.data(name='length', shape=[], dtype='int64')
+    emission, _crf, loss = srl_tagger(word, mark, target, vocab, labels,
+                                      length=length)
+    decoded = srl_decode(emission, length=length)
+    rng = np.random.RandomState(12)
+    words = rng.randint(1, vocab, (16, t)).astype('int64')
+    marks = (rng.rand(16, t) < 0.2).astype('int64')
+    # synthetic rule: tag = (word + mark) % labels
+    targets = ((words + marks) % labels).astype('int64')
+    feed = {'word': words, 'mark': marks, 'target': targets,
+            'length': np.full((16,), t, 'int64')}
+    losses = _train(loss, lambda i: feed, steps=25,
+                    opt=fluid.optimizer.Adam(learning_rate=5e-2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    paths = exe.run(feed=feed, fetch_list=[decoded])[0]
+    acc = (paths == targets).mean()
+    assert acc > 0.5, (acc, losses[-1])
