@@ -314,7 +314,7 @@ class Program(object):
                 # carry layer-attached annotations (v2 input types,
                 # row_shard hints) through the copy
                 for extra in ('_v2_type', '_v2_len_var', 'row_shard',
-                              'expert_shard'):
+                              'expert_shard', 'expert_shard_axis'):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

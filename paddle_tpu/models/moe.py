@@ -14,14 +14,81 @@ from ..param_attr import ParamAttr
 from .transformer import _multi_head_attention, position_encoding_table
 
 
+def _stacked_moe_params(n_layer, n_head, d_model, d_inner, num_experts):
+    """[n_layer, ...] stacked weights for the moe_layer_stack op
+    (ops/transformer_ops.py MOE_SLOTS layout); expert weights stack
+    [n_layer, E, ...] and mark expert_shard_axis=1 so the transpiler
+    shards the EXPERT axis (not the layer axis) over 'ep'."""
+    from .transformer import _stack_param
+    L, E = n_layer, num_experts
+    p = {
+        'slf_q': _stack_param('moe_stack_slf_q.w', [L, d_model, d_model],
+                              d_model, d_model),
+        'slf_k': _stack_param('moe_stack_slf_k.w', [L, d_model, d_model],
+                              d_model, d_model),
+        'slf_v': _stack_param('moe_stack_slf_v.w', [L, d_model, d_model],
+                              d_model, d_model),
+        'slf_o': _stack_param('moe_stack_slf_o.w', [L, d_model, d_model],
+                              d_model, d_model),
+        'ln1_w': _stack_param('moe_stack_ln1.w', [L, d_model], 0, 0,
+                              constant=1.0),
+        'ln1_b': _stack_param('moe_stack_ln1.b', [L, d_model], 0, 0,
+                              constant=0.0),
+        'gate_w': _stack_param('moe_stack_gate.w',
+                               [L, d_model, E], d_model, E),
+        'moe_w1': _stack_param('moe_stack_1.w',
+                               [L, E, d_model, d_inner], d_model,
+                               d_inner),
+        'moe_b1': _stack_param('moe_stack_1.b', [L, E, d_inner], 0, 0,
+                               constant=0.0),
+        'moe_w2': _stack_param('moe_stack_2.w',
+                               [L, E, d_inner, d_model], d_inner,
+                               d_model),
+        'moe_b2': _stack_param('moe_stack_2.b', [L, E, d_model], 0, 0,
+                               constant=0.0),
+        'ln2_w': _stack_param('moe_stack_ln2.w', [L, d_model], 0, 0,
+                              constant=1.0),
+        'ln2_b': _stack_param('moe_stack_ln2.b', [L, d_model], 0, 0,
+                              constant=0.0),
+    }
+    for slot in ('moe_w1', 'moe_b1', 'moe_w2', 'moe_b2'):
+        p[slot].expert_shard = True
+        p[slot].expert_shard_axis = 1
+    return p
+
+
+def _moe_stack(x, params, n_head, dropout_rate, capacity_factor, top_k):
+    from ..layers.helper import LayerHelper
+    from ..ops.transformer_ops import _slot_to_input
+    helper = LayerHelper('moe_layer_stack', name='moe_stack')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    aux = helper.create_variable_for_type_inference('float32')
+    aux.shape = ()
+    inputs = {'X': [x]}
+    for slot, param in params.items():
+        inputs[_slot_to_input(slot)] = [param]
+    helper.append_op(type='moe_layer_stack', inputs=inputs,
+                     outputs={'Out': [out], 'AuxLoss': [aux]},
+                     attrs={'n_head': n_head,
+                            'dropout_rate': dropout_rate,
+                            'capacity_factor': capacity_factor,
+                            'top_k': top_k})
+    return out, aux
+
+
 def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
                           d_model=64, d_inner=128, num_experts=4,
                           capacity_factor=1.25, top_k=1, aux_weight=1e-2,
-                          dropout_rate=0.0, max_length=512):
+                          dropout_rate=0.0, max_length=512,
+                          scan_layers=False):
     """Causal LM: feeds word [B, T] int64 and label [B, T] int64;
     returns (avg_cost, logits). Every block: causal fused attention ->
     residual+LN -> Switch-MoE FFN -> residual+LN; the MoE aux losses are
-    added to the CE at `aux_weight` (Switch Transformer's 1e-2)."""
+    added to the CE at `aux_weight` (Switch Transformer's 1e-2).
+    scan_layers=True compiles the n_layer blocks as ONE lax.scan over
+    stacked weights (moe_layer_stack op) — flat compile time over
+    depth, expert sharding intact."""
     word = layers.data(name='word', shape=[seq_len], dtype='int64')
     label = layers.data(name='label', shape=[seq_len], dtype='int64')
 
@@ -41,7 +108,13 @@ def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
     x = layers.elementwise_add(x=emb, y=pos_slice)
 
     aux_losses = []
-    for i in range(n_layer):
+    if scan_layers:
+        params = _stacked_moe_params(n_layer, n_head, d_model, d_inner,
+                                     num_experts)
+        x, aux = _moe_stack(x, params, n_head, dropout_rate,
+                            capacity_factor, top_k)
+        aux_losses.append(aux)
+    for i in range(0 if scan_layers else n_layer):
         d_head = d_model // n_head
         proj = _multi_head_attention(
             x, x, x, d_head, d_head, d_model, n_head, dropout_rate,

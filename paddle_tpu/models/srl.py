@@ -24,14 +24,14 @@ def srl_tagger(word, mark, target, word_dict_len, label_dict_len,
     mark_emb = layers.embedding(input=mark,
                                 size=[mark_dict_len, emb_dim // 2],
                                 dtype='float32')
-    feat = layers.concat([word_emb, mark_emb], axis=2)
-    hidden = layers.fc(input=feat, size=hidden_dim * 3,
-                       num_flatten_dims=2)
+    hidden = layers.concat([word_emb, mark_emb], axis=2)
     for i in range(depth):
-        gru = layers.dynamic_gru(input=hidden, size=hidden_dim,
-                                 is_reverse=(i % 2) == 1, length=length)
-        hidden = layers.fc(input=gru, size=hidden_dim * 3,
-                           num_flatten_dims=2)
+        # dynamic_gru consumes a 3h pre-projection of its input
+        proj = layers.fc(input=hidden, size=hidden_dim * 3,
+                         num_flatten_dims=2)
+        hidden = layers.dynamic_gru(input=proj, size=hidden_dim,
+                                    is_reverse=(i % 2) == 1,
+                                    length=length)
     emission = layers.fc(input=hidden, size=label_dict_len,
                          num_flatten_dims=2,
                          param_attr=ParamAttr(name='srl_emission.w'))

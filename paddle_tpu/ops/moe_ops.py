@@ -18,6 +18,24 @@ import jax.numpy as jnp
 from ..core.registry import register
 
 
+def moe_capacity(cap_factor, k, s, e):
+    """ceil(cap_factor * k * S / E), floor 1 — the per-expert slot
+    budget shared by every MoE lowering."""
+    return max(1, int(cap_factor * k * s / e + 0.999999))
+
+
+def constrain_experts(mesh, tensors):
+    """with_sharding_constraint P('ep') on each [E, ...] tensor when the
+    mesh's ep axis is active (each chip holds E/ep experts; GSPMD turns
+    the dispatch/combine einsums into the token exchange over ICI);
+    passthrough otherwise."""
+    if mesh is None or dict(mesh.shape).get('ep', 1) <= 1:
+        return tuple(tensors)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return tuple(jax.lax.with_sharding_constraint(
+        t, NamedSharding(mesh, P('ep'))) for t in tensors)
+
+
 def switch_moe_reference(x2, gate_w, w1, b1, w2, b2, capacity, k=1):
     """Dense-dispatch MoE on flattened tokens x2 [S, D].
     Returns (out [S, D], aux_loss scalar, expert_index [S, k]).
@@ -85,24 +103,10 @@ def _switch_moe(ctx):
 
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
-    s = x2.shape[0]
-    e = gate_w.shape[-1]
-    capacity = max(1, int(cap_factor * k * s / e + 0.999999))
-
+    capacity = moe_capacity(cap_factor, k, x2.shape[0],
+                            gate_w.shape[-1])
     mesh = getattr(ctx.block.program, 'mesh', None)
-    ep = dict(mesh.shape).get('ep', 1) if mesh is not None else 1
-
-    if ep > 1:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def c(v, spec):
-            return jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, spec))
-        w1 = c(w1, P('ep'))
-        w2 = c(w2, P('ep'))
-        b1 = c(b1, P('ep'))
-        b2 = c(b2, P('ep'))
-
+    w1, b1, w2, b2 = constrain_experts(mesh, (w1, b1, w2, b2))
     out2, aux, _ = switch_moe_reference(x2, gate_w, w1, b1, w2, b2,
                                         capacity, k=k)
     ctx.set_output('Out', out2.reshape(shape))

@@ -126,6 +126,77 @@ def _transformer_layer_stack(ctx):
     ctx.set_output('Out', out)
 
 
+MOE_SLOTS = ('slf_q', 'slf_k', 'slf_v', 'slf_o', 'ln1_w', 'ln1_b',
+             'gate_w', 'moe_w1', 'moe_b1', 'moe_w2', 'moe_b2',
+             'ln2_w', 'ln2_b')
+
+
+@register('moe_layer_stack')
+def _moe_layer_stack(ctx):
+    """Scan-over-layers for MoE transformer blocks: causal fused
+    attention -> residual+LN -> Switch/top-k MoE FFN -> residual+LN,
+    ONE lax.scan over [n_layer, ...] stacked weights (expert weights
+    stack [n_layer, E, ...]). Mirrors models/moe.py's unrolled block;
+    per-layer aux losses come back summed. Composes the two scaling
+    levers: flat compile time over depth (transformer_layer_stack) and
+    expert parallelism (the per-layer dispatch is switch_moe_reference,
+    so 'ep' sharding constraints still apply inside the scan)."""
+    from .moe_ops import (constrain_experts, moe_capacity,
+                          switch_moe_reference)
+
+    x = ctx.input('X')
+    n_head = ctx.attr('n_head', 1)
+    rate = ctx.attr('dropout_rate', 0.0)
+    cap_factor = ctx.attr('capacity_factor', 1.25)
+    k = ctx.attr('top_k', 1)
+    is_test = ctx.attr('is_test', False) or ctx.is_test
+    mesh = getattr(ctx.block.program, 'mesh', None)
+
+    params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
+              for s in MOE_SLOTS}
+    n_layer = next(iter(params.values())).shape[0]
+    if ctx.amp == 'bf16':
+        x = x.astype(jnp.bfloat16)
+        for s in MOE_SLOTS:
+            # router (gate_w) and LN params stay fp32
+            if not s.startswith('ln') and s != 'gate_w':
+                params[s] = params[s].astype(jnp.bfloat16)
+
+    b, t, d = x.shape
+    capacity = moe_capacity(cap_factor, k, b * t,
+                            params['gate_w'].shape[-1])
+
+    if rate and not is_test:
+        # one key per layer: dropout lives only inside the attention op
+        # (models/moe.py's unrolled block has no post-process sites)
+        site_keys = jax.random.split(
+            ctx.rng_key(), n_layer).reshape(n_layer, 1)
+        xs = (params, site_keys)
+    else:
+        xs = (params,)
+
+    def body(carry, sl):
+        h, aux_sum = carry
+        p = sl[0]
+        key = sl[1][0] if len(sl) > 1 else None
+        slf = _attn(h, h, p, 'slf', n_head, True, None, rate, key,
+                    is_test, mesh)
+        h = _post_process(h, slf, p, 0.0, None, is_test, 'ln1')
+        h2 = h.reshape(b * t, d)
+        w1, b1, w2, b2 = constrain_experts(
+            mesh, (p['moe_w1'], p['moe_b1'], p['moe_w2'], p['moe_b2']))
+        moe_out, aux, _ = switch_moe_reference(
+            h2, p['gate_w'], w1, b1, w2, b2, capacity, k=k)
+        h = _post_process(h, moe_out.reshape(b, t, d), p, 0.0, None,
+                          is_test, 'ln2')
+        return (h, aux_sum + aux), None
+
+    (out, aux_total), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), xs)
+    ctx.set_output('Out', out)
+    ctx.set_output('AuxLoss', aux_total)
+
+
 # --------------------------------------------------------- incremental decode
 def _mha_one_step(q1, kc, vc, n_head, live):
     """One-query attention against a cached key/value buffer.

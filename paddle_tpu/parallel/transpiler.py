@@ -110,13 +110,18 @@ def _row_shard_spec_for(param, mesh):
 
 
 def _expert_shard_spec_for(param, mesh):
-    """[E, ...] expert-stacked weights (layers.switch_moe) shard their
-    leading expert axis over 'ep' — each chip holds E/ep experts."""
+    """Expert-stacked weights (layers.switch_moe) shard their expert
+    axis over 'ep' — each chip holds E/ep experts. The axis defaults to
+    0 ([E, ...]); scan-stacked MoE layers ([n_layer, E, ...]) set
+    expert_shard_axis = 1."""
     if not getattr(param, 'expert_shard', False):
         return None
     if dict(mesh.shape).get('ep', 1) <= 1:
         return None
-    return P(*(['ep'] + [None] * (len(param.shape) - 1)))
+    axis = getattr(param, 'expert_shard_axis', 0)
+    spec = [None] * len(param.shape)
+    spec[axis] = 'ep'
+    return P(*spec)
 
 
 def transpile(program, mesh, strategy=None):

@@ -123,3 +123,97 @@ def test_moe_params_marked_and_sharded():
     assert gates and all(
         tuple(prog.var_shardings[g.name]) in ((), (None,) * 2)
         for g in gates)
+
+
+_MOE_STACK_RE = __import__('re').compile(
+    r'^moe_(\d+)_(slf_(?:q|k|v)|slf_out)\.w$|'
+    r'^moe_(\d+)_ln(\d)\.(w|b)$|'
+    r'^moe_(\d+)_exp_(gate\.w|1\.w|1\.b|2\.w|2\.b)$')
+
+
+def _moe_stacked_name(name):
+    m = _MOE_STACK_RE.match(name)
+    if not m:
+        return None, None
+    if m.group(1):
+        slot = m.group(2).replace('slf_out', 'slf_o') + '.w'
+        return 'moe_stack_%s' % slot, int(m.group(1))
+    if m.group(3):
+        return 'moe_stack_ln%s.%s' % (m.group(4), m.group(5)), \
+            int(m.group(3))
+    return 'moe_stack_%s' % m.group(7), int(m.group(6))
+
+
+def test_moe_scan_layers_matches_unrolled():
+    """moe_layer_stack (one lax.scan over stacked blocks) follows the
+    unrolled MoE LM's trajectory exactly given identical weights."""
+    from paddle_tpu.models.moe import switch_transformer_lm
+    vocab, seq, L = 32, 8, 2
+    kw = dict(n_layer=L, n_head=2, d_model=16, d_inner=32,
+              num_experts=4, top_k=2)
+    rng = np.random.RandomState(9)
+    words = rng.randint(1, vocab, (8, seq)).astype('int64')
+    labels = np.roll(words, -1, axis=1)
+
+    def build(scan):
+        fluid.reset_default_programs()
+        avg, _ = switch_transformer_lm(vocab, seq, scan_layers=scan,
+                                       **kw)
+        fluid.optimizer.SGD(learning_rate=0.3).minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return avg, exe
+
+    su, ss = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(su):
+        avg, exe = build(False)
+        init = {n: np.asarray(su.find(n)) for n in su.keys()
+                if su.find(n) is not None}
+        base = [float(np.asarray(exe.run(
+            feed={'word': words, 'label': labels},
+            fetch_list=[avg])[0]).reshape(())) for _ in range(3)]
+    with fluid.scope_guard(ss):
+        avg, exe = build(True)
+        stacks = {}
+        for name, val in init.items():
+            sname, i = _moe_stacked_name(name)
+            if sname is None:
+                if ss.find(name) is not None:
+                    ss.set(name, val)
+            else:
+                stacks.setdefault(sname, [None] * L)[i] = val
+        for sname, parts in stacks.items():
+            assert all(p is not None for p in parts), sname
+            assert ss.find(sname) is not None, sname
+            ss.set(sname, np.stack(parts, axis=0))
+        got = [float(np.asarray(exe.run(
+            feed={'word': words, 'label': labels},
+            fetch_list=[avg])[0]).reshape(())) for _ in range(3)]
+    np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_scan_layers_ep_mesh():
+    """The stacked MoE LM trains on a dp2 x ep4 mesh, with the expert
+    axis (axis 1 of the [L, E, ...] stacks) sharded over 'ep'."""
+    from paddle_tpu.models.moe import switch_transformer_lm
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    vocab, seq = 32, 8
+    avg, _ = switch_transformer_lm(vocab, seq, n_layer=2, n_head=2,
+                                   d_model=16, d_inner=32,
+                                   num_experts=4, scan_layers=True)
+    fluid.default_main_program().random_seed = 7
+    fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg)
+    mesh = make_mesh(dp=2, ep=4)
+    prog = transpile(fluid.default_main_program(), mesh,
+                     ParallelStrategy(data_parallel=True))
+    spec = prog.var_shardings['moe_stack_1.w']
+    assert tuple(spec)[:2] == (None, 'ep'), spec
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    words = rng.randint(1, vocab, (8, seq)).astype('int64')
+    losses = [float(np.asarray(exe.run(
+        feed={'word': words, 'label': np.roll(words, -1, axis=1)},
+        fetch_list=[avg])[0]).reshape(())) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
