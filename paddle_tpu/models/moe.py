@@ -8,10 +8,67 @@ with parallel.transpile on a mesh with ep > 1 (tests/test_moe.py;
 __graft_entry__.dryrun_multichip runs one ep-sharded step).
 """
 
+import re
+
+import numpy as np
+
 from .. import layers
 from ..initializer import Normal, NumpyArrayInitializer
 from ..param_attr import ParamAttr
 from .transformer import _multi_head_attention, position_encoding_table
+
+_UNROLLED_MOE_RE = re.compile(
+    r'^moe_(\d+)_(slf_(?:q|k|v)|slf_out)\.w$|'
+    r'^moe_(\d+)_ln(\d)\.(w|b)$|'
+    r'^moe_(\d+)_exp_(gate\.w|1\.w|1\.b|2\.w|2\.b)$')
+
+
+def _unrolled_to_moe_stacked_name(name):
+    """Map an unrolled MoE-block param name ('moe_0_slf_q.w',
+    'moe_1_exp_1.w', ...) to (stacked 'moe_stack_*' name, layer index);
+    (None, None) for non-layer params (embeddings, pos table, out)."""
+    m = _UNROLLED_MOE_RE.match(name)
+    if not m:
+        return None, None
+    if m.group(1):
+        slot = m.group(2).replace('slf_out', 'slf_o') + '.w'
+        return 'moe_stack_%s' % slot, int(m.group(1))
+    if m.group(3):
+        return 'moe_stack_ln%s.%s' % (m.group(4), m.group(5)), \
+            int(m.group(3))
+    return 'moe_stack_%s' % m.group(7), int(m.group(6))
+
+
+def stack_moe_trained_weights(scope, n_layer):
+    """Convert an unrolled-trained switch_transformer_lm scope in place
+    to the stacked 'moe_stack_*' layout the scan_layers=True graph
+    reads (the MoE analog of transformer.stack_trained_weights).
+    Returns the stacked names.
+
+    To CONTINUE TRAINING under the scan graph (not just infer): build
+    the scan program, run its startup (fresh stacked params + optimizer
+    accumulators), restore the trained shared-name weights, then call
+    this — optimizer state restarts cold for the migrated layout."""
+    stacks = {}
+    for name in scope.keys():
+        val = scope.find(name)
+        if val is None:
+            continue
+        sname, i = _unrolled_to_moe_stacked_name(name)
+        if sname is not None:
+            if i >= n_layer:
+                raise ValueError(
+                    'stack_moe_trained_weights: %r has layer index %d '
+                    'but n_layer=%d' % (name, i, n_layer))
+            stacks.setdefault(sname, [None] * n_layer)[i] = \
+                np.asarray(val)
+    for sname, parts in stacks.items():
+        missing = [i for i, p in enumerate(parts) if p is None]
+        if missing:
+            raise ValueError('stack_moe_trained_weights: %r missing '
+                             'layers %s' % (sname, missing))
+        scope.set(sname, np.stack(parts, axis=0))
+    return sorted(stacks)
 
 
 def _stacked_moe_params(n_layer, n_head, d_model, d_inner, num_experts):
@@ -21,15 +78,16 @@ def _stacked_moe_params(n_layer, n_head, d_model, d_inner, num_experts):
     shards the EXPERT axis (not the layer axis) over 'ep'."""
     from .transformer import _stack_param
     L, E = n_layer, num_experts
+    hd = (d_model // n_head) * n_head  # == unrolled d_head * n_head
     p = {
-        'slf_q': _stack_param('moe_stack_slf_q.w', [L, d_model, d_model],
-                              d_model, d_model),
-        'slf_k': _stack_param('moe_stack_slf_k.w', [L, d_model, d_model],
-                              d_model, d_model),
-        'slf_v': _stack_param('moe_stack_slf_v.w', [L, d_model, d_model],
-                              d_model, d_model),
-        'slf_o': _stack_param('moe_stack_slf_o.w', [L, d_model, d_model],
-                              d_model, d_model),
+        'slf_q': _stack_param('moe_stack_slf_q.w', [L, d_model, hd],
+                              d_model, hd),
+        'slf_k': _stack_param('moe_stack_slf_k.w', [L, d_model, hd],
+                              d_model, hd),
+        'slf_v': _stack_param('moe_stack_slf_v.w', [L, d_model, hd],
+                              d_model, hd),
+        'slf_o': _stack_param('moe_stack_slf_o.w', [L, hd, d_model],
+                              hd, d_model),
         'ln1_w': _stack_param('moe_stack_ln1.w', [L, d_model], 0, 0,
                               constant=1.0),
         'ln1_b': _stack_param('moe_stack_ln1.b', [L, d_model], 0, 0,
@@ -89,6 +147,9 @@ def switch_transformer_lm(vocab_size, seq_len, n_layer=2, n_head=4,
     scan_layers=True compiles the n_layer blocks as ONE lax.scan over
     stacked weights (moe_layer_stack op) — flat compile time over
     depth, expert sharding intact."""
+    if not 1 <= top_k <= num_experts:
+        raise ValueError('switch_transformer_lm: top_k=%d must be in '
+                         '[1, num_experts=%d]' % (top_k, num_experts))
     word = layers.data(name='word', shape=[seq_len], dtype='int64')
     label = layers.data(name='label', shape=[seq_len], dtype='int64')
 

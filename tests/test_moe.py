@@ -125,25 +125,6 @@ def test_moe_params_marked_and_sharded():
         for g in gates)
 
 
-_MOE_STACK_RE = __import__('re').compile(
-    r'^moe_(\d+)_(slf_(?:q|k|v)|slf_out)\.w$|'
-    r'^moe_(\d+)_ln(\d)\.(w|b)$|'
-    r'^moe_(\d+)_exp_(gate\.w|1\.w|1\.b|2\.w|2\.b)$')
-
-
-def _moe_stacked_name(name):
-    m = _MOE_STACK_RE.match(name)
-    if not m:
-        return None, None
-    if m.group(1):
-        slot = m.group(2).replace('slf_out', 'slf_o') + '.w'
-        return 'moe_stack_%s' % slot, int(m.group(1))
-    if m.group(3):
-        return 'moe_stack_ln%s.%s' % (m.group(4), m.group(5)), \
-            int(m.group(3))
-    return 'moe_stack_%s' % m.group(7), int(m.group(6))
-
-
 def test_moe_scan_layers_matches_unrolled():
     """moe_layer_stack (one lax.scan over stacked blocks) follows the
     unrolled MoE LM's trajectory exactly given identical weights."""
@@ -174,18 +155,14 @@ def test_moe_scan_layers_matches_unrolled():
             fetch_list=[avg])[0]).reshape(())) for _ in range(3)]
     with fluid.scope_guard(ss):
         avg, exe = build(True)
-        stacks = {}
+        # seed the scan scope with the unrolled init, then convert via
+        # the production mapping (models.moe.stack_moe_trained_weights);
+        # leftover per-layer names in the scope are simply unread
+        from paddle_tpu.models.moe import stack_moe_trained_weights
         for name, val in init.items():
-            sname, i = _moe_stacked_name(name)
-            if sname is None:
-                if ss.find(name) is not None:
-                    ss.set(name, val)
-            else:
-                stacks.setdefault(sname, [None] * L)[i] = val
-        for sname, parts in stacks.items():
-            assert all(p is not None for p in parts), sname
-            assert ss.find(sname) is not None, sname
-            ss.set(sname, np.stack(parts, axis=0))
+            ss.set(name, val)
+        stacked = stack_moe_trained_weights(ss, L)
+        assert stacked, 'no params were stacked'
         got = [float(np.asarray(exe.run(
             feed={'word': words, 'label': labels},
             fetch_list=[avg])[0]).reshape(())) for _ in range(3)]

@@ -135,3 +135,38 @@ def test_scan_layers_env_knob(monkeypatch):
         ops = [op.type for op in
                fluid.default_main_program().global_block().ops]
     assert ops.count('transformer_layer_stack') == 2, ops
+
+
+def test_scan_layers_with_ring_attention_sp_mesh():
+    """Composition of the two long-context levers: scan-over-layers with
+    the ring-attention sp dispatch INSIDE the scan body (shard_map
+    nested in lax.scan). Trajectory must match the unsharded scan run."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import (ParallelStrategy,
+                                                transpile)
+    cfg = dict(CFG, src_seq_len=8, trg_seq_len=8, dropout_rate=0.0)
+    feed = T.make_fake_batch(4, 8, 8, VOCAB, VOCAB, seed=2)
+
+    def run(mesh):
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.reset_default_programs()
+            avg, _ = T.transformer(VOCAB, VOCAB, max_length=16,
+                                   scan_layers=True, **cfg)
+            fluid.default_main_program().random_seed = 5
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(avg)
+            if mesh is not None:
+                transpile(fluid.default_main_program(), mesh,
+                          ParallelStrategy(
+                              data_parallel=True,
+                              sequence_parallel=True,
+                              sp_vars=['src_word', 'trg_word',
+                                       'lbl_word', 'lbl_weight']))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            return [float(np.asarray(exe.run(
+                feed=feed, fetch_list=[avg])[0]).reshape(()))
+                for _ in range(3)]
+
+    base = run(None)
+    sp = run(make_mesh(dp=2, sp=4))
+    np.testing.assert_allclose(sp, base, rtol=2e-4, atol=1e-5)
