@@ -8,7 +8,7 @@ from ..core import unique_name
 from ..core.program import default_main_program, default_startup_program
 from ..core.dtypes import canonical_dtype
 from ..initializer import Constant, Xavier
-from ..param_attr import ParamAttr
+from ..param_attr import ParamAttr, WeightNormParamAttr
 
 
 class LayerHelper(object):
@@ -77,6 +77,9 @@ class LayerHelper(object):
         name = attr.name if attr.name is not None else \
             unique_name.generate('%s.w' % self.name if not is_bias
                                  else '%s.b' % self.name)
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_normalized(attr, name, shape,
+                                                  dtype)
         block = self.main_program.global_block()
         kwargs = attr.to_kwargs(with_initializer=True)
         kwargs.pop('name', None)
@@ -86,6 +89,52 @@ class LayerHelper(object):
         attr.initializer(param)
         self.main_program._startup_ref = self.startup_program
         return param
+
+    def _create_weight_normalized(self, attr, name, shape, dtype):
+        """w = g * v / ||v|| (norm over all axes except attr.dim;
+        reference layer_helper.py:_create_weight_normalize builds this
+        from elementwise ops — here it is ONE weight_norm op, with g
+        startup-initialized to ||v|| so training starts at the
+        unnormalized parameterization). v and g are the trainable
+        Parameters; the returned w is recomputed in-graph each step."""
+        dim = attr.dim
+        shape = [int(s) for s in shape]
+        if dim is not None:
+            if not -len(shape) <= dim < len(shape):
+                raise ValueError(
+                    'WeightNormParamAttr: dim=%d out of range for a '
+                    '%d-D weight' % (dim, len(shape)))
+            dim = dim % len(shape)  # normalize negatives (-1 is the
+            #                         internal dim=None wire sentinel)
+        block = self.main_program.global_block()
+        v_kwargs = attr.to_kwargs(with_initializer=True)
+        v_kwargs.pop('name', None)
+        v = block.create_parameter(name + '.wn_v', shape=shape,
+                                   dtype=dtype, **v_kwargs)
+        attr.initializer(v)
+        g_shape = [1] if dim is None else [shape[dim]]
+        # g inherits every training-relevant attr field (clip included);
+        # only the initializer differs (the startup norm op below)
+        g_kwargs = attr.to_kwargs()
+        g_kwargs.pop('name', None)
+        g = block.create_parameter(name + '.wn_g', shape=g_shape,
+                                   dtype=dtype, **g_kwargs)
+        # startup: g <- ||v|| (runs after v's init op, same program)
+        sb = self.startup_program.global_block()
+        sb.create_var(name=g.name, shape=tuple(g_shape), dtype=dtype,
+                      persistable=True)
+        sb.append_op(type='weight_norm_g_init', inputs={'V': [v]},
+                     outputs={'G': [g]},
+                     attrs={'dim': -1 if dim is None else int(dim)})
+        self.main_program._startup_ref = self.startup_program
+        w = self.block.create_var(name=name, dtype=dtype)
+        w.shape = tuple(shape)
+        w.stop_gradient = False
+        self.block.append_op(
+            type='weight_norm', inputs={'V': [v], 'G': [g]},
+            outputs={'W': [w]},
+            attrs={'dim': -1 if dim is None else int(dim)})
+        return w
 
     def create_variable_for_type_inference(self, dtype=None):
         if dtype is None:

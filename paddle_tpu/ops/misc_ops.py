@@ -91,3 +91,36 @@ def _range(ctx):
     ctx.set_output('Out', jnp.arange(
         ctx.attr('start', 0), ctx.attr('end'), ctx.attr('step', 1),
         dtype=ctx.out_dtype('Out')))
+
+
+def _wn_norm(v, dim):
+    """||v|| over every axis except `dim` (dim=-1: all axes), keepdims."""
+    import jax.numpy as jnp
+    axes = tuple(i for i in range(v.ndim) if i != dim) if dim >= 0 \
+        else tuple(range(v.ndim))
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True)
+                    + 1e-12)
+
+
+@register('weight_norm')
+def _weight_norm(ctx):
+    """w = g * v / ||v|| (WeightNormParamAttr reparameterization;
+    reference layer_helper.py:_create_weight_normalize builds the same
+    from elementwise ops)."""
+    v = ctx.input('V')
+    g = ctx.input('G')
+    dim = ctx.attr('dim', -1)
+    norm = _wn_norm(v, dim)
+    gshape = [1] * v.ndim
+    if dim >= 0:
+        gshape[dim] = v.shape[dim]
+    ctx.set_output('W', g.reshape(gshape) * v / norm)
+
+
+@register('weight_norm_g_init')
+def _weight_norm_g_init(ctx):
+    """Startup op: g <- ||v|| so the initial w equals the initializer's
+    v (training starts at the unnormalized parameterization)."""
+    v = ctx.input('V')
+    dim = ctx.attr('dim', -1)
+    ctx.set_output('G', _wn_norm(v, dim).reshape(-1))

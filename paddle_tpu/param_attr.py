@@ -50,4 +50,16 @@ class ParamAttr(object):
         return kwargs
 
 
-WeightNormParamAttr = ParamAttr
+class WeightNormParamAttr(ParamAttr):
+    """Weight normalization (reference param_attr.py:WeightNormParamAttr):
+    the consuming layer's weight is reparameterized as
+    w = g * v / ||v||, with the norm taken over every axis EXCEPT `dim`
+    (dim=None normalizes over all axes to a scalar g). The helper
+    creates `<name>.wn_v` (direction, the layer initializer) and
+    `<name>.wn_g` (magnitude, initialized to ||v|| at startup so
+    training starts at the unnormalized parameterization) and emits one
+    weight_norm op; gradients flow to v and g."""
+
+    def __init__(self, dim=None, **kwargs):
+        super(WeightNormParamAttr, self).__init__(**kwargs)
+        self.dim = dim
