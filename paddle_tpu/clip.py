@@ -25,6 +25,11 @@ class ErrorClipByValue(BaseErrorClipAttr):
 
 
 def error_clip_callback(block, op_desc):
+    """API shim: the reference appends clip ops per grad OpDesc here;
+    TPU-native, the Executor applies a var's ``error_clip`` as a
+    cotangent clamp (custom_vjp) at lowering time — set
+    ``var.error_clip = ErrorClipByValue(...)`` and the clamp rides the
+    whole-program autodiff (core/executor.py _error_clip_grad)."""
     pass
 
 

@@ -23,6 +23,36 @@ def _ensure_ops_imported():
     from .. import ops as _ops  # noqa: F401  (registers lowerings)
 
 
+_ERROR_CLIP_FN = None
+
+
+def _error_clip_grad(x, lo, hi):
+    """Identity forward; clamps the cotangent to [lo, hi] on the way
+    back (the reference's error clip semantics, fluid/clip.py
+    ErrorClipByValue applied through backward.py callbacks). The
+    custom_vjp is built once (module cache) — lo/hi ride as nondiff
+    args, so one primitive serves every clipped var."""
+    global _ERROR_CLIP_FN
+    if _ERROR_CLIP_FN is None:
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+        def f(x, lo, hi):
+            return x
+
+        def fwd(x, lo, hi):
+            return x, None
+
+        def bwd(lo, hi, _res, g):
+            return (jnp.clip(g, lo, hi),)
+
+        f.defvjp(fwd, bwd)
+        _ERROR_CLIP_FN = f
+    return _ERROR_CLIP_FN(x, lo, hi)
+
+
 def _default_prng():
     """Dropout-mask PRNG implementation. On TPU the hardware
     RngBitGenerator ('rbg') is the default: measured +62% transformer
@@ -390,7 +420,21 @@ class Executor(object):
             import jax.numpy as _jnp
             from jax.sharding import NamedSharding, PartitionSpec
             from .registry import AMP_BF16_OUT_SLOTS
+            from ..clip import ErrorClipByValue
             for i, op in enumerate(op_list):
+                err_clipped = []
+                for n in op.output_names():
+                    v = block._find_var_recursive(n)
+                    ec = getattr(v, 'error_clip', None) \
+                        if v is not None else None
+                    if ec is None:
+                        continue
+                    if not isinstance(ec, ErrorClipByValue):
+                        raise NotImplementedError(
+                            'error_clip on %r: only ErrorClipByValue is '
+                            'supported by the cotangent-clamp lowering '
+                            '(got %s)' % (n, type(ec).__name__))
+                    err_clipped.append((n, ec))
                 ctx = LoweringContext(env, op, block, start_index + i,
                                       base_key,
                                       is_test=bool(op.attrs.get('is_test',
@@ -409,6 +453,14 @@ class Executor(object):
                         name = op.output(slot)
                         if name in env and env[name].dtype == _jnp.float32:
                             env[name] = env[name].astype(_jnp.bfloat16)
+                for name, ec in err_clipped:
+                    # reference error_clip: clamp the gradient flowing
+                    # BACK through this var (fluid/clip.py ErrorClip +
+                    # backward.py error_clip_callback); TPU-native, the
+                    # clamp rides the var's cotangent via custom_vjp
+                    if name in env:
+                        env[name] = _error_clip_grad(
+                            env[name], float(ec.min), float(ec.max))
                 if mesh is not None:
                     for name in op.output_names():
                         spec = shardings.get(name)

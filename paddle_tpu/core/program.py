@@ -34,7 +34,19 @@ class Variable(object):
         self.stop_gradient = stop_gradient
         self.is_data = is_data
         self.trainable = trainable
-        self.error_clip = kwargs.get('error_clip', None)
+        self._error_clip = kwargs.get('error_clip', None)
+
+    @property
+    def error_clip(self):
+        return self._error_clip
+
+    @error_clip.setter
+    def error_clip(self, value):
+        # compile-relevant mutation: a clip set AFTER a run must not be
+        # ignored by the executor's warm compile cache
+        self._error_clip = value
+        if self.block is not None and self.block.program is not None:
+            self.block.program._bump_version()
 
     @property
     def program(self):
@@ -314,7 +326,8 @@ class Program(object):
                 # carry layer-attached annotations (v2 input types,
                 # row_shard hints) through the copy
                 for extra in ('_v2_type', '_v2_len_var', 'row_shard',
-                              'expert_shard', 'expert_shard_axis'):
+                              'expert_shard', 'expert_shard_axis',
+                              '_error_clip'):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

@@ -323,3 +323,63 @@ def test_run_steps_advances_lr_schedule():
     np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-6)
     # the decay actually kicked in (loss scale changes across windows)
     assert not np.allclose(single[0], single[-1])
+
+
+def test_error_clip_clamps_activation_gradient():
+    """var.error_clip = ErrorClipByValue(...) clamps the cotangent
+    flowing back through that var (reference fluid/clip.py ErrorClip +
+    backward error_clip_callback; here a custom_vjp at lowering)."""
+    def build(clip):
+        fluid.reset_default_programs()
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name='ec_w'))
+        if clip:
+            pred.error_clip = fluid.clip.ErrorClipByValue(max=0.01)
+        loss = fluid.layers.reduce_sum(fluid.layers.scale(pred,
+                                                          scale=100.0))
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return loss, exe
+
+    xs = np.ones((4, 3), 'f')
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        loss, exe = build(clip=False)
+        w0 = np.asarray(s1.find('ec_w'))
+        exe.run(feed={'x': xs}, fetch_list=[loss])
+        dw_unclipped = (w0 - np.asarray(s1.find('ec_w')))  # lr=1
+    with fluid.scope_guard(s2):
+        loss, exe = build(clip=True)
+        w0 = np.asarray(s2.find('ec_w'))
+        exe.run(feed={'x': xs}, fetch_list=[loss])
+        dw_clipped = (w0 - np.asarray(s2.find('ec_w')))
+    # unclipped cotangent is 100 per element -> dw = sum_b x = 4 * 100
+    np.testing.assert_allclose(dw_unclipped, 400.0, rtol=1e-5)
+    # clipped to 0.01 per element -> dw = 4 * 0.01
+    np.testing.assert_allclose(dw_clipped, 0.04, rtol=1e-5)
+
+
+def test_error_clip_set_after_run_invalidates_cache():
+    """Setting var.error_clip AFTER a compiled run must bump the program
+    version so the warm executor cache recompiles with the clamp."""
+    fluid.reset_default_programs()
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name='ec2_w'))
+    loss = fluid.layers.reduce_sum(fluid.layers.scale(pred, scale=100.0))
+    fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.ones((4, 3), 'f')
+    scope = fluid.global_scope()
+    w0 = np.asarray(scope.find('ec2_w'))
+    exe.run(feed={'x': xs}, fetch_list=[loss])
+    w1 = np.asarray(scope.find('ec2_w'))
+    np.testing.assert_allclose(w0 - w1, 400.0, rtol=1e-5)
+    pred.error_clip = fluid.clip.ErrorClipByValue(max=0.01)
+    exe.run(feed={'x': xs}, fetch_list=[loss])
+    w2 = np.asarray(scope.find('ec2_w'))
+    # fp32 ulp at |w|~400 dominates the 0.04 delta -> atol
+    np.testing.assert_allclose(w1 - w2, 0.04, atol=2e-3)
