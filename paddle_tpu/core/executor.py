@@ -23,6 +23,29 @@ def _ensure_ops_imported():
     from .. import ops as _ops  # noqa: F401  (registers lowerings)
 
 
+def collect_error_clips(block, ops):
+    """{var name: (lo, hi)} for every op output carrying an error_clip
+    (validated once, at compile/trace start — not per op per trace).
+    Only ErrorClipByValue maps onto the cotangent-clamp lowering."""
+    from ..clip import ErrorClipByValue
+    clips = {}
+    for op in ops:
+        for n in op.output_names():
+            if n in clips:
+                continue
+            v = block._find_var_recursive(n)
+            ec = getattr(v, 'error_clip', None) if v is not None else None
+            if ec is None:
+                continue
+            if not isinstance(ec, ErrorClipByValue):
+                raise NotImplementedError(
+                    'error_clip on %r: only ErrorClipByValue is '
+                    'supported by the cotangent-clamp lowering (got %s)'
+                    % (n, type(ec).__name__))
+            clips[n] = (float(ec.min), float(ec.max))
+    return clips
+
+
 _ERROR_CLIP_FN = None
 
 
@@ -414,27 +437,14 @@ class Executor(object):
         mesh = program.mesh
         shardings = program.var_shardings
         amp = program.amp
+        error_clips = collect_error_clips(block, ops)
 
         def run_ops(op_list, env, base_key, start_index=0):
             import jax as _jax
             import jax.numpy as _jnp
             from jax.sharding import NamedSharding, PartitionSpec
             from .registry import AMP_BF16_OUT_SLOTS
-            from ..clip import ErrorClipByValue
             for i, op in enumerate(op_list):
-                err_clipped = []
-                for n in op.output_names():
-                    v = block._find_var_recursive(n)
-                    ec = getattr(v, 'error_clip', None) \
-                        if v is not None else None
-                    if ec is None:
-                        continue
-                    if not isinstance(ec, ErrorClipByValue):
-                        raise NotImplementedError(
-                            'error_clip on %r: only ErrorClipByValue is '
-                            'supported by the cotangent-clamp lowering '
-                            '(got %s)' % (n, type(ec).__name__))
-                    err_clipped.append((n, ec))
                 ctx = LoweringContext(env, op, block, start_index + i,
                                       base_key,
                                       is_test=bool(op.attrs.get('is_test',
@@ -453,14 +463,16 @@ class Executor(object):
                         name = op.output(slot)
                         if name in env and env[name].dtype == _jnp.float32:
                             env[name] = env[name].astype(_jnp.bfloat16)
-                for name, ec in err_clipped:
+                if error_clips:
                     # reference error_clip: clamp the gradient flowing
                     # BACK through this var (fluid/clip.py ErrorClip +
                     # backward.py error_clip_callback); TPU-native, the
                     # clamp rides the var's cotangent via custom_vjp
-                    if name in env:
-                        env[name] = _error_clip_grad(
-                            env[name], float(ec.min), float(ec.max))
+                    for name in op.output_names():
+                        if name in error_clips and name in env:
+                            lo, hi = error_clips[name]
+                            env[name] = _error_clip_grad(env[name],
+                                                         lo, hi)
                 if mesh is not None:
                     for name in op.output_names():
                         spec = shardings.get(name)

@@ -12,12 +12,18 @@ from ..core.registry import LoweringContext, get_lowering, register
 
 
 def _run_block_ops(block, env, base_key, is_test=False):
+    from ..core.executor import _error_clip_grad, collect_error_clips
+    clips = collect_error_clips(block, block.ops)
     for i, op in enumerate(block.ops):
         ctx = LoweringContext(env, op, block, 10_000 * (block.idx + 1) + i,
                               base_key,
                               is_test=is_test or
                               bool(op.attrs.get('is_test', False)))
         get_lowering(op.type)(ctx)
+        for name in op.output_names():
+            if name in clips and name in env:
+                lo, hi = clips[name]
+                env[name] = _error_clip_grad(env[name], lo, hi)
     return env
 
 

@@ -19,7 +19,7 @@ __all__ = ['SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
            'AdamaxOptimizer', 'DecayedAdagradOptimizer',
            'AdadeltaOptimizer', 'RMSPropOptimizer', 'FtrlOptimizer',
            'ProximalAdagrad', 'ProximalAdagradOptimizer',
-           'Optimizer']
+           'Optimizer', 'GradientAccumulator']
 
 
 class Optimizer(object):
@@ -112,21 +112,36 @@ class Optimizer(object):
         self._finish_update(block)
         return optimize_ops
 
+    def _minimize_prologue(self, loss, startup_program, parameter_list,
+                           no_grad_set):
+        """Shared front half of minimize: resolve programs, append
+        backward + clip + regularization. Returns (main_program,
+        startup_program, params_grads); the caller appends its update
+        ops under program_guard(main, startup)."""
+        from .core.program import default_startup_program
+        main_program = loss.block.program
+        if startup_program is None:
+            startup_program = main_program._startup_ref or \
+                default_startup_program()
+        from .core.program import program_guard
+        with program_guard(main_program, startup_program):
+            params_grads = append_backward(loss, parameter_list,
+                                           no_grad_set)
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+        return main_program, startup_program, params_grads
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         # All helper ops (lr var, accumulators, clip, regularizer) must land
         # in the LOSS's program, not whatever default is current — guard it
         # (the reference wraps the same way via program_guard).
-        from .core.program import (default_startup_program, program_guard)
-        main_program = loss.block.program
-        if startup_program is None:
-            startup_program = main_program._startup_ref or \
-                default_startup_program()
+        from .core.program import program_guard
+        main_program, startup_program, params_grads = \
+            self._minimize_prologue(loss, startup_program, parameter_list,
+                                    no_grad_set)
         with program_guard(main_program, startup_program):
-            params_grads = append_backward(loss, parameter_list, no_grad_set)
-            params_grads = append_gradient_clip_ops(params_grads)
-            params_grads = append_regularization_ops(params_grads,
-                                                     self.regularization)
             optimize_ops = self._create_optimization_pass(
                 params_grads, loss, startup_program)
         return optimize_ops, params_grads
@@ -441,3 +456,116 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 ProximalAdagrad = ProximalAdagradOptimizer
+
+
+class GradientAccumulator(object):
+    """Gradient accumulation: wrap any optimizer so the parameter update
+    applies every `accum_steps` executor steps with the MEAN of the
+    accumulated gradients — effective batch = accum_steps x micro-batch
+    without the memory of the large batch.
+
+    No reference analog (the pserver era predates it); TPU-native
+    design: no Python branching — every step runs the same XLA program.
+    Per (param, grad): acc += grad and the inner update consumes acc /
+    accum_steps; every persistable var the inner update writes (params,
+    moments, beta pows) is snapshotted before the update ops and
+    blended back with select arithmetic `snap + (new - snap) * flag`,
+    where flag = [phase == accum_steps - 1]; acc and the phase counter
+    reset on apply steps. Composes with Executor.run_steps (state
+    chains through the scan carry).
+
+    Caveats: gradient clip / regularization (the inner optimizer's
+    config) apply to each MICRO gradient before accumulation; lr decay
+    counters advance per micro step."""
+
+    def __init__(self, optimizer, accum_steps):
+        if int(accum_steps) != accum_steps or accum_steps < 1:
+            raise ValueError('accum_steps must be a positive integer, '
+                             'got %r' % (accum_steps,))
+        self._inner = optimizer
+        self._k = int(accum_steps)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .core import unique_name
+        from .core.program import program_guard
+        from . import layers as _layers
+        inner = self._inner
+        k = self._k
+        if k == 1:
+            return inner.minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
+        main_program, startup_program, params_grads = \
+            inner._minimize_prologue(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        block = main_program.global_block()
+        with program_guard(main_program, startup_program):
+            helper = LayerHelper('grad_accum')
+            phase = block.create_var(name=unique_name.generate(
+                'grad_accum_phase'), shape=(1,), dtype='float32',
+                persistable=True)
+            phase.stop_gradient = True
+            Constant(0.0)(phase)
+            boundary = _layers.fill_constant(shape=[1], dtype='float32',
+                                             value=float(k - 1))
+            flag = _layers.cast(_layers.equal(x=phase, y=boundary),
+                                'float32')            # 1.0 on apply steps
+            keep = _layers.scale(flag, scale=-1.0, bias=1.0)
+
+            # acc += grad; the inner update consumes the mean grad
+            accs = []
+            for p, g in params_grads:
+                acc = block.create_var(
+                    name=unique_name.generate(p.name + '_grad_acc'),
+                    shape=p.shape, dtype=p.dtype, persistable=True)
+                acc.stop_gradient = True
+                Constant(0.0)(acc)
+                helper.append_op(type='elementwise_add',
+                                 inputs={'X': [acc], 'Y': [g]},
+                                 outputs={'Out': [acc]})
+                helper.append_op(type='scale', inputs={'X': [acc]},
+                                 outputs={'Out': [g]},
+                                 attrs={'scale': 1.0 / k})
+                accs.append(acc)
+
+            mark = len(block.ops)
+            optimize_ops = inner._create_optimization_pass(
+                params_grads, loss, startup_program)
+
+            # every persistable var the inner update wrote gets
+            # snapshot-before / select-after treatment
+            written = []
+            seen = set()
+            for op in block.ops[mark:]:
+                for n in op.output_names():
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable and n not in seen:
+                        seen.add(n)
+                        written.append(v)
+            insert_at = mark
+            snaps = {}
+            for v in written:
+                snap = helper.create_variable_for_type_inference(v.dtype)
+                snap.shape = v.shape
+                helper.append_op(type='assign', inputs={'X': [v]},
+                                 outputs={'Out': [snap]})
+                block.ops.insert(insert_at, block.ops.pop())
+                insert_at += 1
+                snaps[v.name] = snap
+            for v in written:
+                snap = snaps[v.name]
+                delta = _layers.elementwise_sub(x=v, y=snap)
+                gated = _layers.elementwise_mul(x=delta, y=flag)
+                helper.append_op(type='elementwise_add',
+                                 inputs={'X': [snap], 'Y': [gated]},
+                                 outputs={'Out': [v]})
+            for acc in accs:  # reset on apply steps
+                helper.append_op(type='elementwise_mul',
+                                 inputs={'X': [acc], 'Y': [keep]},
+                                 outputs={'Out': [acc]})
+            bumped = _layers.scale(phase, scale=1.0, bias=1.0)
+            gated_phase = _layers.elementwise_mul(x=bumped, y=keep)
+            helper.append_op(type='assign',
+                             inputs={'X': [gated_phase]},
+                             outputs={'Out': [phase]})
+        return optimize_ops, params_grads

@@ -146,3 +146,44 @@ def test_array_write_read():
     got = run_startup_and({'x': xs}, [r0, r1])
     np.testing.assert_allclose(got[0], xs, rtol=1e-6)
     np.testing.assert_allclose(got[1], xs * 3.0, rtol=1e-6)
+
+
+def test_error_clip_inside_rnn_sub_block():
+    """var.error_clip set on a StaticRNN step var clamps the cotangent
+    inside the scan body (the sub-block lowering applies the same
+    cotangent clamp as the global block; regression: it was silently
+    ignored there)."""
+    def build(clip):
+        fluid.reset_default_programs()
+        x = fluid.layers.data(name='x', shape=[3, 4], dtype='float32')
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(batch_ref=x, shape=[4], value=0.0)
+            h = fluid.layers.fc(input=[xt, mem], size=4, bias_attr=False,
+                                param_attr=[fluid.ParamAttr(name='rx_w'),
+                                            fluid.ParamAttr(name='rh_w')])
+            if clip:
+                h.error_clip = fluid.clip.ErrorClipByValue(max=1e-4)
+            rnn.update_memory(mem, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.scale(out, scale=1000.0))
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        w0 = np.asarray(scope.find('rx_w'))
+        xs = np.ones((2, 3, 4), 'f')
+        exe.run(feed={'x': xs}, fetch_list=[loss])
+        return float(np.abs(w0 - np.asarray(scope.find('rx_w'))).max())
+
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        dw_unclipped = build(clip=False)
+    with fluid.scope_guard(s2):
+        dw_clipped = build(clip=True)
+    # cotangent ~1000 unclipped vs 1e-4 clipped: orders of magnitude
+    assert dw_unclipped > 1e2, dw_unclipped
+    assert dw_clipped < 1e-1, dw_clipped

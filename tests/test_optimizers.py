@@ -219,3 +219,94 @@ def test_proximal_adagrad():
         p = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * 0.01, 0.0) / \
             (1.0 + lr_t * 0.02)
     np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_gradient_accumulator_equals_big_batch_sgd():
+    """GradientAccumulator(SGD, k): k micro-steps apply ONE update with
+    the mean gradient — identical to a single step on the concatenated
+    batch (mean losses make the math exact)."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 6).astype('float32')
+    w_true = rng.randn(6, 1).astype('float32')
+    ys = xs @ w_true
+
+    def build(accum):
+        fluid.reset_default_programs()
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name='ga_w'))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if accum:
+            fluid.optimizer.GradientAccumulator(opt, 2).minimize(loss)
+        else:
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return loss, exe
+
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):  # one step on the full batch
+        loss, exe = build(accum=False)
+        w0 = np.asarray(s1.find('ga_w'))
+        exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+        w_big = np.asarray(s1.find('ga_w'))
+    with fluid.scope_guard(s2):  # two micro-steps, accumulated
+        loss, exe = build(accum=True)
+        s2.set('ga_w', w0)       # same init as the big-batch run
+        exe.run(feed={'x': xs[:8], 'y': ys[:8]}, fetch_list=[loss])
+        w_mid = np.asarray(s2.find('ga_w'))
+        np.testing.assert_allclose(w_mid, w0, rtol=1e-6)  # no update yet
+        exe.run(feed={'x': xs[8:], 'y': ys[8:]}, fetch_list=[loss])
+        w_acc = np.asarray(s2.find('ga_w'))
+    np.testing.assert_allclose(w_acc, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_accumulator_adam_state_gating():
+    """With Adam inside, moments and beta-pow accumulators advance only
+    on apply steps, and the trajectory over 2k micro-steps equals k
+    big-batch Adam steps."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(8, 4).astype('float32')
+    ys = rng.randn(8, 1).astype('float32')
+
+    def build(accum):
+        fluid.reset_default_programs()
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name='gaa_w'))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.Adam(learning_rate=0.05)
+        if accum:
+            fluid.optimizer.GradientAccumulator(opt, 2).minimize(loss)
+        else:
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        return loss, exe
+
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        loss, exe = build(accum=False)
+        w0 = np.asarray(s1.find('gaa_w'))
+        for _ in range(3):  # 3 big-batch Adam steps
+            exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+        w_big = np.asarray(s1.find('gaa_w'))
+        beta1_big = [np.asarray(s1.find(n)).reshape(())
+                     for n in s1.keys() if 'beta1_pow' in n]
+    with fluid.scope_guard(s2):
+        loss, exe = build(accum=True)
+        s2.set('gaa_w', w0)
+        for _ in range(6):  # 6 micro-steps = 3 applied updates
+            exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+        w_acc = np.asarray(s2.find('gaa_w'))
+        beta1_acc = [np.asarray(s2.find(n)).reshape(())
+                     for n in s2.keys() if 'beta1_pow' in n]
+    # identical micro-batches -> mean grad == big-batch grad, so the
+    # whole Adam trajectory (incl. beta powers) must match
+    np.testing.assert_allclose(beta1_acc, beta1_big, rtol=1e-6)
+    np.testing.assert_allclose(w_acc, w_big, rtol=1e-5, atol=1e-6)
