@@ -475,8 +475,12 @@ class GradientAccumulator(object):
     chains through the scan carry).
 
     Caveats: gradient clip / regularization (the inner optimizer's
-    config) apply to each MICRO gradient before accumulation; lr decay
-    counters advance per micro step."""
+    config) apply to each MICRO gradient before accumulation. The two
+    step clocks differ by design: @LR_DECAY_COUNTER@ (created by the lr
+    schedule before this wrapper's gated region) advances every MICRO
+    step, while a user-supplied `global_step` counter is written inside
+    the inner optimization pass and therefore gated — it counts APPLIED
+    updates, advancing once per accum_steps micro steps."""
 
     def __init__(self, optimizer, accum_steps):
         if int(accum_steps) != accum_steps or accum_steps < 1:

@@ -86,9 +86,13 @@ def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
 
         def produce():
             try:
-                batches, stream = [first], it
+                import itertools
+                batches = []
+                # first already normalized; route it through the same
+                # flush path so a steps=1 window packs exactly one batch
+                stream = itertools.chain([first], map(norm, it))
                 for item in stream:
-                    batches.append(norm(item))
+                    batches.append(item)
                     if len(batches) < steps:
                         continue
                     buf = lib.staging_acquire_fill(ring)

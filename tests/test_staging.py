@@ -102,6 +102,19 @@ def test_staged_superbatch_feeds_run_steps():
     np.testing.assert_allclose(staged, single, rtol=1e-5, atol=1e-6)
 
 
+def test_staged_superbatch_steps_one():
+    """Regression (r3 advisor): steps=1 used to pack 2 batches into a
+    1-step region (first batch seeded outside the flush check), writing
+    past the per-field region and silently dropping every other batch."""
+    data = _batches(4, seed=5)
+    windows = list(staged_superbatch(lambda: iter(data), steps=1)())
+    assert len(windows) == 4
+    for w, b in zip(windows, data):
+        for nme in ('x', 'y'):
+            assert np.asarray(w[nme]).shape == (1,) + b[nme].shape
+            np.testing.assert_array_equal(np.asarray(w[nme])[0], b[nme])
+
+
 def test_staged_superbatch_mismatched_shape_raises():
     data = _batches(3)
     data[2]['x'] = np.zeros((5, 8), 'f')    # batch-size drift mid-stream
