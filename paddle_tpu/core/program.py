@@ -245,6 +245,11 @@ class Program(object):
         # var name -> jax.sharding.PartitionSpec (or None)
         self.var_shardings = {}
         self.mesh = None
+        # Pipeline parallelism config attached by parallel.transpile when
+        # strategy.pipeline_parallel is set: {'n_micro': int}. Scan-stacked
+        # layer ops (transformer_layer_stack) read it and run the GPipe
+        # microbatch schedule over the mesh's 'pp' axis.
+        self.pipeline = None
         # Mixed precision: None (full fp32) or 'bf16' — matmul/conv-class
         # ops autocast inputs to bfloat16 (MXU-native) while params,
         # grads, optimizer state and loss-class ops stay fp32
@@ -306,6 +311,7 @@ class Program(object):
         p._seed = self._seed
         p.var_shardings = dict(self.var_shardings)
         p.mesh = self.mesh
+        p.pipeline = dict(self.pipeline) if self.pipeline else None
         for i, b in enumerate(self.blocks):
             nb = p.blocks[0] if i == 0 else p.create_block(b.parent_idx)
             for name, v in b.vars.items():
@@ -327,7 +333,7 @@ class Program(object):
                 # row_shard hints) through the copy
                 for extra in ('_v2_type', '_v2_len_var', 'row_shard',
                               'expert_shard', 'expert_shard_axis',
-                              '_error_clip'):
+                              '_error_clip', 'sparse_grad', 'sparse_ids'):
                     if hasattr(v, extra):
                         setattr(nv, extra, getattr(v, extra))
                 nb.vars[name] = nv

@@ -81,7 +81,15 @@ def _transformer_layer_stack(ctx):
     n_head = ctx.attr('n_head', 1)
     rate = ctx.attr('dropout_rate', 0.0)
     is_test = ctx.attr('is_test', False) or ctx.is_test
-    mesh = getattr(ctx.block.program, 'mesh', None)
+    program = ctx.block.program
+    mesh = getattr(program, 'mesh', None)
+    pp_conf = getattr(program, 'pipeline', None)
+    # Program-level pipeline parallelism: transpile(strategy=
+    # ParallelStrategy(pipeline_parallel=True)) on a mesh with an active
+    # 'pp' axis routes this op through the GPipe schedule instead of one
+    # flat lax.scan — stage s holds layers [s*L/pp, (s+1)*L/pp).
+    pipelined = bool(pp_conf) and mesh is not None and \
+        dict(mesh.shape).get('pp', 1) > 1
 
     slots = DEC_SLOTS if is_decoder else ENC_SLOTS
     params = {s: ctx.env[ctx.op.input(_slot_to_input(s))] for s in slots}
@@ -106,23 +114,50 @@ def _transformer_layer_stack(ctx):
     else:
         xs = (params,)
 
-    def body(h, sl):
-        p = sl[0]
-        kk = list(sl[1]) if len(sl) > 1 else [None] * n_sites
-        slf = _attn(h, h, p, 'slf', n_head, is_decoder,
-                    None if is_decoder else key_length,
-                    rate, kk[0], is_test, mesh)
-        h = _post_process(h, slf, p, rate, kk[1], is_test, 'ln1')
-        if is_decoder:
-            cross = _attn(h, enc_out, p, 'cross', n_head, False,
-                          key_length, rate, kk[4], is_test, mesh)
-            h = _post_process(h, cross, p, rate, kk[5], is_test, 'ln2')
-        ffn = _ffn(h, p, rate, kk[2], is_test)
-        h = _post_process(h, ffn, p, rate, kk[3], is_test,
-                          'ln3' if is_decoder else 'ln2')
-        return h, None
+    # inside shard_map GSPMD constraints don't apply — drop the sp ring
+    # dispatch from the per-stage attention (pp composes with dp only)
+    attn_mesh = None if pipelined else mesh
 
-    out, _ = jax.lax.scan(body, x, xs)
+    def make_body(ext, fold):
+        # ext: this microbatch's slice of the batch-aligned side inputs
+        # (full arrays in the non-pipelined path); fold: microbatch index
+        # folded into dropout keys so masks stay per-microbatch
+        enc_m = ext.get('enc')
+        kl_m = ext.get('kl')
+
+        def body(h, sl):
+            p = sl[0]
+            kk = list(sl[1]) if len(sl) > 1 else [None] * n_sites
+            if fold is not None:
+                kk = [None if k is None else jax.random.fold_in(k, fold)
+                      for k in kk]
+            slf = _attn(h, h, p, 'slf', n_head, is_decoder,
+                        None if is_decoder else kl_m,
+                        rate, kk[0], is_test, attn_mesh)
+            h = _post_process(h, slf, p, rate, kk[1], is_test, 'ln1')
+            if is_decoder:
+                cross = _attn(h, enc_m, p, 'cross', n_head, False,
+                              kl_m, rate, kk[4], is_test, attn_mesh)
+                h = _post_process(h, cross, p, rate, kk[5], is_test, 'ln2')
+            ffn = _ffn(h, p, rate, kk[2], is_test)
+            h = _post_process(h, ffn, p, rate, kk[3], is_test,
+                              'ln3' if is_decoder else 'ln2')
+            return h, None
+
+        return body
+
+    extras = {}
+    if enc_out is not None:
+        extras['enc'] = enc_out
+    if key_length is not None:
+        extras['kl'] = key_length
+
+    if pipelined:
+        from ..parallel.pipeline import pipeline_layer_scan
+        out = pipeline_layer_scan(make_body, x, xs, mesh,
+                                  pp_conf['n_micro'], extras=extras)
+    else:
+        out, _ = jax.lax.scan(make_body(extras, None), x, xs)
     ctx.set_output('Out', out)
 
 

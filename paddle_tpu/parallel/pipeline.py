@@ -15,13 +15,19 @@ import jax
 import jax.numpy as jnp
 
 
-def pipeline(stage_fn, stage_params, microbatches, axis_name='pp'):
+def pipeline(stage_fn, stage_params, microbatches, axis_name='pp',
+             with_mb_index=False):
     """Run inside shard_map over `axis_name`.
 
     stage_fn(params, x) -> y           one pipeline stage (same shape in/out)
     stage_params: pytree whose leaves are this device's stage params
                   (leading stage dim already stripped by shard_map)
     microbatches: [n_micro, mb, ...]   replicated input microbatches
+    with_mb_index: call stage_fn(params, x, m) where m is the index of
+    the microbatch this stage processes at this tick (t - stage,
+    clamped) — lets the stage fold m into dropout PRNG keys so masks
+    stay per-microbatch, matching the semantics of one big batch split
+    into n_micro pieces.
     Returns [n_micro, mb, ...] final-stage outputs (valid on the LAST
     stage; other stages hold garbage — combine with out_specs that index
     the last shard, or psum-mask as convenient).
@@ -36,7 +42,11 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name='pp'):
         # stage 0 ingests microbatch t (clamped; masked later)
         mb = microbatches[jnp.clip(t, 0, n_micro - 1)]
         x = jnp.where(stage == 0, mb, buf)
-        y = stage_fn(stage_params, x)
+        if with_mb_index:
+            m = jnp.clip(t - stage, 0, n_micro - 1)
+            y = stage_fn(stage_params, x, m)
+        else:
+            y = stage_fn(stage_params, x)
         nxt = jax.lax.ppermute(y, axis_name, fwd_perm)
         return nxt, y
 
@@ -85,4 +95,82 @@ def pipelined_apply(stage_fn, stacked_params, x, n_micro, mesh,
         in_specs=(param_specs, P(*mb_axes)),
         out_specs=P(*mb_axes), check_vma=False)
     out = mapped(jax.tree.map(jnp.asarray, stacked_params), mb_x)
+    return out.reshape((batch,) + out.shape[2:])
+
+
+def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
+                        axis_name='pp'):
+    """Pipeline a scan-over-layers op body over `mesh`'s pp axis — the
+    Program-level pipeline path (a transformer_layer_stack op whose
+    program was transpiled with ParallelStrategy(pipeline_parallel=True)
+    lands here instead of one flat lax.scan).
+
+    The [n_layer, ...] stacked weight pytree `xs` is read as n_stages
+    contiguous chunks of n_layer/n_stages layers (shard_map splits the
+    leading axis over 'pp'); each device's stage scans its local layers,
+    activations hop stage->stage via the GPipe schedule in `pipeline`.
+    Differentiable end-to-end, so the executor's value_and_grad recovers
+    the backward pipeline and grads come back pp-sharded like their
+    params (the transpiler pins both).
+
+    make_body(ext_m, m) -> body(h, slice) builds the per-layer scan body:
+    `ext_m` is the microbatch-m slice of `extras` (batch-aligned side
+    inputs — a decoder stack's enc_out / src_length) and `m` is the
+    microbatch index, for folding into dropout keys.
+
+    x: [batch, ...] activations; batch must divide n_micro. Composes
+    with 'dp' (each microbatch's batch dim keeps its dp sharding; the
+    pipeline runs per dp group). 'sp'/'tp' inside the stage body are not
+    supported — inside shard_map GSPMD constraints don't apply, so the
+    caller must drop those axes from the attention dispatch.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh_shape = dict(mesh.shape)
+    n_stages = mesh_shape[axis_name]
+    n_layer = jax.tree.leaves(xs)[0].shape[0]
+    if n_layer % n_stages:
+        raise ValueError(
+            'pipeline_layer_scan: n_layer %d not divisible by pp=%d'
+            % (n_layer, n_stages))
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(
+            'pipeline_layer_scan: batch %d not divisible by n_micro %d'
+            % (batch, n_micro))
+    mb = batch // n_micro
+    mb_x = x.reshape((n_micro, mb) + x.shape[1:])
+    # batch-aligned side inputs are microbatched the same way; the stage
+    # picks row-block m so cross attention sees ITS examples' memory
+    mb_extras = jax.tree.map(
+        lambda e: e.reshape((n_micro, mb) + e.shape[1:]), extras)
+
+    param_specs = jax.tree.map(
+        lambda a: P(*((axis_name,) + (None,) * (a.ndim - 1))), xs)
+    dp = 'dp' if mesh_shape.get('dp', 1) > 1 and axis_name != 'dp' \
+        else None
+
+    def batch_spec(a):
+        return P(None, dp, *((None,) * (a.ndim - 2)))
+
+    def inner(local_xs, mbx, ext):
+        def stage_fn(local, h, m):
+            ext_m = jax.tree.map(lambda e: e[m], ext)
+            out, _ = jax.lax.scan(make_body(ext_m, m), h, local)
+            return out
+
+        out = pipeline(stage_fn, local_xs, mbx, axis_name,
+                       with_mb_index=True)
+        # emit only the last stage's result; zeros elsewhere so the psum
+        # over pp reconstructs the true output on every device
+        is_last = jax.lax.axis_index(axis_name) == n_stages - 1
+        out = jnp.where(is_last, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis_name)
+
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(param_specs, batch_spec(mb_x),
+                  jax.tree.map(batch_spec, mb_extras)),
+        out_specs=batch_spec(mb_x), check_vma=False)
+    out = mapped(xs, mb_x, mb_extras)
     return out.reshape((batch,) + out.shape[2:])

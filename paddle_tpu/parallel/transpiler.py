@@ -23,7 +23,8 @@ from ..core.program import Parameter
 class ParallelStrategy(object):
     def __init__(self, data_parallel=True, tensor_parallel=False,
                  sequence_parallel=False, tp_rules=None, sp_vars=None,
-                 shard_embeddings=True):
+                 shard_embeddings=True, pipeline_parallel=False,
+                 pipeline_microbatches=None):
         self.data_parallel = data_parallel
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
@@ -35,6 +36,17 @@ class ParallelStrategy(object):
         # is_distributed) — the pserver sparse-row role (go/pserver/
         # service.go) done as GSPMD gather partitioning.
         self.shard_embeddings = shard_embeddings
+        # Pipeline parallelism over the mesh 'pp' axis: the program's
+        # scan-stacked layer ops (transformer_layer_stack, built with
+        # scan_layers=True) split their [n_layer, ...] weights into
+        # contiguous stage chunks and run the GPipe microbatch schedule
+        # (parallel/pipeline.py). Reference analog: the transpiler owns
+        # program partitioning (distribute_transpiler.py:133 splits one
+        # program into trainer/pserver halves); here it partitions the
+        # layer stack across the pp axis.
+        self.pipeline_parallel = pipeline_parallel
+        # microbatches per pipeline pass (default: the pp axis size)
+        self.pipeline_microbatches = pipeline_microbatches
 
 
 def _tp_spec_for(param, rules):
@@ -91,6 +103,40 @@ def _auto_tp_specs(program):
     return specs
 
 
+def _pp_stack_specs(program, n_stages):
+    """Stage-shard the scan-stacked layer weights: every parameter input
+    of a transformer_layer_stack op gets P('pp', ...) on its leading
+    [n_layer] axis, so stage s of the GPipe schedule holds layers
+    [s*L/pp, (s+1)*L/pp) — the op lowering runs the schedule itself
+    (ops/transformer_ops.py pipelined path)."""
+    specs = {}
+    block = program.global_block()
+    found_stack = False
+    for op in block.ops:
+        if op.type != 'transformer_layer_stack':
+            continue
+        found_stack = True
+        for slot, names in op.inputs.items():
+            if slot in ('X', 'EncOut', 'SrcLength'):
+                continue
+            for n in names:
+                v = block._find_var_recursive(n)
+                if not isinstance(v, Parameter):
+                    continue
+                if v.shape[0] % n_stages:
+                    raise ValueError(
+                        'pipeline_parallel: stacked param %r has '
+                        'n_layer=%d, not divisible by pp=%d'
+                        % (n, v.shape[0], n_stages))
+                specs[n] = P(*(['pp'] + [None] * (len(v.shape) - 1)))
+    if not found_stack:
+        raise ValueError(
+            'pipeline_parallel requires scan-stacked layers: build the '
+            'model with scan_layers=True (transformer_layer_stack ops) '
+            'so the transpiler can partition the stack into pp stages')
+    return specs
+
+
 def _row_shard_axis(mesh):
     """Mesh axis for embedding row-sharding: prefer the model-parallel
     axis (rows stay put while dp batches move), fall back to dp."""
@@ -134,12 +180,24 @@ def transpile(program, mesh, strategy=None):
     if strategy.tensor_parallel and not strategy.tp_rules:
         auto_tp = _auto_tp_specs(program)
 
+    pp_specs = {}
+    if strategy.pipeline_parallel:
+        n_pp = dict(mesh.shape).get('pp', 1)
+        if n_pp <= 1:
+            raise ValueError(
+                'pipeline_parallel=True but the mesh has no pp axis > 1 '
+                '(mesh shape %s) — build it with make_mesh(pp=n_stages)'
+                % dict(mesh.shape))
+        pp_specs = _pp_stack_specs(program, n_pp)
+        program.pipeline = {
+            'n_micro': int(strategy.pipeline_microbatches or n_pp)}
+
     for var in program.list_vars():
         if var.shape is None:
             continue
         if isinstance(var, Parameter):
-            spec = None
-            if strategy.tensor_parallel:
+            spec = pp_specs.get(var.name)
+            if spec is None and strategy.tensor_parallel:
                 spec = _tp_spec_for(var, strategy.tp_rules) \
                     if strategy.tp_rules else auto_tp.get(var.name)
             if spec is None:
