@@ -14,14 +14,63 @@ from .program import Parameter
 
 GRAD_SUFFIX = '@GRAD'
 
+# env key of the zero "row seed" added to a sparse-grad lookup's output:
+# differentiating w.r.t. the seed yields the O(batch x dim) row gradient
+# (the reference's SelectedRows, lookup_table_op.cc:119-127) without ever
+# materializing an O(vocab) dense table gradient.
+SPARSE_SEED_PREFIX = '~sparse_seed~'
+
 
 def grad_var_name(name):
     return name + GRAD_SUFFIX
 
 
+def _sparse_grad_lookups(block, params):
+    """{param name: {'ids', 'out'}} for every parameter eligible for
+    row-sparse gradients: flagged by layers.embedding(is_sparse=True),
+    read by exactly ONE lookup_table op — counted across ALL blocks, so
+    a second use inside a while/rnn sub-block disqualifies rather than
+    silently dropping its grad contribution — whose Ids are available at
+    step start (fed data or persistable state), with no regularizer or
+    clip anywhere in scope (per-param attrs here; optimizer-level
+    regularization and program-level set_gradient_clip are checked by
+    the caller — both rewrite grads against the dense shape).
+    Ineligible tables silently take the exact dense path."""
+    eligible = {}
+    program = block.program
+    program_clip = getattr(program, '_gradient_clip_attr', None)
+    flagged = {p.name for p in params if getattr(p, 'sparse_grad', False)
+               and p.regularizer is None and program_clip is None
+               and getattr(p, 'gradient_clip_attr', None) is None}
+    if not flagged:
+        return eligible
+    uses = {}
+    for b in program.blocks:
+        for op in b.ops:
+            for n in op.input_names():
+                if n in flagged:
+                    uses.setdefault(n, []).append(op)
+    for name, ops in uses.items():
+        if len(ops) != 1 or ops[0].type != 'lookup_table':
+            continue
+        op = ops[0]
+        ids_name = op.inputs['Ids'][0]
+        ids_var = block._find_var_recursive(ids_name)
+        if ids_var is None or not (ids_var.is_data or ids_var.persistable):
+            continue
+        eligible[name] = {'ids': ids_name, 'out': op.outputs['Out'][0]}
+    return eligible
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None):
+                    callbacks=None, sparse_supported=False):
     """Append the backward section for ``loss``.
+
+    sparse_supported: the calling optimizer's update op can consume
+    row-sparse gradients (SGD/Adagrad scatter rows in place); eligible
+    embedding tables then get [n_ids, dim] row grads instead of dense
+    [vocab, dim] — the SelectedRows role of lookup_table_grad
+    (reference lookup_table_op.cc:119-127) under whole-program jit.
 
     Returns list of (param_var, grad_var) like the reference.
     """
@@ -42,10 +91,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if not params:
         raise ValueError('append_backward: no trainable parameters found')
 
+    sparse = _sparse_grad_lookups(block, params) if sparse_supported else {}
+
     params_and_grads = []
     for p in params:
-        g = block.create_var(name=grad_var_name(p.name), shape=p.shape,
-                             dtype=p.dtype)
+        if p.name in sparse:
+            # runtime shape is [n_ids, dim] (batch-dependent)
+            g = block.create_var(name=grad_var_name(p.name),
+                                 shape=(-1, p.shape[-1]), dtype=p.dtype)
+            g.sparse_ids = sparse[p.name]['ids']
+        else:
+            g = block.create_var(name=grad_var_name(p.name), shape=p.shape,
+                                 dtype=p.dtype)
         g.stop_gradient = True
         params_and_grads.append((p, g))
 
@@ -55,5 +112,6 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         outputs={'Grads': [g.name for _, g in params_and_grads]},
         attrs={'param_names': [p.name for p, _ in params_and_grads],
                'grad_names': [g.name for _, g in params_and_grads],
-               'loss_name': loss.name})
+               'loss_name': loss.name,
+               'sparse_grads': sparse})
     return params_and_grads

@@ -495,16 +495,34 @@ class Executor(object):
             env.update(scope_vals)
 
             if marker_idx is not None:
+                import jax.numpy as _jnp
+                from .backward import SPARSE_SEED_PREFIX
                 pre = ops[:marker_idx]
                 marker = ops[marker_idx]
                 post = ops[marker_idx + 1:]
                 param_names = marker.attrs['param_names']
                 grad_names = marker.attrs['grad_names']
                 loss_name = marker.attrs['loss_name']
+                sparse_info = marker.attrs.get('sparse_grads') or {}
 
+                # sparse-grad tables are NOT differentiated (they stay
+                # in base_env; the lookup lowering detaches them) — a
+                # zero row seed shaped like the lookup OUTPUT becomes
+                # the leaf instead, so its grad is O(batch x dim) rows,
+                # never an O(vocab) dense table grad
+                dense_names = [n for n in param_names
+                               if n not in sparse_info]
                 base_env = {k: v for k, v in env.items()
-                            if k not in set(param_names)}
-                params = {n: env[n] for n in param_names}
+                            if k not in set(dense_names)}
+                params = {n: env[n] for n in dense_names}
+                for pname, info in sparse_info.items():
+                    ids = env[info['ids']]
+                    ids_shape = ids.shape[:-1] \
+                        if ids.ndim >= 2 and ids.shape[-1] == 1 \
+                        else ids.shape
+                    params[SPARSE_SEED_PREFIX + info['out']] = _jnp.zeros(
+                        ids_shape + (env[pname].shape[-1],),
+                        env[pname].dtype)
 
                 # Only values consumed after the backward boundary may
                 # escape the forward — anything else would be saved as a
@@ -532,7 +550,12 @@ class Executor(object):
                     fwd, has_aux=True)(params)
                 env.update(kept)
                 for pn, gn in zip(param_names, grad_names):
-                    env[gn] = grads[pn]
+                    if pn in sparse_info:
+                        rows = grads[SPARSE_SEED_PREFIX +
+                                     sparse_info[pn]['out']]
+                        env[gn] = rows.reshape(-1, rows.shape[-1])
+                    else:
+                        env[gn] = grads[pn]
                 env = run_ops(post, env, base_key,
                               start_index=marker_idx + 1)
             else:

@@ -98,7 +98,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype)
     if is_sparse or is_distributed:
-        w.row_shard = True  # consumed by parallel.transpiler
+        w.row_shard = True    # consumed by parallel.transpiler
+        w.sparse_grad = True  # row-sparse grads (core/backward.py)
     out = helper.create_variable_for_type_inference(dtype)
     in_shape = input.shape
     if in_shape is not None:

@@ -13,16 +13,31 @@ from ..core.registry import register
 @register('lookup_table')
 def _lookup_table(ctx):
     """Embedding lookup (lookup_table_op.cc). On TPU a dense gather —
-    XLA lowers to an efficient dynamic-gather on HBM; sparse-grad is
-    unnecessary because the grad is computed by XLA scatter-add."""
+    XLA lowers to an efficient dynamic-gather on HBM.
+
+    Sparse gradients (the reference's SelectedRows path,
+    lookup_table_op.cc:119-127): when the executor planted a zero "row
+    seed" for this lookup's output (is_sparse tables under an
+    SGD/Adagrad minimize), the table itself is detached and the seed —
+    shaped like the OUTPUT, O(batch x dim) — carries the gradient; the
+    optimizer op scatters those rows into the table in place. A
+    1e8-row CTR table then never materializes a 1e8-row grad."""
+    from ..core.backward import SPARSE_SEED_PREFIX
     w = ctx.input('W')
     ids = ctx.input('Ids')
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     if squeeze_last:
         ids = ids.squeeze(-1)
     padding_idx = ctx.attr('padding_idx', -1)
+    seed = ctx.env.get(SPARSE_SEED_PREFIX + ctx.op.output('Out'))
+    if seed is not None:
+        w = jax.lax.stop_gradient(w)
     out = jnp.take(w, ids, axis=0)
+    if seed is not None:
+        out = out + seed.reshape(out.shape)
     if padding_idx is not None and padding_idx >= 0:
+        # mask AFTER the seed add so padding rows' seed grads zero out
+        # exactly like the dense grad's masked rows
         mask = (ids != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)
     ctx.set_output('Out', out)

@@ -18,11 +18,58 @@ def _lr(ctx):
     return lr.reshape(()) if hasattr(lr, 'reshape') else lr
 
 
+def _sparse_rows(ctx, g):
+    """(flat_ids, rows) when this op's Grad is a row-sparse embedding
+    gradient (g.sparse_ids annotation from append_backward), else None.
+    rows: [n_ids, dim] — one gradient row per id OCCURRENCE; duplicate
+    ids are legal (scatter-add merges linearly; adagrad merges runs
+    first). The reference analog is the SelectedRows branch of
+    sgd_op.cc / adagrad_op.cc."""
+    gvar = ctx.block._find_var_recursive(ctx.op.input('Grad'))
+    ids_name = getattr(gvar, 'sparse_ids', None) if gvar is not None \
+        else None
+    if ids_name is None:
+        return None
+    ids = ctx.env[ids_name]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    flat = ids.reshape(-1).astype(jnp.int32)
+    return flat, g.reshape(flat.shape[0], -1)
+
+
+def _merge_duplicate_rows(flat, rows):
+    """Merge duplicate-id rows: (rep_ids, merged, valid) where each RUN
+    of equal ids (after sort) contributes one representative id and the
+    sum of its rows; padding segments have valid=False and merged=0 (the
+    SelectedRows merge_add the reference applies before any non-linear
+    update). O(n log n) sort + O(n x dim) — never touches vocab rows."""
+    import jax
+    n = flat.shape[0]
+    order = jnp.argsort(flat)
+    sids = flat[order]
+    srows = rows[order]
+    start = jnp.concatenate([jnp.ones((1,), bool), sids[1:] != sids[:-1]])
+    run = jnp.cumsum(start) - 1                  # run index per row
+    merged = jax.ops.segment_sum(srows, run, num_segments=n)
+    rep = jax.ops.segment_max(sids, run, num_segments=n)
+    valid = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), run,
+                                num_segments=n) > 0
+    rep = jnp.where(valid, rep, 0)               # safe index; delta is 0
+    return rep, merged, valid
+
+
 @register('sgd')
 def _sgd(ctx):
     p = ctx.input('Param')
     g = ctx.input('Grad')
     lr = _lr(ctx)
+    sparse = _sparse_rows(ctx, g)
+    if sparse is not None:
+        # linear update: scatter-add merges duplicate ids exactly
+        flat, rows = sparse
+        out = p.at[flat].add((-lr * rows).astype(p.dtype), mode='drop')
+        ctx.set_output('ParamOut', out)
+        return
     ctx.set_output('ParamOut', (p - lr * g).astype(p.dtype))
 
 
@@ -79,6 +126,23 @@ def _adagrad(ctx):
     m = ctx.input('Moment')
     lr = _lr(ctx)
     eps = ctx.attr('epsilon', 1e-6)
+    sparse = _sparse_rows(ctx, g)
+    if sparse is not None:
+        # non-linear in the grad: merge duplicate ids first (the
+        # reference's SelectedRows merge_add in adagrad_op.h), then
+        # update only the touched rows — exact vs the dense path
+        flat, rows = sparse
+        rep, merged, valid = _merge_duplicate_rows(flat, rows)
+        old_m = jnp.take(m, rep, axis=0)
+        new_m = old_m + jnp.square(merged)
+        dm = jnp.where(valid[:, None], new_m - old_m, 0.0)
+        dp = jnp.where(valid[:, None],
+                       lr * merged / (jnp.sqrt(new_m) + eps), 0.0)
+        ctx.set_output('MomentOut',
+                       m.at[rep].add(dm.astype(m.dtype), mode='drop'))
+        ctx.set_output('ParamOut',
+                       p.at[rep].add(-dp.astype(p.dtype), mode='drop'))
+        return
     m_out = m + jnp.square(g)
     p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     ctx.set_output('MomentOut', m_out.astype(m.dtype))

@@ -23,6 +23,14 @@ __all__ = ['SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
 
 
 class Optimizer(object):
+    # True on optimizers whose update op can consume row-sparse embedding
+    # gradients (scatter rows in place of a dense [vocab, dim] grad —
+    # the reference's SelectedRows path, lookup_table_op.cc:119-127).
+    # SGD and Adagrad support it exactly, like the reference pserver;
+    # moment-decay optimizers (Adam & co.) decay EVERY row every step,
+    # so they take the dense path for exactness.
+    _supports_sparse_update = False
+
     def __init__(self, learning_rate, regularization=None, name=None):
         if not isinstance(learning_rate, (float, Variable)):
             raise TypeError('learning_rate must be float or Variable')
@@ -125,8 +133,12 @@ class Optimizer(object):
                 default_startup_program()
         from .core.program import program_guard
         with program_guard(main_program, startup_program):
-            params_grads = append_backward(loss, parameter_list,
-                                           no_grad_set)
+            # optimizer-level regularization applies to EVERY param and
+            # is written against the dense grad shape — disable sparse
+            params_grads = append_backward(
+                loss, parameter_list, no_grad_set,
+                sparse_supported=(self._supports_sparse_update and
+                                  self.regularization is None))
             params_grads = append_gradient_clip_ops(params_grads)
             params_grads = append_regularization_ops(params_grads,
                                                      self.regularization)
@@ -148,6 +160,8 @@ class Optimizer(object):
 
 
 class SGDOptimizer(Optimizer):
+    _supports_sparse_update = True
+
     def _append_optimize_op(self, block, param_and_grad):
         param, grad = param_and_grad
         return block.append_op(
@@ -184,6 +198,7 @@ class MomentumOptimizer(Optimizer):
 
 
 class AdagradOptimizer(Optimizer):
+    _supports_sparse_update = True
     _moment_acc_str = 'moment'
 
     def __init__(self, learning_rate, epsilon=1e-6, **kwargs):
@@ -499,9 +514,16 @@ class GradientAccumulator(object):
         if k == 1:
             return inner.minimize(loss, startup_program, parameter_list,
                                   no_grad_set)
-        main_program, startup_program, params_grads = \
-            inner._minimize_prologue(loss, startup_program,
-                                     parameter_list, no_grad_set)
+        # row-sparse embedding grads cannot accumulate across micro steps
+        # (each step's [n_ids, dim] rows index different ids) — force the
+        # exact dense path for the gated region
+        inner.__dict__['_supports_sparse_update'] = False
+        try:
+            main_program, startup_program, params_grads = \
+                inner._minimize_prologue(loss, startup_program,
+                                         parameter_list, no_grad_set)
+        finally:
+            inner.__dict__.pop('_supports_sparse_update', None)
         block = main_program.global_block()
         with program_guard(main_program, startup_program):
             helper = LayerHelper('grad_accum')
