@@ -171,18 +171,50 @@ def load_inference_model(dirname, executor, model_filename=None,
     return program, meta['feed_names'], fetch_vars
 
 
-def save_checkpoint(executor, dirname, main_program=None, step=None):
-    """Full training checkpoint: every persistable incl. optimizer state."""
+def save_checkpoint(executor, dirname, main_program=None, step=None,
+                    reader=None):
+    """Full training checkpoint: every persistable incl. optimizer state.
+
+    reader: a reader.CheckpointableReader — its (epoch, offset, seed)
+    is persisted alongside, so load_checkpoint resumes the data stream
+    mid-epoch with exactly the untrained remainder (the reference data
+    master's etcd task-queue recovery, go/master/service.go:165-213,
+    done masterless via deterministic replay)."""
     save_persistables(executor, dirname, main_program)
+    meta = {}
     if step is not None:
-        with open(os.path.join(dirname, 'checkpoint.json'), 'w') as f:
-            json.dump({'step': int(step)}, f)
+        meta['step'] = int(step)
+    if reader is not None:
+        meta['reader'] = reader.state_dict()
+    if meta:
+        import jax
+        # single writer, like save_persistables; positional sharding
+        # advances every host's reader identically, so process 0's
+        # (epoch, offset) is valid for all shards
+        if jax.process_index() == 0:
+            with open(os.path.join(dirname, 'checkpoint.json'), 'w') as f:
+                json.dump(meta, f)
 
 
-def load_checkpoint(executor, dirname, main_program=None):
+def load_checkpoint(executor, dirname, main_program=None, reader=None):
     load_persistables(executor, dirname, main_program)
     path = os.path.join(dirname, 'checkpoint.json')
-    if os.path.exists(path):
-        with open(path) as f:
-            return json.load(f).get('step')
-    return None
+    if not os.path.exists(path):
+        if reader is not None:
+            raise ValueError(
+                'load_checkpoint: a reader was passed but %r holds no '
+                'checkpoint.json — resuming would silently re-consume '
+                'already-trained data (was save_checkpoint called with '
+                'reader=...?)' % dirname)
+        return None
+    with open(path) as f:
+        meta = json.load(f)
+    if reader is not None:
+        state = meta.get('reader')
+        if state is None:
+            raise ValueError(
+                'load_checkpoint: a reader was passed but %r holds no '
+                'reader state (was save_checkpoint called with '
+                'reader=...?)' % dirname)
+        reader.load_state_dict(state)
+    return meta.get('step')

@@ -9,6 +9,7 @@ from .decorator import (batch, buffered, cache, chain, compose,  # noqa
                         firstn, map_readers, shard, shuffle, xmap_readers)
 from .decorator import prefetch_to_device  # noqa: F401
 from .staging import staged_superbatch  # noqa: F401
+from .state import CheckpointableReader, checkpointable  # noqa: F401
 from .recordio import (example_dtype, recordio_superbatch,  # noqa: F401
                        write_example_recordio)
 from . import creator  # noqa: F401

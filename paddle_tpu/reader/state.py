@@ -1,0 +1,95 @@
+"""Checkpointable reader state: mid-epoch resume.
+
+Reference analog: go/master/service.go:165-213 — the data master
+persists its task queue to etcd and recovers mid-epoch on failover, so
+resumed training sees exactly the untrained remainder. TPU-native /
+masterless design: instead of storing a task queue, the wrapper makes
+the epoch stream DETERMINISTIC (per-epoch shuffle seed chained from a
+base seed) and records only (epoch, offset); resume replays the same
+epoch order and skips the consumed prefix — recompute-over-store, the
+same trade the executor makes with rematerialization.
+
+Pairs with io.save_checkpoint(..., reader=...) / load_checkpoint(...,
+reader=...). Under multihost positional sharding every process consumes
+the same NUMBER of items per step, so the single-writer checkpoint's
+(epoch, offset) applies to every host's shard reader.
+"""
+
+import random
+
+__all__ = ['checkpointable', 'CheckpointableReader']
+
+
+class CheckpointableReader(object):
+    """Wrap a reader factory with resumable position state.
+
+    reader: nullary callable yielding one epoch of items.
+    shuffle_buf: optional buffered shuffle INSIDE the wrapper (use this
+        instead of reader.shuffle — the global-RNG decorator is not
+        replayable) with a per-epoch rng seeded (seed, epoch), so epoch
+        k's order is identical on replay.
+    seed: base seed for the per-epoch shuffle chain.
+
+    Each __call__ yields the remainder of the current epoch (all of it
+    when offset == 0) and advances (epoch, offset) as items are
+    consumed; a generator abandoned mid-epoch leaves offset at the
+    consumed count, which is exactly what state_dict() then captures.
+    """
+
+    def __init__(self, reader, shuffle_buf=0, seed=0):
+        self._base = reader
+        self._buf = int(shuffle_buf)
+        self._seed = int(seed)
+        self.epoch = 0
+        self.offset = 0
+
+    def _epoch_stream(self):
+        if not self._buf:
+            for e in self._base():
+                yield e
+            return
+        rng = random.Random((self._seed * 1000003) ^ self.epoch)
+        buf = []
+        for e in self._base():
+            buf.append(e)
+            if len(buf) >= self._buf:
+                rng.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            for b in buf:
+                yield b
+
+    def __call__(self):
+        skip = self.offset
+        for i, e in enumerate(self._epoch_stream()):
+            if i < skip:
+                continue    # replayed prefix: already trained on
+            self.offset = i + 1
+            yield e
+        self.epoch += 1
+        self.offset = 0
+
+    # ------------------------------------------------------------ state
+    def state_dict(self):
+        return {'epoch': int(self.epoch), 'offset': int(self.offset),
+                'seed': self._seed, 'shuffle_buf': self._buf}
+
+    def load_state_dict(self, state):
+        if int(state.get('seed', self._seed)) != self._seed or \
+                int(state.get('shuffle_buf', self._buf)) != self._buf:
+            raise ValueError(
+                'reader state was saved with seed=%s shuffle_buf=%s but '
+                'this reader has seed=%s shuffle_buf=%s — the replayed '
+                'epoch order would differ from the trained one'
+                % (state.get('seed'), state.get('shuffle_buf'),
+                   self._seed, self._buf))
+        self.epoch = int(state['epoch'])
+        self.offset = int(state['offset'])
+
+
+def checkpointable(reader, shuffle_buf=0, seed=0):
+    """Decorator form: reader.checkpointable(r, shuffle_buf=1024)."""
+    return CheckpointableReader(reader, shuffle_buf=shuffle_buf, seed=seed)

@@ -76,3 +76,47 @@ def test_checkpoint_roundtrip(tmp_path):
     assert step == 3
     np.testing.assert_allclose(
         np.asarray(fluid.global_scope().find('w')), w0)
+
+
+def test_reader_state_kill_and_resume(tmp_path):
+    """Mid-epoch resume (reference go/master/service.go:165-213 task
+    recovery): kill after k batches, resume from the checkpoint, and the
+    resumed stream replays EXACTLY the untrained remainder — no item
+    re-seen, none skipped."""
+    from paddle_tpu.reader import CheckpointableReader
+    items = list(range(20))
+
+    def base():
+        return iter(items)
+
+    reader = CheckpointableReader(base, shuffle_buf=8, seed=42)
+    full_epoch = list(CheckpointableReader(base, shuffle_buf=8, seed=42)())
+    assert sorted(full_epoch) == items      # a permutation of the data
+
+    gen = reader()
+    seen = [next(gen) for _ in range(7)]    # ... then the process dies
+    gen.close()
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe, steps=1)
+    fluid.io.save_checkpoint(exe, str(tmp_path), step=7, reader=reader)
+
+    resumed = CheckpointableReader(base, shuffle_buf=8, seed=42)
+    step = fluid.io.load_checkpoint(exe, str(tmp_path), reader=resumed)
+    assert step == 7
+    rest = list(resumed())
+    assert seen + rest == full_epoch        # exactly the remainder
+    # the NEXT epoch reshuffles (different seed chain) but stays complete
+    nxt = list(resumed())
+    assert sorted(nxt) == items
+    assert nxt != full_epoch
+
+
+def test_reader_state_mismatched_seed_rejected(tmp_path):
+    from paddle_tpu.reader import CheckpointableReader
+    r = CheckpointableReader(lambda: iter(range(5)), shuffle_buf=4, seed=1)
+    state = r.state_dict()
+    other = CheckpointableReader(lambda: iter(range(5)), shuffle_buf=4,
+                                 seed=2)
+    import pytest
+    with pytest.raises(ValueError, match='seed'):
+        other.load_state_dict(state)
