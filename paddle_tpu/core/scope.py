@@ -60,8 +60,20 @@ class Scope(object):
 
 _global_scope = Scope()
 
+# scope_guard overrides are per-THREAD: concurrent embedded-ABI clients
+# (native/capi.cpp — two predictors loading models on two pthreads) must
+# not see each other's guarded scopes, or loads write parameters into
+# the wrong predictor's store. Single-thread semantics are unchanged:
+# with no active guard on this thread, global_scope() is process-global.
+import threading as _threading
+
+_tls = _threading.local()
+
 
 def global_scope():
+    stack = getattr(_tls, 'scope_stack', None)
+    if stack:
+        return stack[-1]
     return _global_scope
 
 
@@ -70,12 +82,13 @@ def scope_guard(scope):
 
     @contextlib.contextmanager
     def _guard():
-        global _global_scope
-        old = _global_scope
-        _global_scope = scope
+        stack = getattr(_tls, 'scope_stack', None)
+        if stack is None:
+            stack = _tls.scope_stack = []
+        stack.append(scope)
         try:
             yield
         finally:
-            _global_scope = old
+            stack.pop()
 
     return _guard()

@@ -13,7 +13,9 @@
 
 #include <Python.h>
 
+#include <atomic>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -51,7 +53,11 @@ struct Predictor {
   std::vector<paddle_tensor> tensors;
 };
 
-bool g_initialized = false;
+// Lazy init may race: the thread contract allows concurrent first
+// paddle_predictor_create calls, and Py_InitializeEx must run exactly
+// once BEFORE any PyGILState_Ensure — serialize the whole init.
+std::mutex g_init_mutex;
+std::atomic<bool> g_initialized{false};
 
 size_t dtype_size(paddle_dtype d) {
   switch (d) {
@@ -79,6 +85,7 @@ class GIL {
 extern "C" {
 
 paddle_error paddle_tpu_init(const char* platform) {
+  std::lock_guard<std::mutex> init_lock(g_init_mutex);
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     // Release the GIL taken by Py_InitializeEx so every later entry

@@ -7,7 +7,25 @@
  * gets the same AOT-compiled inference path Python users get. Repeated
  * runs with a stable input signature are cached XLA dispatches.
  *
- * Thread model: calls are serialized on the embedded interpreter's GIL.
+ * Compilation cache: the predictor compiles one executable per input
+ * SIGNATURE (shapes + dtypes) and keeps all of them. The first run with
+ * a new batch size pays a fresh XLA compile (seconds); later runs with
+ * any previously-seen signature are pure dispatches. Serving tip: batch
+ * to a small fixed set of sizes (pad the tail batch) rather than
+ * feeding every ragged size.
+ *
+ * Thread model (contract, tested by tests/test_capi.py's concurrent
+ * client — reference analog: capi/examples/model_inference/multi_thread):
+ *   - The library is thread-safe ACROSS predictors: any number of
+ *     threads may create/run/destroy DISTINCT predictors concurrently;
+ *     calls serialize internally on the embedded interpreter's GIL
+ *     (device compute may release it, so runs can overlap on-device).
+ *   - A single predictor is NOT thread-safe: its output buffers are
+ *     per-predictor state overwritten by each run, so concurrent runs
+ *     on the SAME predictor may interleave and swap results. Serialize
+ *     externally, or use one predictor per thread (each predictor
+ *     AOT-compiles its own executable on first run for its feed
+ *     signature).
  * Output buffers are owned by the predictor and stay valid until the next
  * paddle_predictor_run / paddle_predictor_destroy on that predictor.
  */

@@ -104,6 +104,96 @@ def test_c_client_classifies(tmp_path):
     assert lines[-1] == 'OK'
 
 
+C_CONCURRENT = r'''
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include "capi.h"
+
+/* Two threads, one predictor EACH (the documented thread contract),
+ * running concurrently with thread-distinct inputs; every iteration
+ * checks the outputs belong to THIS thread's input. */
+
+#define ITERS 8
+
+typedef struct { const char* model; float sign; int failures; } job_t;
+
+static void* worker(void* arg) {
+  job_t* job = (job_t*)arg;
+  paddle_predictor pred;
+  if (paddle_predictor_create(job->model, &pred) != kPD_NO_ERROR) {
+    fprintf(stderr, "create failed: %s\n", paddle_last_error_message());
+    job->failures = ITERS;
+    return NULL;
+  }
+  for (int it = 0; it < ITERS; it++) {
+    float x[2 * 4];
+    /* sign=+1 -> rows sum +4/-4; sign=-1 -> rows sum -4/+4 */
+    for (int i = 0; i < 8; i++)
+      x[i] = ((i < 4) ? 1.0f : -1.0f) * job->sign;
+    paddle_tensor in;
+    in.dtype = PD_FLOAT32; in.ndim = 2;
+    in.shape[0] = 2; in.shape[1] = 4; in.data = x;
+    const char* names[] = {"x"};
+    if (paddle_predictor_run(pred, 1, names, &in) != kPD_NO_ERROR) {
+      job->failures++; continue;
+    }
+    paddle_tensor out;
+    if (paddle_predictor_output(pred, 0, &out) != kPD_NO_ERROR) {
+      job->failures++; continue;
+    }
+    const float* p = (const float*)out.data;
+    int want_row0 = job->sign > 0 ? 1 : 0;   /* class1 iff sum(x) > 0 */
+    int got_row0 = p[1] > p[0] ? 1 : 0;
+    int got_row1 = p[3] > p[2] ? 1 : 0;
+    if (got_row0 != want_row0 || got_row1 != 1 - want_row0)
+      job->failures++;
+  }
+  paddle_predictor_destroy(pred);
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (paddle_tpu_init("cpu") != kPD_NO_ERROR) return 1;
+  job_t jobs[2] = {{argv[1], 1.0f, 0}, {argv[1], -1.0f, 0}};
+  pthread_t ts[2];
+  for (int i = 0; i < 2; i++) pthread_create(&ts[i], NULL, worker, &jobs[i]);
+  for (int i = 0; i < 2; i++) pthread_join(ts[i], NULL);
+  printf("failures=%d,%d\n", jobs[0].failures, jobs[1].failures);
+  if (jobs[0].failures || jobs[1].failures) return 1;
+  printf("OK\n");
+  return 0;
+}
+'''
+
+
+@pytest.mark.skipif(sys.platform != 'linux', reason='embed build is linux')
+def test_c_client_concurrent_predictors(tmp_path):
+    """The capi.h thread contract: two predictors on two pthreads run
+    concurrently; each thread's outputs always match its own inputs
+    (reference: capi/examples/model_inference/multi_thread)."""
+    from paddle_tpu.native import build_capi
+    model_dir = str(tmp_path / 'model')
+    _save_tiny_classifier(model_dir)
+
+    so = build_capi()
+    src = tmp_path / 'client_mt.c'
+    src.write_text(C_CONCURRENT)
+    exe_path = str(tmp_path / 'client_mt')
+    subprocess.run(
+        ['gcc', str(src), '-I', os.path.join(REPO, 'paddle_tpu', 'native'),
+         so, '-lpthread', '-o', exe_path,
+         '-Wl,-rpath,' + os.path.dirname(so)],
+        check=True, capture_output=True)
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    r = subprocess.run([exe_path, model_dir], capture_output=True,
+                       text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.strip().splitlines()[-1] == 'OK'
+
+
 def test_capi_via_ctypes_repeated_runs(tmp_path):
     """Drive the C ABI through ctypes from the host process: repeated
     runs reuse the cached executable and outputs stay stable; error
