@@ -12,6 +12,10 @@ Strategies:
   tensor-parallel : fc/embedding weights column/row split on 'tp' by the
                     megatron pairing rule (column then row per block).
   sequence        : time dim of long activations -> 'sp' (ring attention).
+  pipeline        : scan-stacked layer weights stage-sharded on 'pp'; the
+                    layer-stack op runs the GPipe microbatch schedule
+                    (pipeline.py) inside the jitted step.
+  expert          : [E, ...] expert weights on 'ep' (set by switch_moe).
 """
 
 from jax.sharding import PartitionSpec as P

@@ -337,6 +337,33 @@ def test_program_pipeline_matches_single_device():
     np.testing.assert_allclose(pp_dp, base, rtol=2e-4, atol=1e-5)
 
 
+def test_program_pipeline_composes_with_run_steps():
+    """The pipelined step under Executor.run_steps (shard_map inside the
+    multi-step lax.scan): trajectory equals per-step dispatch."""
+    from paddle_tpu.models import transformer as T
+    mesh = make_mesh(dp=1, pp=2)
+    strat = ParallelStrategy(data_parallel=False, pipeline_parallel=True)
+
+    per_step = _train_scan_transformer(mesh=mesh, strategy=strat, steps=4,
+                                       n_layer=2)
+
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    fluid.default_main_program().random_seed = 7
+    avg_cost, _ = T.transformer_base(
+        src_vocab_size=64, trg_vocab_size=64, src_seq_len=8, trg_seq_len=8,
+        n_layer=2, d_model=16, d_inner=32, d_key=8, d_value=8,
+        n_head=2, dropout_rate=0.0, scan_layers=True)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    transpile(fluid.default_main_program(), mesh, strat)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
+    out = exe.run_steps(4, feed=feed, fetch_list=[avg_cost])
+    windowed = np.asarray(out[0]).reshape(-1).tolist()
+    np.testing.assert_allclose(windowed, per_step, rtol=2e-4, atol=1e-5)
+
+
 def test_program_pipeline_with_dropout_runs():
     """Dropout keys fold the microbatch index (masks per microbatch);
     trajectory differs from single-device by design — train steps must
