@@ -168,13 +168,18 @@ def bench_resnet50(batch=64, image=224, iters=20):
     return batch / dt
 
 
-def resnet_step_anatomy(batch=64, image=224, iters=10):
+def resnet_step_anatomy_phases(batch=64, image=224, iters=10):
     """ResNet-50 step anatomy (VERDICT r3 #2: the bwd gap): fwd-only
     vs full-step wall time on identical shapes, plus the compiled step's
     XLA cost analysis (flops / bytes accessed). detail math: if
     bytes_per_step / step_time approaches the chip's HBM bandwidth
     (~819 GB/s on v5e), the residual bwd gap is a memory-bandwidth
-    floor, not a schedulable loss. Returns a JSON-able dict."""
+    floor, not a schedulable loss.
+
+    Yields the growing dict once per phase — measured wall times first,
+    cost analysis (a third full compile, the hang-prone part on the
+    relay) last — so the caller can emit intermediate results that
+    survive a watchdog kill."""
     import jax
 
     out = {'batch': batch}
@@ -187,6 +192,7 @@ def resnet_step_anatomy(batch=64, image=224, iters=10):
     out['step_ms'] = round(
         _time_multi(exe, feed, [cost], iters) * 1e3, 2)
     out['bwd_update_ms'] = round(out['step_ms'] - out['fwd_ms'], 2)
+    yield dict(out)
 
     # XLA cost analysis of the one-step compiled train fn
     try:
@@ -208,7 +214,7 @@ def resnet_step_anatomy(batch=64, image=224, iters=10):
                 byts / (out['step_ms'] * 1e-3) / 1e9, 1)
     except Exception as e:  # cost analysis is best-effort
         out['cost_analysis_error'] = str(e)[:200]
-    return out
+    yield out
 
 
 def attention_microbench(batch_tokens=4096, d=64, heads=8, inner=8,
@@ -309,8 +315,12 @@ def _run_workload_child(workload, backend, reduced):
         return
     if workload == 'resnet50_anatomy':
         kw = dict(batch=4, image=64, iters=3) if reduced else {}
-        print('RESULT_JSON %s' % json.dumps(resnet_step_anatomy(**kw)),
-              flush=True)
+        # emitted per-phase: the wall-time split prints before the
+        # best-effort cost analysis, so a compile hang in the latter
+        # can't take the measured numbers down with the watchdog (the
+        # parent keeps the LAST complete line it sees)
+        for partial in resnet_step_anatomy_phases(**kw):
+            print('RESULT_JSON %s' % json.dumps(partial), flush=True)
         return
     if workload == 'attention_microbench':
         kw = {}
@@ -359,16 +369,30 @@ def _run_workload(workload, backend, reduced, timeout, env=None):
         cmd.append('--reduced')
     child_env = dict(os.environ)
     child_env.update(env or {})
+    def last_result(stdout):
+        for line in reversed((stdout or '').splitlines()):
+            if line.startswith('RESULT_JSON '):
+                return json.loads(line[len('RESULT_JSON '):])
+            if line.startswith('RESULT '):
+                return float(line[len('RESULT '):])
+        return None
+
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=child_env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the child may have printed a (partial) result before hanging
+        # in a later best-effort phase — salvage it rather than lose
+        # measured numbers to the watchdog
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or '')
+        val = last_result(stdout)
+        if val is not None:
+            return val, None
         return None, 'timeout after %.0fs' % timeout
-    for line in reversed((r.stdout or '').splitlines()):
-        if line.startswith('RESULT_JSON '):
-            return json.loads(line[len('RESULT_JSON '):]), None
-        if line.startswith('RESULT '):
-            return float(line[len('RESULT '):]), None
+    val = last_result(r.stdout)
+    if val is not None:
+        return val, None
     return None, ('rc=%s: %s' % (r.returncode,
                                  (r.stderr or '').strip()[-800:]))
 

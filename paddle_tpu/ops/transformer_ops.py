@@ -114,8 +114,13 @@ def _transformer_layer_stack(ctx):
     else:
         xs = (params,)
 
-    # inside shard_map GSPMD constraints don't apply — drop the sp ring
-    # dispatch from the per-stage attention (pp composes with dp only)
+    # The pipelined stage runs inside a shard_map that is manual over
+    # 'pp' only, so GSPMD still manages dp/tp within the stage. The one
+    # thing that can't ride along is the ring-attention dispatch: it is
+    # its own shard_map over 'sp', and nesting it under the pp-manual
+    # map isn't supported — under pipelining, attention takes the
+    # XLA-fused path (pp composes with dp and tp; pp x sp does not
+    # ring).
     attn_mesh = None if pipelined else mesh
 
     def make_body(ext, fold):

@@ -118,11 +118,13 @@ def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
     inputs — a decoder stack's enc_out / src_length) and `m` is the
     microbatch index, for folding into dropout keys.
 
-    x: [batch, ...] activations; batch must divide n_micro. Composes
-    with 'dp' (each microbatch's batch dim keeps its dp sharding; the
-    pipeline runs per dp group). 'sp'/'tp' inside the stage body are not
-    supported — inside shard_map GSPMD constraints don't apply, so the
-    caller must drop those axes from the attention dispatch.
+    x: [batch, ...] activations; batch must divide n_micro. The
+    shard_map is MANUAL over 'pp' only (axis_names={'pp'}): every other
+    mesh axis stays compiler-managed inside the stage, so 'dp' batch
+    sharding flows through untouched and intra-stage 'tp' (Megatron
+    column/row splits of the stacked weights, P('pp', None, 'tp') /
+    P('pp', 'tp', None) from the transpiler) gets its psums from GSPMD
+    — the scaling-book pp x tp composition with no hand collectives.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -145,13 +147,10 @@ def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
     mb_extras = jax.tree.map(
         lambda e: e.reshape((n_micro, mb) + e.shape[1:]), extras)
 
+    # specs constrain the MANUAL axis only: stage dim of the stacked
+    # weights on pp, activations replicated over pp (stage 0 ingests)
     param_specs = jax.tree.map(
         lambda a: P(*((axis_name,) + (None,) * (a.ndim - 1))), xs)
-    dp = 'dp' if mesh_shape.get('dp', 1) > 1 and axis_name != 'dp' \
-        else None
-
-    def batch_spec(a):
-        return P(None, dp, *((None,) * (a.ndim - 2)))
 
     def inner(local_xs, mbx, ext):
         def stage_fn(local, h, m):
@@ -168,9 +167,9 @@ def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
         return jax.lax.psum(out, axis_name)
 
     mapped = jax.shard_map(
-        inner, mesh=mesh,
-        in_specs=(param_specs, batch_spec(mb_x),
-                  jax.tree.map(batch_spec, mb_extras)),
-        out_specs=batch_spec(mb_x), check_vma=False)
+        inner, mesh=mesh, axis_names=frozenset({axis_name}),
+        in_specs=(param_specs, P(), jax.tree.map(lambda _: P(),
+                                                 mb_extras)),
+        out_specs=P(), check_vma=False)
     out = mapped(xs, mb_x, mb_extras)
     return out.reshape((batch,) + out.shape[2:])

@@ -107,12 +107,23 @@ def _auto_tp_specs(program):
     return specs
 
 
-def _pp_stack_specs(program, n_stages):
+# Megatron pairing for the stacked-layer weight slots (pp x tp): qkv +
+# ffn-in column split (tp on the output-features dim), out-proj +
+# ffn-out row split (tp on the input dim; GSPMD inserts the psum).
+_STACK_TP_COL = frozenset(('SlfQ', 'SlfK', 'SlfV', 'CrossQ', 'CrossK',
+                           'CrossV', 'FfnW1'))
+_STACK_TP_ROW = frozenset(('SlfO', 'CrossO', 'FfnW2'))
+
+
+def _pp_stack_specs(program, n_stages, with_tp=False):
     """Stage-shard the scan-stacked layer weights: every parameter input
     of a transformer_layer_stack op gets P('pp', ...) on its leading
     [n_layer] axis, so stage s of the GPipe schedule holds layers
     [s*L/pp, (s+1)*L/pp) — the op lowering runs the schedule itself
-    (ops/transformer_ops.py pipelined path)."""
+    (ops/transformer_ops.py pipelined path). With with_tp, the matmul
+    weights additionally column/row split over 'tp' INSIDE each stage
+    (the shard_map is manual over pp only, so GSPMD manages the
+    intra-stage tp collectives)."""
     specs = {}
     block = program.global_block()
     found_stack = False
@@ -132,7 +143,13 @@ def _pp_stack_specs(program, n_stages):
                         'pipeline_parallel: stacked param %r has '
                         'n_layer=%d, not divisible by pp=%d'
                         % (n, v.shape[0], n_stages))
-                specs[n] = P(*(['pp'] + [None] * (len(v.shape) - 1)))
+                spec = ['pp'] + [None] * (len(v.shape) - 1)
+                if with_tp and len(v.shape) == 3:
+                    if slot in _STACK_TP_COL:
+                        spec[2] = 'tp'
+                    elif slot in _STACK_TP_ROW:
+                        spec[1] = 'tp'
+                specs[n] = P(*spec)
     if not found_stack:
         raise ValueError(
             'pipeline_parallel requires scan-stacked layers: build the '
@@ -192,7 +209,10 @@ def transpile(program, mesh, strategy=None):
                 'pipeline_parallel=True but the mesh has no pp axis > 1 '
                 '(mesh shape %s) — build it with make_mesh(pp=n_stages)'
                 % dict(mesh.shape))
-        pp_specs = _pp_stack_specs(program, n_pp)
+        pp_specs = _pp_stack_specs(
+            program, n_pp,
+            with_tp=(strategy.tensor_parallel and
+                     dict(mesh.shape).get('tp', 1) > 1))
         program.pipeline = {
             'n_micro': int(strategy.pipeline_microbatches or n_pp)}
 
