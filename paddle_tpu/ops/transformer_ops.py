@@ -190,7 +190,15 @@ def _moe_layer_stack(ctx):
     cap_factor = ctx.attr('capacity_factor', 1.25)
     k = ctx.attr('top_k', 1)
     is_test = ctx.attr('is_test', False) or ctx.is_test
-    mesh = getattr(ctx.block.program, 'mesh', None)
+    program = ctx.block.program
+    mesh = getattr(program, 'mesh', None)
+    pp_conf = getattr(program, 'pipeline', None)
+    pipelined = bool(pp_conf) and mesh is not None and \
+        dict(mesh.shape).get('pp', 1) > 1
+    # see _transformer_layer_stack: under the pp-manual shard_map the
+    # ep constraints stay valid (ep is compiler-managed) but the sp
+    # ring can't nest — attention drops the mesh when pipelined
+    attn_mesh = None if pipelined else mesh
 
     params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
               for s in MOE_SLOTS}
@@ -203,7 +211,12 @@ def _moe_layer_stack(ctx):
                 params[s] = params[s].astype(jnp.bfloat16)
 
     b, t, d = x.shape
-    capacity = moe_capacity(cap_factor, k, b * t,
+    # pipelined: each microbatch routes independently, so capacity is
+    # per-microbatch tokens (capacity_factor semantics preserved; the
+    # routing population differs from full-batch by design, like any
+    # microbatched MoE schedule)
+    route_b = b // pp_conf['n_micro'] if pipelined else b
+    capacity = moe_capacity(cap_factor, k, route_b * t,
                             params['gate_w'].shape[-1])
 
     if rate and not is_test:
@@ -215,24 +228,36 @@ def _moe_layer_stack(ctx):
     else:
         xs = (params,)
 
-    def body(carry, sl):
-        h, aux_sum = carry
-        p = sl[0]
-        key = sl[1][0] if len(sl) > 1 else None
-        slf = _attn(h, h, p, 'slf', n_head, True, None, rate, key,
-                    is_test, mesh)
-        h = _post_process(h, slf, p, 0.0, None, is_test, 'ln1')
-        h2 = h.reshape(b * t, d)
-        w1, b1, w2, b2 = constrain_experts(
-            mesh, (p['moe_w1'], p['moe_b1'], p['moe_w2'], p['moe_b2']))
-        moe_out, aux, _ = switch_moe_reference(
-            h2, p['gate_w'], w1, b1, w2, b2, capacity, k=k)
-        h = _post_process(h, moe_out.reshape(b, t, d), p, 0.0, None,
-                          is_test, 'ln2')
-        return (h, aux_sum + aux), None
+    def make_body(_ext, fold):
+        def body(carry, sl):
+            h, aux_sum = carry
+            p = sl[0]
+            key = sl[1][0] if len(sl) > 1 else None
+            if fold is not None and key is not None:
+                key = jax.random.fold_in(key, fold)
+            slf = _attn(h, h, p, 'slf', n_head, True, None, rate, key,
+                        is_test, attn_mesh)
+            h = _post_process(h, slf, p, 0.0, None, is_test, 'ln1')
+            hb, ht, hd = h.shape
+            h2 = h.reshape(hb * ht, hd)
+            w1, b1, w2, b2 = constrain_experts(
+                mesh, (p['moe_w1'], p['moe_b1'], p['moe_w2'],
+                       p['moe_b2']))
+            moe_out, aux, _ = switch_moe_reference(
+                h2, p['gate_w'], w1, b1, w2, b2, capacity, k=k)
+            h = _post_process(h, moe_out.reshape(hb, ht, hd), p, 0.0,
+                              None, is_test, 'ln2')
+            return (h, aux_sum + aux), None
 
-    (out, aux_total), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), xs)
+        return body
+
+    if pipelined:
+        from ..parallel.pipeline import pipeline_layer_scan
+        out, aux_total = pipeline_layer_scan(
+            make_body, x, xs, mesh, pp_conf['n_micro'], aux=True)
+    else:
+        (out, aux_total), _ = jax.lax.scan(
+            make_body({}, None), (x, jnp.zeros((), jnp.float32)), xs)
     ctx.set_output('Out', out)
     ctx.set_output('AuxLoss', aux_total)
 

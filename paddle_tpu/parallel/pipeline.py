@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 
 def pipeline(stage_fn, stage_params, microbatches, axis_name='pp',
-             with_mb_index=False):
+             with_mb_index=False, with_aux=False):
     """Run inside shard_map over `axis_name`.
 
     stage_fn(params, x) -> y           one pipeline stage (same shape in/out)
@@ -28,9 +28,16 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name='pp',
     clamped) — lets the stage fold m into dropout PRNG keys so masks
     stay per-microbatch, matching the semantics of one big batch split
     into n_micro pieces.
+    with_aux: stage_fn additionally returns a scalar auxiliary loss
+    (MoE load-balancing); contributions are summed over this stage's
+    VALID ticks only (warm-up/cool-down ticks process clamped garbage
+    microbatches and must not pollute the total) and returned as the
+    second output — psum over the pipe and divide by n_micro to
+    recover the full-batch mean.
     Returns [n_micro, mb, ...] final-stage outputs (valid on the LAST
     stage; other stages hold garbage — combine with out_specs that index
-    the last shard, or psum-mask as convenient).
+    the last shard, or psum-mask as convenient); with_aux returns
+    (outputs, aux_sum).
     """
     n_stages = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
@@ -38,24 +45,32 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name='pp',
     total = n_micro + n_stages - 1
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    def tick(buf, t):
+    def tick(carry, t):
+        buf, aux_acc = carry
         # stage 0 ingests microbatch t (clamped; masked later)
         mb = microbatches[jnp.clip(t, 0, n_micro - 1)]
         x = jnp.where(stage == 0, mb, buf)
+        args = (stage_params, x)
         if with_mb_index:
-            m = jnp.clip(t - stage, 0, n_micro - 1)
-            y = stage_fn(stage_params, x, m)
-        else:
-            y = stage_fn(stage_params, x)
+            args = args + (jnp.clip(t - stage, 0, n_micro - 1),)
+        y = stage_fn(*args)
+        if with_aux:
+            y, aux = y
+            valid = (t >= stage) & (t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         nxt = jax.lax.ppermute(y, axis_name, fwd_perm)
-        return nxt, y
+        return (nxt, aux_acc), y
 
     # mark the carry varying over pp (ppermute outputs are varying; an
     # unvarying init would make the scan carry types mismatch)
     buf0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
-    _, ys = jax.lax.scan(tick, buf0, jnp.arange(total))
+    aux0 = jax.lax.pvary(jnp.zeros((), jnp.float32), (axis_name,))
+    (_, aux_sum), ys = jax.lax.scan(tick, (buf0, aux0),
+                                    jnp.arange(total))
     # last stage emits microbatch m at tick m + n_stages - 1
     out = jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, n_micro, axis=0)
+    if with_aux:
+        return out, aux_sum
     return out
 
 
@@ -99,7 +114,7 @@ def pipelined_apply(stage_fn, stacked_params, x, n_micro, mesh,
 
 
 def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
-                        axis_name='pp'):
+                        axis_name='pp', aux=False):
     """Pipeline a scan-over-layers op body over `mesh`'s pp axis — the
     Program-level pipeline path (a transformer_layer_stack op whose
     program was transpiled with ParallelStrategy(pipeline_parallel=True)
@@ -155,21 +170,40 @@ def pipeline_layer_scan(make_body, x, xs, mesh, n_micro, extras=(),
     def inner(local_xs, mbx, ext):
         def stage_fn(local, h, m):
             ext_m = jax.tree.map(lambda e: e[m], ext)
-            out, _ = jax.lax.scan(make_body(ext_m, m), h, local)
+            body = make_body(ext_m, m)
+            if aux:
+                # body carry is (h, aux_sum) — MoE stacks accumulate
+                # their per-layer load-balancing loss through the scan
+                (out, a), _ = jax.lax.scan(
+                    body, (h, jnp.zeros((), jnp.float32)), local)
+                return out, a
+            out, _ = jax.lax.scan(body, h, local)
             return out
 
-        out = pipeline(stage_fn, local_xs, mbx, axis_name,
-                       with_mb_index=True)
+        res = pipeline(stage_fn, local_xs, mbx, axis_name,
+                       with_mb_index=True, with_aux=aux)
+        out, aux_sum = res if aux else (res, None)
         # emit only the last stage's result; zeros elsewhere so the psum
         # over pp reconstructs the true output on every device
         is_last = jax.lax.axis_index(axis_name) == n_stages - 1
         out = jnp.where(is_last, out, jnp.zeros_like(out))
-        return jax.lax.psum(out, axis_name)
+        out = jax.lax.psum(out, axis_name)
+        if aux:
+            # each stage summed its own layers' aux over its n_micro
+            # valid ticks; psum totals the pipe, /n_micro recovers the
+            # full-batch per-token mean the unpipelined scan computes
+            return out, jax.lax.psum(aux_sum, axis_name) / n_micro
+        return out
 
+    out_specs = (P(), P()) if aux else P()
     mapped = jax.shard_map(
         inner, mesh=mesh, axis_names=frozenset({axis_name}),
         in_specs=(param_specs, P(), jax.tree.map(lambda _: P(),
                                                  mb_extras)),
-        out_specs=P(), check_vma=False)
-    out = mapped(xs, mb_x, mb_extras)
-    return out.reshape((batch,) + out.shape[2:])
+        out_specs=out_specs, check_vma=False)
+    res = mapped(xs, mb_x, mb_extras)
+    out, aux_total = res if aux else (res, None)
+    out = out.reshape((batch,) + out.shape[2:])
+    if aux:
+        return out, aux_total
+    return out

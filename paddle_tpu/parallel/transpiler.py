@@ -115,20 +115,25 @@ _STACK_TP_COL = frozenset(('SlfQ', 'SlfK', 'SlfV', 'CrossQ', 'CrossK',
 _STACK_TP_ROW = frozenset(('SlfO', 'CrossO', 'FfnW2'))
 
 
-def _pp_stack_specs(program, n_stages, with_tp=False):
+_PP_STACK_OPS = ('transformer_layer_stack', 'moe_layer_stack')
+
+
+def _pp_stack_specs(program, n_stages, with_tp=False, with_ep=False):
     """Stage-shard the scan-stacked layer weights: every parameter input
-    of a transformer_layer_stack op gets P('pp', ...) on its leading
-    [n_layer] axis, so stage s of the GPipe schedule holds layers
-    [s*L/pp, (s+1)*L/pp) — the op lowering runs the schedule itself
-    (ops/transformer_ops.py pipelined path). With with_tp, the matmul
-    weights additionally column/row split over 'tp' INSIDE each stage
-    (the shard_map is manual over pp only, so GSPMD manages the
-    intra-stage tp collectives)."""
+    of a transformer_layer_stack / moe_layer_stack op gets P('pp', ...)
+    on its leading [n_layer] axis, so stage s of the GPipe schedule
+    holds layers [s*L/pp, (s+1)*L/pp) — the op lowering runs the
+    schedule itself (ops/transformer_ops.py pipelined paths). With
+    with_tp, the 3-D matmul weights additionally column/row split over
+    'tp' inside each stage; with with_ep, [n_layer, E, ...] expert
+    weights keep their 'ep' split on axis 1. Both compose because the
+    shard_map is manual over pp only — GSPMD manages the intra-stage
+    tp/ep collectives."""
     specs = {}
     block = program.global_block()
     found_stack = False
     for op in block.ops:
-        if op.type != 'transformer_layer_stack':
+        if op.type not in _PP_STACK_OPS:
             continue
         found_stack = True
         for slot, names in op.inputs.items():
@@ -144,7 +149,18 @@ def _pp_stack_specs(program, n_stages, with_tp=False):
                         'n_layer=%d, not divisible by pp=%d'
                         % (n, v.shape[0], n_stages))
                 spec = ['pp'] + [None] * (len(v.shape) - 1)
-                if with_tp and len(v.shape) == 3:
+                if with_ep and getattr(v, 'expert_shard', False):
+                    ax = getattr(v, 'expert_shard_axis', 1)
+                    if ax < 1:
+                        # axis 0 is the stage axis here; an [E, ...]
+                        # expert annotation cannot sit on a stacked op
+                        raise ValueError(
+                            'stacked expert param %r has '
+                            'expert_shard_axis=%d; scan-stacked MoE '
+                            'weights are [n_layer, E, ...] (axis >= 1)'
+                            % (n, ax))
+                    spec[ax] = 'ep'
+                elif with_tp and len(v.shape) == 3:
                     if slot in _STACK_TP_COL:
                         spec[2] = 'tp'
                     elif slot in _STACK_TP_ROW:
@@ -153,8 +169,9 @@ def _pp_stack_specs(program, n_stages, with_tp=False):
     if not found_stack:
         raise ValueError(
             'pipeline_parallel requires scan-stacked layers: build the '
-            'model with scan_layers=True (transformer_layer_stack ops) '
-            'so the transpiler can partition the stack into pp stages')
+            'model with scan_layers=True (transformer_layer_stack / '
+            'moe_layer_stack ops) so the transpiler can partition the '
+            'stack into pp stages')
     return specs
 
 
@@ -202,6 +219,10 @@ def transpile(program, mesh, strategy=None):
         auto_tp = _auto_tp_specs(program)
 
     pp_specs = {}
+    # re-transpiling with pipeline off must clear a previous schedule —
+    # the stack lowerings key off program.pipeline, and the version bump
+    # below guarantees they get re-traced with the new decision
+    program.pipeline = None
     if strategy.pipeline_parallel:
         n_pp = dict(mesh.shape).get('pp', 1)
         if n_pp <= 1:
@@ -212,7 +233,8 @@ def transpile(program, mesh, strategy=None):
         pp_specs = _pp_stack_specs(
             program, n_pp,
             with_tp=(strategy.tensor_parallel and
-                     dict(mesh.shape).get('tp', 1) > 1))
+                     dict(mesh.shape).get('tp', 1) > 1),
+            with_ep=dict(mesh.shape).get('ep', 1) > 1)
         program.pipeline = {
             'n_micro': int(strategy.pipeline_microbatches or n_pp)}
 
@@ -270,6 +292,10 @@ def transpile(program, mesh, strategy=None):
 
     program.var_shardings.update(shardings)
     program.mesh = mesh
+    # invalidate compiled-step caches: a step compiled BEFORE transpile
+    # has no sharding constraints (and no pipeline schedule) traced in —
+    # reusing it would silently train without the requested layout
+    program._bump_version()
     return program
 
 

@@ -436,6 +436,42 @@ def test_program_pipeline_indivisible_layers_raises():
                   ParallelStrategy(pipeline_parallel=True))
 
 
+def test_retranspile_clears_pipeline_schedule():
+    """Re-transpiling with pipeline_parallel=False must clear the old
+    schedule — the stack lowerings key off program.pipeline (r4
+    review)."""
+    from paddle_tpu.models import transformer as T
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    T.transformer_base(
+        src_vocab_size=64, trg_vocab_size=64, src_seq_len=8, trg_seq_len=8,
+        n_layer=2, d_model=16, d_inner=32, d_key=8, d_value=8, n_head=2,
+        dropout_rate=0.0, scan_layers=True)
+    prog = fluid.default_main_program()
+    transpile(prog, make_mesh(dp=1, pp=2),
+              ParallelStrategy(pipeline_parallel=True,
+                               pipeline_microbatches=4))
+    assert prog.pipeline == {'n_micro': 4}
+    transpile(prog, make_mesh(dp=1, pp=2),
+              ParallelStrategy(pipeline_parallel=False))
+    assert prog.pipeline is None
+
+
+def test_transpile_invalidates_compiled_cache():
+    """A step compiled before transpile must not be reused after: the
+    old trace has no sharding constraints (and no pipeline schedule).
+    transpile bumps the program version, which keys the executor
+    cache."""
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    loss = _build_mlp_loss()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    v0 = prog._version
+    transpile(prog, make_mesh(dp=8), ParallelStrategy(data_parallel=True))
+    assert prog._version > v0
+
+
 def test_multihost_single_host_fallbacks():
     from paddle_tpu.parallel import multihost
     assert multihost.init_distributed() in (True, False)
