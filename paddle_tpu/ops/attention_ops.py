@@ -58,14 +58,28 @@ def _ring_dispatch(q, k, v, mesh, causal):
     """Sequence-parallel exact attention: shard_map over the mesh's 'sp'
     axis with K/V rotating on ICI (parallel/ring_attention.py). Called
     inside the executor's jit — GSPMD reshards q/k/v to the sp layout if
-    the transpiler hasn't already."""
+    the transpiler hasn't already.
+
+    Nests under a pipelined stage (pp x sp): when tracing inside a
+    shard_map that is already manual over 'pp', the inner map INHERITS
+    the context's abstract mesh — passing the concrete mesh would
+    mismatch its Manual axis types. Varying-axis checking stays ON:
+    with check_vma=False the nested backward silently mis-accounted
+    the pp-varying cotangents (measured ~1e-3 loss drift vs single
+    device; exact with the default)."""
     from jax.sharding import PartitionSpec as P
     from ..parallel.ring_attention import ring_attention
     spec = P(None, None, 'sp', None)
+    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec)
+    ctx = jax.sharding.get_abstract_mesh()
+    manual = getattr(jax.sharding.AxisType, 'Manual', None)
+    if not (ctx is not None and any(
+            t == manual for t in getattr(ctx, 'axis_types', ()))):
+        kwargs['mesh'] = mesh
     return jax.shard_map(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name='sp',
                                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        **kwargs)(q, k, v)
 
 
 def _sp_size(mesh):

@@ -115,13 +115,10 @@ def _transformer_layer_stack(ctx):
         xs = (params,)
 
     # The pipelined stage runs inside a shard_map that is manual over
-    # 'pp' only, so GSPMD still manages dp/tp within the stage. The one
-    # thing that can't ride along is the ring-attention dispatch: it is
-    # its own shard_map over 'sp', and nesting it under the pp-manual
-    # map isn't supported — under pipelining, attention takes the
-    # XLA-fused path (pp composes with dp and tp; pp x sp does not
-    # ring).
-    attn_mesh = None if pipelined else mesh
+    # 'pp' only: GSPMD still manages dp/tp within the stage, and the
+    # ring-attention dispatch nests as an sp-manual inner shard_map
+    # that inherits the context mesh (_ring_dispatch) — pp composes
+    # with dp, tp, AND sp, so attention sees the mesh either way.
 
     def make_body(ext, fold):
         # ext: this microbatch's slice of the batch-aligned side inputs
@@ -138,11 +135,11 @@ def _transformer_layer_stack(ctx):
                       for k in kk]
             slf = _attn(h, h, p, 'slf', n_head, is_decoder,
                         None if is_decoder else kl_m,
-                        rate, kk[0], is_test, attn_mesh)
+                        rate, kk[0], is_test, mesh)
             h = _post_process(h, slf, p, rate, kk[1], is_test, 'ln1')
             if is_decoder:
                 cross = _attn(h, enc_m, p, 'cross', n_head, False,
-                              kl_m, rate, kk[4], is_test, attn_mesh)
+                              kl_m, rate, kk[4], is_test, mesh)
                 h = _post_process(h, cross, p, rate, kk[5], is_test, 'ln2')
             ffn = _ffn(h, p, rate, kk[2], is_test)
             h = _post_process(h, ffn, p, rate, kk[3], is_test,
@@ -195,11 +192,6 @@ def _moe_layer_stack(ctx):
     pp_conf = getattr(program, 'pipeline', None)
     pipelined = bool(pp_conf) and mesh is not None and \
         dict(mesh.shape).get('pp', 1) > 1
-    # see _transformer_layer_stack: under the pp-manual shard_map the
-    # ep constraints stay valid (ep is compiler-managed) but the sp
-    # ring can't nest — attention drops the mesh when pipelined
-    attn_mesh = None if pipelined else mesh
-
     params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
               for s in MOE_SLOTS}
     n_layer = next(iter(params.values())).shape[0]
@@ -236,7 +228,7 @@ def _moe_layer_stack(ctx):
             if fold is not None and key is not None:
                 key = jax.random.fold_in(key, fold)
             slf = _attn(h, h, p, 'slf', n_head, True, None, rate, key,
-                        is_test, attn_mesh)
+                        is_test, mesh)
             h = _post_process(h, slf, p, 0.0, None, is_test, 'ln1')
             hb, ht, hd = h.shape
             h2 = h.reshape(hb * ht, hd)

@@ -238,6 +238,21 @@ def test_moe_pipeline_ep_matches_single_device():
     assert tuple(spec)[:2] == ('pp', 'ep'), spec
 
 
+def test_moe_pipeline_four_axis_matches_single_device():
+    """pp x sp x ep (+ the causal ring nested inside the stage): the MoE
+    stack's attention dispatches ring attention under pipelining while
+    experts stay 'ep'-split — all in one program, trajectory equal to
+    single device in the no-drop regime."""
+    base = _train_moe_pp()
+    four = _train_moe_pp(
+        mesh=make_mesh(dp=1, pp=2, sp=2, ep=2),
+        strategy=ParallelStrategy(data_parallel=False,
+                                  sequence_parallel=True,
+                                  pipeline_parallel=True,
+                                  sp_vars=['word', 'label']))
+    np.testing.assert_allclose(four, base, rtol=2e-4, atol=1e-5)
+
+
 def test_moe_pipeline_with_aux_trains():
     """dp x pp x ep with the load-balancing aux on: the pipelined aux is
     the mean of per-microbatch means (documented semantic difference),

@@ -356,6 +356,21 @@ def test_program_pipeline_composes_with_tp():
     assert tuple(spec_o) == ('pp', 'tp', None), spec_o
 
 
+def test_program_pipeline_composes_with_sp():
+    """pp x sp: the ring-attention dispatch nests as an sp-manual inner
+    shard_map inheriting the pp-manual context mesh — long-context
+    sequence parallelism inside a pipeline stage, loss-equal to single
+    device."""
+    base = _train_scan_transformer(n_layer=2)
+    pp_sp = _train_scan_transformer(
+        mesh=make_mesh(dp=1, pp=2, sp=4), n_layer=2,
+        strategy=ParallelStrategy(
+            data_parallel=False, sequence_parallel=True,
+            pipeline_parallel=True,
+            sp_vars=['src_word', 'trg_word', 'lbl_word', 'lbl_weight']))
+    np.testing.assert_allclose(pp_sp, base, rtol=2e-4, atol=1e-5)
+
+
 def test_program_pipeline_composes_with_run_steps():
     """The pipelined step under Executor.run_steps (shard_map inside the
     multi-step lax.scan): trajectory equals per-step dispatch."""
