@@ -72,8 +72,9 @@ def _ring_dispatch(q, k, v, mesh, causal):
     spec = P(None, None, 'sp', None)
     kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec)
     ctx = jax.sharding.get_abstract_mesh()
-    manual = getattr(jax.sharding.AxisType, 'Manual', None)
-    if not (ctx is not None and any(
+    manual = getattr(getattr(jax.sharding, 'AxisType', None),
+                     'Manual', None)
+    if not (manual is not None and any(
             t == manual for t in getattr(ctx, 'axis_types', ()))):
         kwargs['mesh'] = mesh
     return jax.shard_map(

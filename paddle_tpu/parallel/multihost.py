@@ -49,9 +49,19 @@ def init_distributed(coordinator_address=None, num_processes=None,
         try:
             jax.distributed.initialize()
             _initialized = True
-        except Exception:
+        except Exception as e:
             # auto-detect path only: no pod metadata → single-host
-            # fallback; everything below still works on local devices
+            # fallback; everything below still works on local devices.
+            # Loudly though — a pod with broken metadata would silently
+            # train single-host on duplicate data otherwise.
+            import warnings
+            warnings.warn(
+                'init_distributed: %d processes requested (num_processes '
+                'arg or PADDLE_TRAINERS) but jax.distributed auto-init '
+                'failed (%s: %s); continuing SINGLE-HOST — if this is a '
+                'real cluster, set PADDLE_COORDINATOR to make joining '
+                'mandatory' % (num_processes, type(e).__name__,
+                               str(e)[:200]))
             _initialized = False
     return _initialized
 

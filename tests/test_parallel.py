@@ -487,6 +487,32 @@ def test_transpile_invalidates_compiled_cache():
     assert prog._version > v0
 
 
+def test_multihost_autodetect_failure_warns(monkeypatch):
+    """Auto-detect path (PADDLE_TRAINERS set, no coordinator): a failed
+    jax.distributed init falls back single-host but WARNS — a pod with
+    broken metadata must not silently train on duplicate data."""
+    import warnings
+    from paddle_tpu.parallel import multihost
+    monkeypatch.setattr(multihost, '_initialized', False)
+    monkeypatch.setenv('PADDLE_TRAINERS', '4')
+    monkeypatch.delenv('PADDLE_COORDINATOR', raising=False)
+    monkeypatch.delenv('PADDLE_TRAINER_ID', raising=False)
+
+    class _FakeDist(object):
+        @staticmethod
+        def initialize(*a, **k):
+            raise RuntimeError('no pod metadata')
+
+    import jax
+    monkeypatch.setattr(jax, 'distributed', _FakeDist)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        ok = multihost.init_distributed()
+    assert ok is False
+    assert any('SINGLE-HOST' in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+
+
 def test_multihost_single_host_fallbacks():
     from paddle_tpu.parallel import multihost
     assert multihost.init_distributed() in (True, False)
