@@ -1,0 +1,33 @@
+#!/bin/sh
+# Build the C client against the embedded-runtime inference library and
+# run it on a freshly saved fit_a_line model.
+set -e
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO/examples/capi_inference"
+export PYTHONPATH="$REPO:$PYTHONPATH"
+
+MODEL_DIR="$(mktemp -d)/model"
+# PADDLE_TPU_CAPI_PLATFORM picks the C client's backend; the same value
+# drives the model-saving python below (in-script config update — the
+# reliable way to pick a backend before any device query)
+PLATFORM="${PADDLE_TPU_CAPI_PLATFORM:-cpu}"
+export PADDLE_TPU_CAPI_PLATFORM="$PLATFORM"
+python - "$MODEL_DIR" "$PLATFORM" <<'EOF'
+import sys
+import jax
+jax.config.update('jax_platforms', sys.argv[2])
+import numpy as np
+import paddle_tpu as fluid
+
+x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+pred = fluid.layers.fc(input=x, size=1)
+exe = fluid.Executor(fluid.TPUPlace(0))
+exe.run(fluid.default_startup_program())
+fluid.io.save_inference_model(sys.argv[1], ['x'], [pred], exe)
+print('saved', sys.argv[1])
+EOF
+
+SO="$(python -c 'from paddle_tpu.native import build_capi; print(build_capi())')"
+cc main.c -I "$REPO/paddle_tpu/native" "$SO" \
+   -Wl,-rpath,"$(dirname "$SO")" -o infer
+./infer "$MODEL_DIR"

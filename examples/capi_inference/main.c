@@ -1,0 +1,56 @@
+/* Serve a saved paddle_tpu model from plain C through the inference
+ * C ABI (paddle_tpu/native/capi.h). Reference analog:
+ * paddle/capi/examples/model_inference/dense/main.c.
+ *
+ * Usage: ./infer <model_dir>   (a dir from fluid.io.save_inference_model
+ * whose feed is one float32 tensor named "x" of shape [batch, 13]) */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "capi.h"
+
+#define CHECK(expr)                                                     \
+  do {                                                                  \
+    paddle_error e_ = (expr);                                           \
+    if (e_ != kPD_NO_ERROR) {                                           \
+      fprintf(stderr, "%s -> %s: %s\n", #expr, paddle_error_string(e_), \
+              paddle_last_error_message());                             \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  CHECK(paddle_tpu_init(NULL)); /* NULL = auto backend; "cpu" forces CPU */
+
+  paddle_predictor pred;
+  CHECK(paddle_predictor_create(argv[1], &pred));
+
+  float x[2 * 13];
+  for (int i = 0; i < 2 * 13; i++) x[i] = 0.1f * (float)(i % 13);
+  paddle_tensor in;
+  in.dtype = PD_FLOAT32;
+  in.ndim = 2;
+  in.shape[0] = 2;
+  in.shape[1] = 13;
+  in.data = x;
+  const char* names[] = {"x"};
+  CHECK(paddle_predictor_run(pred, 1, names, &in));
+
+  int32_t n;
+  CHECK(paddle_predictor_output_count(pred, &n));
+  for (int32_t i = 0; i < n; i++) {
+    paddle_tensor out;
+    CHECK(paddle_predictor_output(pred, i, &out));
+    printf("output %d: shape [", i);
+    for (int32_t d = 0; d < out.ndim; d++)
+      printf("%s%lld", d ? ", " : "", (long long)out.shape[d]);
+    printf("]  first value %.5f\n", ((const float*)out.data)[0]);
+  }
+  CHECK(paddle_predictor_destroy(pred));
+  printf("OK\n");
+  return 0;
+}

@@ -1,0 +1,39 @@
+"""Minimal end-to-end: linear regression on the uci_housing schema,
+then save + reload an inference model (the fit_a_line book chapter)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main():
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype('float32')
+    for step in range(200):
+        xs = rng.randn(32, 13).astype('float32')
+        ys = xs @ w_true + 0.5
+        loss, = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[cost])
+        if step % 50 == 0:
+            print('step %3d  loss %.6f' % (step, float(np.asarray(loss))))
+
+    fluid.io.save_inference_model('/tmp/fit_a_line_model', ['x'], [pred],
+                                  exe)
+    prog, feeds, fetches = fluid.io.load_inference_model(
+        '/tmp/fit_a_line_model', exe)
+    xs = rng.randn(4, 13).astype('float32')
+    out = exe.run(program=prog, feed={'x': xs}, fetch_list=fetches)
+    err = np.abs(np.asarray(out[0]) - (xs @ w_true + 0.5)).max()
+    print('reloaded model max abs err vs truth: %.4f' % err)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,47 @@
+"""The large-model recipe: a scan-stacked transformer trained over a
+dp x pp x tp mesh. The model is built once with scan_layers=True (one
+transformer_layer_stack op per side); ParallelStrategy(
+pipeline_parallel=True, tensor_parallel=True) stage-shards the layer
+stacks over 'pp' and Megatron-splits the matmul weights over 'tp', and
+Executor.run trains exactly as on one device — the GPipe schedule and
+all collectives live inside the jitted step.
+
+Runs on 8 virtual CPU devices by default; on a real 8-chip slice,
+remove the force_host_cpu call.
+"""
+
+import numpy as np
+
+
+def main():
+    from paddle_tpu.core.platform_boot import force_host_cpu
+    force_host_cpu(8)   # drop this line on real hardware
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
+
+    avg_cost, _ = T.transformer_base(
+        src_vocab_size=1024, trg_vocab_size=1024,
+        src_seq_len=32, trg_seq_len=32,
+        n_layer=4, d_model=64, d_inner=256, d_key=16, d_value=16,
+        dropout_rate=0.1, scan_layers=True)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+    mesh = make_mesh(dp=2, pp=2, tp=2)
+    transpile(fluid.default_main_program(), mesh,
+              ParallelStrategy(data_parallel=True, tensor_parallel=True,
+                               pipeline_parallel=True,
+                               pipeline_microbatches=2))
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    for step in range(10):
+        feed = T.make_fake_batch(8, 32, 32, 1024, 1024, seed=step)
+        loss, = exe.run(feed=feed, fetch_list=[avg_cost])
+        print('step %d  loss %.4f' % (step, float(np.asarray(loss))))
+
+
+if __name__ == '__main__':
+    main()
