@@ -23,7 +23,7 @@ def main():
         ys = xs @ w_true + 0.5
         loss, = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[cost])
         if step % 50 == 0:
-            print('step %3d  loss %.6f' % (step, float(np.asarray(loss))))
+            print('step %3d  loss %.6f' % (step, float(np.asarray(loss).reshape(()))))
 
     fluid.io.save_inference_model('/tmp/fit_a_line_model', ['x'], [pred],
                                   exe)

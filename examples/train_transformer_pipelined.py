@@ -40,7 +40,7 @@ def main():
     for step in range(10):
         feed = T.make_fake_batch(8, 32, 32, 1024, 1024, seed=step)
         loss, = exe.run(feed=feed, fetch_list=[avg_cost])
-        print('step %d  loss %.4f' % (step, float(np.asarray(loss))))
+        print('step %d  loss %.4f' % (step, float(np.asarray(loss).reshape(()))))
 
 
 if __name__ == '__main__':

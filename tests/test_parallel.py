@@ -398,6 +398,38 @@ def test_program_pipeline_composes_with_run_steps():
     np.testing.assert_allclose(windowed, per_step, rtol=2e-4, atol=1e-5)
 
 
+def test_program_pipeline_composes_with_grad_accum():
+    """GradientAccumulator's gated updates under a pipelined program:
+    the accumulator state and phase counter live OUTSIDE the pp
+    shard_map, so accumulation semantics are unchanged — trajectory
+    equals single device (loss repeats in pairs: k=2)."""
+    def run(mesh=None, strategy=None):
+        from paddle_tpu.models import transformer as T
+        fluid.reset_default_programs()
+        fluid.global_scope().clear()
+        fluid.default_main_program().random_seed = 7
+        cost, _ = T.transformer_base(
+            src_vocab_size=64, trg_vocab_size=64, src_seq_len=8,
+            trg_seq_len=8, n_layer=2, d_model=16, d_inner=32, d_key=8,
+            d_value=8, n_head=2, dropout_rate=0.0, scan_layers=True)
+        fluid.optimizer.GradientAccumulator(
+            fluid.optimizer.SGD(learning_rate=0.1), 2).minimize(cost)
+        if mesh is not None:
+            transpile(fluid.default_main_program(), mesh, strategy)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
+        return [float(np.asarray(exe.run(
+            feed=feed, fetch_list=[cost])[0])) for _ in range(4)]
+
+    base = run()
+    assert base[0] == base[1] and base[2] == base[3]  # k=2 gating
+    pp = run(mesh=make_mesh(dp=2, pp=2),
+             strategy=ParallelStrategy(data_parallel=True,
+                                       pipeline_parallel=True))
+    np.testing.assert_allclose(pp, base, rtol=2e-4, atol=1e-5)
+
+
 def test_program_pipeline_with_dropout_runs():
     """Dropout keys fold the microbatch index (masks per microbatch);
     trajectory differs from single-device by design — train steps must
