@@ -295,9 +295,10 @@ def test_pipeline_parallel_differentiable():
 
 
 def _train_scan_transformer(mesh=None, strategy=None, steps=3,
-                            dropout=0.0, n_layer=4):
-    """Tiny scan-stacked transformer (enc+dec) trained `steps` Adam
-    steps; returns the per-step losses."""
+                            dropout=0.0, n_layer=4, optimizer=None):
+    """Tiny scan-stacked transformer (enc+dec) trained `steps` steps
+    (Adam unless an optimizer factory is given); returns the per-step
+    losses."""
     from paddle_tpu.models import transformer as T
     fluid.reset_default_programs()
     fluid.global_scope().clear()
@@ -306,14 +307,17 @@ def _train_scan_transformer(mesh=None, strategy=None, steps=3,
         src_vocab_size=64, trg_vocab_size=64, src_seq_len=8, trg_seq_len=8,
         n_layer=n_layer, d_model=16, d_inner=32, d_key=8, d_value=8,
         n_head=2, dropout_rate=dropout, scan_layers=True)
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    opt = optimizer() if optimizer is not None else \
+        fluid.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(avg_cost)
     if mesh is not None:
         transpile(fluid.default_main_program(), mesh, strategy)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
-    return [float(np.asarray(exe.run(feed=feed, fetch_list=[avg_cost])[0]))
-            for _ in range(steps)]
+    return [float(np.asarray(exe.run(
+        feed=feed, fetch_list=[avg_cost])[0]).reshape(()))
+        for _ in range(steps)]
 
 
 def test_program_pipeline_matches_single_device():
@@ -403,30 +407,17 @@ def test_program_pipeline_composes_with_grad_accum():
     the accumulator state and phase counter live OUTSIDE the pp
     shard_map, so accumulation semantics are unchanged — trajectory
     equals single device (loss repeats in pairs: k=2)."""
-    def run(mesh=None, strategy=None):
-        from paddle_tpu.models import transformer as T
-        fluid.reset_default_programs()
-        fluid.global_scope().clear()
-        fluid.default_main_program().random_seed = 7
-        cost, _ = T.transformer_base(
-            src_vocab_size=64, trg_vocab_size=64, src_seq_len=8,
-            trg_seq_len=8, n_layer=2, d_model=16, d_inner=32, d_key=8,
-            d_value=8, n_head=2, dropout_rate=0.0, scan_layers=True)
-        fluid.optimizer.GradientAccumulator(
-            fluid.optimizer.SGD(learning_rate=0.1), 2).minimize(cost)
-        if mesh is not None:
-            transpile(fluid.default_main_program(), mesh, strategy)
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(fluid.default_startup_program())
-        feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
-        return [float(np.asarray(exe.run(
-            feed=feed, fetch_list=[cost])[0])) for _ in range(4)]
+    def accum():
+        return fluid.optimizer.GradientAccumulator(
+            fluid.optimizer.SGD(learning_rate=0.1), 2)
 
-    base = run()
+    base = _train_scan_transformer(steps=4, n_layer=2, optimizer=accum)
     assert base[0] == base[1] and base[2] == base[3]  # k=2 gating
-    pp = run(mesh=make_mesh(dp=2, pp=2),
-             strategy=ParallelStrategy(data_parallel=True,
-                                       pipeline_parallel=True))
+    pp = _train_scan_transformer(
+        steps=4, n_layer=2, optimizer=accum,
+        mesh=make_mesh(dp=2, pp=2),
+        strategy=ParallelStrategy(data_parallel=True,
+                                  pipeline_parallel=True))
     np.testing.assert_allclose(pp, base, rtol=2e-4, atol=1e-5)
 
 
@@ -631,12 +622,17 @@ def test_parallel_executor_facade():
     pe.bcast_params()  # no-op, API compatibility
 
 
-def test_run_steps_on_mesh_with_stacked_feed():
-    """run_steps(stacked_feed=True) on a dp mesh: the var's PartitionSpec
+@pytest.mark.parametrize('mesh_kw,strat_kw', [
+    (dict(dp=8), dict(data_parallel=True)),
+    (dict(dp=4, tp=2), dict(data_parallel=True, tensor_parallel=True)),
+], ids=['dp8', 'dp4xtp2'])
+def test_run_steps_on_mesh_with_stacked_feed(mesh_kw, strat_kw):
+    """run_steps(stacked_feed=True) on a mesh: the var's PartitionSpec
     describes the per-step batch, so the superbatch shards with a
     replicated leading [steps] axis (steps need not divide the mesh) and
-    the trajectory equals per-step dispatch."""
-    steps = 3  # deliberately not divisible by the 8-way dp axis
+    the trajectory equals per-step dispatch — including under dp x tp
+    (auto-derived Megatron splits inside the scanned step)."""
+    steps = 3  # deliberately not divisible by either mesh's dp axis
     rng = np.random.RandomState(3)
     xs = rng.rand(steps, 16, 6).astype('float32')
     ys = rng.randint(0, 4, (steps, 16, 1)).astype('int64')
@@ -647,8 +643,8 @@ def test_run_steps_on_mesh_with_stacked_feed():
         loss = _build_mlp_loss()
         fluid.default_main_program().random_seed = 7
         fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
-        transpile(fluid.default_main_program(), make_mesh(dp=8),
-                  ParallelStrategy(data_parallel=True))
+        transpile(fluid.default_main_program(), make_mesh(**mesh_kw),
+                  ParallelStrategy(**strat_kw))
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(fluid.default_startup_program())
         return loss, exe
