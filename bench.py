@@ -135,6 +135,30 @@ def bench_transformer(batch=64, seq=64, vocab=32000, iters=20,
     return batch * seq / dt
 
 
+def bench_moe(batch=32, seq=64, vocab=32000, num_experts=8,
+              capacity_factor=1.25, n_layer=4, iters=10):
+    """Switch-MoE LM train throughput (tokens/s) — the ep-axis flagship
+    measured on one chip (routing + capacity dispatch overhead vs the
+    dense transformer). The capacity-factor sweep ablation quantifies
+    the drop-rate/throughput trade the Switch paper tunes."""
+    fluid = _fresh()
+    from paddle_tpu.models.moe import switch_transformer_lm
+    avg_cost, _ = switch_transformer_lm(
+        vocab_size=vocab, seq_len=seq, n_layer=n_layer, n_head=8,
+        d_model=512, d_inner=2048, num_experts=num_experts,
+        capacity_factor=capacity_factor, dropout_rate=0.1,
+        max_length=max(512, seq))
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    words = rng.randint(1, vocab, (batch, seq)).astype('int64')
+    feed = _to_device({'word': words,
+                       'label': np.roll(words, -1, axis=1)})
+    return batch * seq / _time_multi(exe, feed, [avg_cost], iters)
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -352,6 +376,11 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(batch=1, seq=1024, vocab=4096, iters=3) if reduced \
             else dict(batch=4, seq=1024, iters=10)
         val = bench_transformer(dropout=0.0, **kw)
+    elif workload.startswith('moe_cap'):
+        cap = float(workload[len('moe_cap'):])
+        kw = dict(batch=4, seq=16, vocab=512, num_experts=4, n_layer=2,
+                  iters=3) if reduced else {}
+        val = bench_moe(capacity_factor=cap, **kw)
     else:
         kw = dict(batch=4, image=64, iters=5) if reduced else {}
         val = bench_resnet50(**kw)
@@ -552,6 +581,22 @@ def main():
                 errors['attention_microbench'] = err
             else:
                 ablations['attention_fwdbwd_microbench'] = attn
+        if backend not in ('cpu',):
+            # MoE capacity-factor sweep (SURVEY §7.12's last pending
+            # interactive item): throughput at cap 1.0 / 1.25 / 2.0 —
+            # tighter capacity drops more tokens but dispatches less
+            moe_sweep = {}
+            for cap in ('1.0', '1.25', '2.0'):
+                if over_budget():
+                    break
+                tok_moe, err = _run_workload('moe_cap' + cap, backend,
+                                             reduced, timeout)
+                if err:
+                    errors['moe_cap' + cap] = err
+                else:
+                    moe_sweep['tok_per_sec_cap' + cap] = round(tok_moe, 1)
+            if moe_sweep:
+                ablations['moe_capacity_sweep'] = moe_sweep
         if backend not in ('cpu',) and not over_budget():
             # default PRNG on TPU is now rbg (executor._default_prng);
             # this ablation records what threefry costs (on cpu the
@@ -625,7 +670,8 @@ if __name__ == '__main__':
                        choices=['transformer', 'transformer_seq256',
                                 'transformer_seq1024', 'resnet50',
                                 'resnet50_anatomy', 'attention_microbench',
-                                'pallas_parity'])
+                                'pallas_parity', 'moe_cap1.0',
+                                'moe_cap1.25', 'moe_cap2.0'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()
