@@ -49,7 +49,11 @@ class ParallelStrategy(object):
         # program into trainer/pserver halves); here it partitions the
         # layer stack across the pp axis.
         self.pipeline_parallel = pipeline_parallel
-        # microbatches per pipeline pass (default: the pp axis size)
+        # Microbatches per pipeline pass (default: the pp axis size).
+        # Bubble fraction is (pp-1)/(n_micro+pp-1): at pp=4 the default
+        # n_micro=4 idles ~43% of stage-ticks, n_micro=16 ~16%. Raise it
+        # as far as per-microbatch batch size (batch % n_micro == 0 and
+        # enough tokens per step to fill the MXU) allows.
         self.pipeline_microbatches = pipeline_microbatches
 
 
