@@ -2,10 +2,13 @@
 sparse crosses + deep MLP over embeddings; BASELINE config 5).
 
 TPU-native: the reference trains this against a parameter server with
-sparse row updates (paddle/pserver). Here embedding tables are dense HBM
-arrays sharded over the mesh's 'tp' axis when transpiled (row-sharded
-lookup + psum), and the whole step is one XLA program — the dp-axis grad
-psum plays the pserver's role (SURVEY.md §2.4).
+sparse row updates (paddle/pserver). Here the is_sparse tables get both
+halves of that role: capacity — the table row-shards over the mesh when
+transpiled (lookup partitioned by GSPMD) — and update cost — under
+SGD/Adagrad the gradient is the O(batch x dim) row stack scattered in
+place (core/backward.py sparse_grads), never an O(vocab) dense grad.
+The whole step is one XLA program; the dp-axis grad psum plays the
+pserver's role (SURVEY.md §2.4).
 """
 
 from .. import layers

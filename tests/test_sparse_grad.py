@@ -208,3 +208,44 @@ def test_wide_deep_ctr_scale_table():
     assert losses[-1] < losses[0]
     after = np.asarray(fluid.global_scope().find('big')[:100])
     np.testing.assert_array_equal(before, after)  # untouched rows frozen
+
+
+def test_wide_deep_model_uses_sparse_grads():
+    """The actual wide&deep flagship (models/wide_deep.py): every
+    is_sparse table (deep + wide slots) takes the row-sparse path under
+    SGD, and the sparse trajectory equals the dense one."""
+    from paddle_tpu.models.wide_deep import build as build_wd
+
+    def train(force_dense, steps=3):
+        fluid.reset_default_programs()
+        fluid.global_scope().clear()
+        fluid.default_main_program().random_seed = 5
+        _, avg_cost, _, _feeds = build_wd(num_slots=4, vocab_size=200)
+        block = fluid.default_main_program().global_block()
+        if force_dense:
+            for p in fluid.default_main_program().all_parameters():
+                if getattr(p, 'sparse_grad', False):
+                    p.sparse_grad = False
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        marker = [op for op in block.ops
+                  if op.type == 'backward_marker'][0]
+        n_sparse = len(marker.attrs['sparse_grads'])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(steps):
+            feed = {'C%d' % i: rng.randint(0, 200, (8, 1)).astype('int64')
+                    for i in range(4)}
+            feed['dense'] = rng.rand(8, 13).astype('float32')
+            feed['label'] = rng.randint(0, 2, (8, 1)).astype('int64')
+            losses.append(float(np.asarray(exe.run(
+                feed=feed, fetch_list=[avg_cost])[0]).reshape(())))
+        return n_sparse, losses
+
+    n_sparse, sparse_losses = train(force_dense=False)
+    assert n_sparse == 8    # 4 deep + 4 wide tables all row-sparse
+    n_dense, dense_losses = train(force_dense=True)
+    assert n_dense == 0
+    np.testing.assert_allclose(sparse_losses, dense_losses,
+                               rtol=1e-5, atol=1e-6)
