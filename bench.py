@@ -470,6 +470,61 @@ def main():
         return False
 
     if not reduced and os.environ.get('BENCH_ABLATIONS', '1') != '0':
+        # Priority order: the round-4 diagnostics (step anatomy, the
+        # seq-1024 XLA-vs-Pallas pair, the attention microbench) run
+        # FIRST after the headline so a tight driver budget captures
+        # them; the long-standing ablations and sweeps follow.
+        if backend not in ('cpu',) and not over_budget(extra=150.0):
+            # fwd/bwd wall split + XLA cost analysis: decides whether
+            # the ResNet bwd gap is HBM-bandwidth floor (VERDICT r3 #2)
+            anatomy, err = _run_workload('resnet50_anatomy', backend,
+                                         reduced, timeout + 100)
+            if err:
+                errors['resnet50_anatomy'] = err
+            else:
+                ablations['resnet50_step_anatomy'] = anatomy
+        # Pallas gets its honest fwd+bwd shot at seq 1024 where the
+        # dispatch gate is actually open (seq >= 512, d_head 64); at the
+        # headline's seq 64 the gate never dispatches, so an ablation
+        # there would measure the identical XLA path. The pair below is
+        # the dated on-chip XLA-vs-Pallas table (VERDICT r3 #8).
+        # reserve both legs' worst case up front (2 x (timeout+100)):
+        # extra = timeout + 200 makes over_budget hold back
+        # timeout + extra = 2*timeout + 200
+        if backend not in ('cpu',) and not over_budget(
+                extra=timeout + 200.0):
+            tok_1k, err = _run_workload(
+                'transformer_seq1024', backend, reduced, timeout + 100)
+            if err:
+                errors['transformer_seq1024'] = err
+            elif not over_budget(extra=100.0):
+                ablations['transformer_tok_per_sec_seq1024'] = \
+                    round(tok_1k, 1)
+                # the Pallas leg only means something against the XLA
+                # leg, and the relay's Pallas compile can hang — keep
+                # its own watchdog
+                tok_1kp, err = _run_workload(
+                    'transformer_seq1024', backend, reduced, timeout + 100,
+                    env={'PADDLE_TPU_USE_PALLAS': '1'})
+                if err:
+                    errors['transformer_seq1024_pallas'] = err
+                else:
+                    ablations['transformer_tok_per_sec_seq1024_pallas'] = \
+                        round(tok_1kp, 1)
+                    ablations['seq1024_attention_winner'] = \
+                        'pallas' if tok_1kp > tok_1k * 1.02 else 'xla'
+            else:
+                ablations['transformer_tok_per_sec_seq1024'] = \
+                    round(tok_1k, 1)
+        if backend not in ('cpu',) and not over_budget():
+            # isolated fwd+bwd attention, XLA vs Pallas, seq 1024/4096
+            # d_head 64 (its own watchdog: relay Pallas compiles hang)
+            attn, err = _run_workload('attention_microbench', backend,
+                                      reduced, timeout)
+            if err:
+                errors['attention_microbench'] = err
+            else:
+                ablations['attention_fwdbwd_microbench'] = attn
         layout_env = {}
         if backend not in ('cpu',) and not over_budget():
             # default layout on TPU is now NHWC (ops/conv_ops.py); this
@@ -530,57 +585,6 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_scan_layers'] = \
                     round(tok_scan, 1)
-        # Pallas gets its honest fwd+bwd shot at seq 1024 where the
-        # dispatch gate is actually open (seq >= 512, d_head 64); at the
-        # headline's seq 64 the gate never dispatches, so an ablation
-        # there would measure the identical XLA path. The pair below is
-        # the dated on-chip XLA-vs-Pallas table (VERDICT r3 #8).
-        # reserve both legs' worst case up front (2 x (timeout+100)):
-        # extra = timeout + 200 makes over_budget hold back
-        # timeout + extra = 2*timeout + 200
-        if backend not in ('cpu',) and not over_budget(
-                extra=timeout + 200.0):
-            tok_1k, err = _run_workload(
-                'transformer_seq1024', backend, reduced, timeout + 100)
-            if err:
-                errors['transformer_seq1024'] = err
-            elif not over_budget(extra=100.0):
-                ablations['transformer_tok_per_sec_seq1024'] = \
-                    round(tok_1k, 1)
-                # the Pallas leg only means something against the XLA
-                # leg, and the relay's Pallas compile can hang — keep
-                # its own watchdog
-                tok_1kp, err = _run_workload(
-                    'transformer_seq1024', backend, reduced, timeout + 100,
-                    env={'PADDLE_TPU_USE_PALLAS': '1'})
-                if err:
-                    errors['transformer_seq1024_pallas'] = err
-                else:
-                    ablations['transformer_tok_per_sec_seq1024_pallas'] = \
-                        round(tok_1kp, 1)
-                    ablations['seq1024_attention_winner'] = \
-                        'pallas' if tok_1kp > tok_1k * 1.02 else 'xla'
-            else:
-                ablations['transformer_tok_per_sec_seq1024'] = \
-                    round(tok_1k, 1)
-        if backend not in ('cpu',) and not over_budget(extra=150.0):
-            # fwd/bwd wall split + XLA cost analysis: decides whether
-            # the ResNet bwd gap is HBM-bandwidth floor (VERDICT r3 #2)
-            anatomy, err = _run_workload('resnet50_anatomy', backend,
-                                         reduced, timeout + 100)
-            if err:
-                errors['resnet50_anatomy'] = err
-            else:
-                ablations['resnet50_step_anatomy'] = anatomy
-        if backend not in ('cpu',) and not over_budget():
-            # isolated fwd+bwd attention, XLA vs Pallas, seq 1024/4096
-            # d_head 64 (its own watchdog: relay Pallas compiles hang)
-            attn, err = _run_workload('attention_microbench', backend,
-                                      reduced, timeout)
-            if err:
-                errors['attention_microbench'] = err
-            else:
-                ablations['attention_fwdbwd_microbench'] = attn
         if backend not in ('cpu',):
             # MoE capacity-factor sweep (SURVEY §7.12's last pending
             # interactive item): throughput at cap 1.0 / 1.25 / 2.0 —
