@@ -71,6 +71,20 @@ def _slot_to_input(slot):
     return ''.join(part.capitalize() for part in slot.split('_'))
 
 
+def _pipeline_state(ctx):
+    """(mesh, pp_conf, pipelined) for a stack op. pipelined is True when
+    the program was transpiled with ParallelStrategy(pipeline_parallel=
+    True) onto a mesh with an active 'pp' axis — the lowering then runs
+    the GPipe microbatch schedule (parallel/pipeline.py) instead of one
+    flat lax.scan, with stage s holding layers [s*L/pp, (s+1)*L/pp)."""
+    program = ctx.block.program
+    mesh = getattr(program, 'mesh', None)
+    pp_conf = getattr(program, 'pipeline', None)
+    pipelined = bool(pp_conf) and mesh is not None and \
+        dict(mesh.shape).get('pp', 1) > 1
+    return mesh, pp_conf, pipelined
+
+
 @register('transformer_layer_stack')
 def _transformer_layer_stack(ctx):
     x = ctx.input('X')
@@ -81,15 +95,7 @@ def _transformer_layer_stack(ctx):
     n_head = ctx.attr('n_head', 1)
     rate = ctx.attr('dropout_rate', 0.0)
     is_test = ctx.attr('is_test', False) or ctx.is_test
-    program = ctx.block.program
-    mesh = getattr(program, 'mesh', None)
-    pp_conf = getattr(program, 'pipeline', None)
-    # Program-level pipeline parallelism: transpile(strategy=
-    # ParallelStrategy(pipeline_parallel=True)) on a mesh with an active
-    # 'pp' axis routes this op through the GPipe schedule instead of one
-    # flat lax.scan — stage s holds layers [s*L/pp, (s+1)*L/pp).
-    pipelined = bool(pp_conf) and mesh is not None and \
-        dict(mesh.shape).get('pp', 1) > 1
+    mesh, pp_conf, pipelined = _pipeline_state(ctx)
 
     slots = DEC_SLOTS if is_decoder else ENC_SLOTS
     params = {s: ctx.env[ctx.op.input(_slot_to_input(s))] for s in slots}
@@ -187,11 +193,7 @@ def _moe_layer_stack(ctx):
     cap_factor = ctx.attr('capacity_factor', 1.25)
     k = ctx.attr('top_k', 1)
     is_test = ctx.attr('is_test', False) or ctx.is_test
-    program = ctx.block.program
-    mesh = getattr(program, 'mesh', None)
-    pp_conf = getattr(program, 'pipeline', None)
-    pipelined = bool(pp_conf) and mesh is not None and \
-        dict(mesh.shape).get('pp', 1) > 1
+    mesh, pp_conf, pipelined = _pipeline_state(ctx)
     params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
               for s in MOE_SLOTS}
     n_layer = next(iter(params.values())).shape[0]
