@@ -294,11 +294,11 @@ def test_pipeline_parallel_differentiable():
                                rtol=2e-4, atol=1e-5)
 
 
-def _train_scan_transformer(mesh=None, strategy=None, steps=3,
-                            dropout=0.0, n_layer=4, optimizer=None):
-    """Tiny scan-stacked transformer (enc+dec) trained `steps` steps
-    (Adam unless an optimizer factory is given); returns the per-step
-    losses."""
+def _build_scan_transformer(mesh=None, strategy=None, dropout=0.0,
+                            n_layer=4, optimizer=None):
+    """Tiny scan-stacked transformer (enc+dec), minimized (Adam unless
+    an optimizer factory is given), transpiled onto `mesh`, startup run.
+    Returns (cost, exe) — the one copy of this build recipe."""
     from paddle_tpu.models import transformer as T
     fluid.reset_default_programs()
     fluid.global_scope().clear()
@@ -314,7 +314,21 @@ def _train_scan_transformer(mesh=None, strategy=None, steps=3,
         transpile(fluid.default_main_program(), mesh, strategy)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
-    feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
+    return avg_cost, exe
+
+
+def _scan_transformer_feed():
+    from paddle_tpu.models import transformer as T
+    return T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
+
+
+def _train_scan_transformer(mesh=None, strategy=None, steps=3,
+                            dropout=0.0, n_layer=4, optimizer=None):
+    """Build + train `steps` steps on a constant batch; returns the
+    per-step losses."""
+    avg_cost, exe = _build_scan_transformer(mesh, strategy, dropout,
+                                            n_layer, optimizer)
+    feed = _scan_transformer_feed()
     return [float(np.asarray(exe.run(
         feed=feed, fetch_list=[avg_cost])[0]).reshape(()))
         for _ in range(steps)]
@@ -378,26 +392,16 @@ def test_program_pipeline_composes_with_sp():
 def test_program_pipeline_composes_with_run_steps():
     """The pipelined step under Executor.run_steps (shard_map inside the
     multi-step lax.scan): trajectory equals per-step dispatch."""
-    from paddle_tpu.models import transformer as T
     mesh = make_mesh(dp=1, pp=2)
     strat = ParallelStrategy(data_parallel=False, pipeline_parallel=True)
 
     per_step = _train_scan_transformer(mesh=mesh, strategy=strat, steps=4,
                                        n_layer=2)
 
-    fluid.reset_default_programs()
-    fluid.global_scope().clear()
-    fluid.default_main_program().random_seed = 7
-    avg_cost, _ = T.transformer_base(
-        src_vocab_size=64, trg_vocab_size=64, src_seq_len=8, trg_seq_len=8,
-        n_layer=2, d_model=16, d_inner=32, d_key=8, d_value=8,
-        n_head=2, dropout_rate=0.0, scan_layers=True)
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
-    transpile(fluid.default_main_program(), mesh, strat)
-    exe = fluid.Executor(fluid.CPUPlace())
-    exe.run(fluid.default_startup_program())
-    feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
-    out = exe.run_steps(4, feed=feed, fetch_list=[avg_cost])
+    avg_cost, exe = _build_scan_transformer(mesh=mesh, strategy=strat,
+                                            n_layer=2)
+    out = exe.run_steps(4, feed=_scan_transformer_feed(),
+                        fetch_list=[avg_cost])
     windowed = np.asarray(out[0]).reshape(-1).tolist()
     np.testing.assert_allclose(windowed, per_step, rtol=2e-4, atol=1e-5)
 
@@ -479,35 +483,18 @@ def test_checkpoint_portable_across_meshes(tmp_path):
     sharded: stage-split stacks, Megatron tp splits) loads on a single
     device and continues with the same trajectory — save gathers global
     values, so checkpoints are mesh-layout-free."""
-    from paddle_tpu.models import transformer as T
-
-    def build(mesh=None, strategy=None):
-        fluid.reset_default_programs()
-        fluid.global_scope().clear()
-        fluid.default_main_program().random_seed = 7
-        cost, _ = T.transformer_base(
-            src_vocab_size=64, trg_vocab_size=64, src_seq_len=8,
-            trg_seq_len=8, n_layer=2, d_model=16, d_inner=32, d_key=8,
-            d_value=8, n_head=2, dropout_rate=0.0, scan_layers=True)
-        fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
-        if mesh is not None:
-            transpile(fluid.default_main_program(), mesh, strategy)
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(fluid.default_startup_program())
-        return cost, exe
-
-    feed = T.make_fake_batch(8, 8, 8, 64, 64, seed=3)
-    cost, exe = build(make_mesh(dp=2, pp=2, tp=2),
-                      ParallelStrategy(data_parallel=True,
-                                       tensor_parallel=True,
-                                       pipeline_parallel=True))
+    feed = _scan_transformer_feed()
+    cost, exe = _build_scan_transformer(
+        make_mesh(dp=2, pp=2, tp=2),
+        ParallelStrategy(data_parallel=True, tensor_parallel=True,
+                         pipeline_parallel=True), n_layer=2)
     for _ in range(2):
         exe.run(feed=feed, fetch_list=[cost])
     fluid.io.save_checkpoint(exe, str(tmp_path), step=2)
     l_mesh = [float(np.asarray(exe.run(
         feed=feed, fetch_list=[cost])[0]).reshape(())) for _ in range(2)]
 
-    cost, exe = build()
+    cost, exe = _build_scan_transformer(n_layer=2)
     assert fluid.io.load_checkpoint(exe, str(tmp_path)) == 2
     l_single = [float(np.asarray(exe.run(
         feed=feed, fetch_list=[cost])[0]).reshape(())) for _ in range(2)]
