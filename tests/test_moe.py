@@ -196,7 +196,8 @@ def test_moe_scan_layers_ep_mesh():
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
-def _train_moe_pp(mesh=None, strategy=None, aux_weight=0.0, steps=3):
+def _train_moe_pp(mesh=None, strategy=None, aux_weight=0.0, steps=3,
+                  top_k=1):
     """Stacked MoE LM, capacity_factor high enough that nothing drops
     (pipelined routing is per-microbatch, so only the no-drop regime is
     bit-comparable to the full-batch scan)."""
@@ -206,7 +207,7 @@ def _train_moe_pp(mesh=None, strategy=None, aux_weight=0.0, steps=3):
     fluid.default_main_program().random_seed = 7
     cost, _ = switch_transformer_lm(
         vocab_size=64, seq_len=8, n_layer=2, n_head=2, d_model=16,
-        d_inner=32, num_experts=4, capacity_factor=4.0,
+        d_inner=32, num_experts=4, capacity_factor=4.0, top_k=top_k,
         aux_weight=aux_weight, scan_layers=True)
     fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
     if mesh is not None:
@@ -221,14 +222,16 @@ def _train_moe_pp(mesh=None, strategy=None, aux_weight=0.0, steps=3):
         for _ in range(steps)]
 
 
-def test_moe_pipeline_ep_matches_single_device():
+@pytest.mark.parametrize('top_k', [1, 2])
+def test_moe_pipeline_ep_matches_single_device(top_k):
     """Program-path pipelining of the MoE stack (pp x ep): stage-sharded
     layers, expert weights still 'ep'-split inside the stage (GSPMD
     manages ep under the pp-manual shard_map), aux accumulated over
     valid ticks only. aux_weight=0 + no capacity drops -> trajectory
-    equals single device."""
-    base = _train_moe_pp()
+    equals single device — Switch top-1 AND GShard top-2 routing."""
+    base = _train_moe_pp(top_k=top_k)
     pp_ep = _train_moe_pp(
+        top_k=top_k,
         mesh=make_mesh(dp=1, pp=2, ep=4),
         strategy=ParallelStrategy(data_parallel=False,
                                   pipeline_parallel=True))
