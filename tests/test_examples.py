@@ -1,0 +1,43 @@
+"""The examples/ directory stays runnable: each script executes
+end-to-end on CPU in a subprocess (compile-heavy ones get generous
+watchdogs). The C inference example is covered by tests/test_capi.py's
+compiled-client tests."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, timeout=420):
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    # force CPU in-script BEFORE any device query: under the hosted
+    # sitecustomize the env-var route still probes the (possibly hung)
+    # TPU relay first — force_host_cpu is the one home of that dance
+    boot = ("from paddle_tpu.core.platform_boot import force_host_cpu; "
+            "force_host_cpu(); "
+            "import runpy; runpy.run_path(%r, run_name='__main__')"
+            % os.path.join(REPO, 'examples', name))
+    r = subprocess.run([sys.executable, '-c', boot],
+                       capture_output=True, text=True, env=env,
+                       timeout=timeout)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r.stdout
+
+
+def test_fit_a_line_example():
+    out = _run_example('train_fit_a_line.py')
+    assert 'reloaded model max abs err' in out
+
+
+def test_pipelined_transformer_example():
+    out = _run_example('train_transformer_pipelined.py')
+    assert 'step 9' in out
+
+
+def test_ctr_sparse_resume_example():
+    out = _run_example('train_ctr_sparse_resume.py')
+    assert 'expect 8' in out
+    assert 'epoch finished' in out
