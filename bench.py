@@ -527,22 +527,25 @@ def main():
                 ablations['attention_fwdbwd_microbench'] = attn
         layout_env = {}
         if backend not in ('cpu',) and not over_budget():
-            # default layout on TPU is now NHWC (ops/conv_ops.py); this
-            # ablation measures NCHW and still promotes it if it wins
-            # (cpu default is already NCHW — nothing to compare there)
+            # default on TPU is now the IR-native NHWC network (zero
+            # boundary transposes, models/resnet.py data_format); this
+            # ablation measures the old NCHW-IR form (whose lowering
+            # still applies the per-conv NHWC trick) and still promotes
+            # it if it wins (cpu default is already NCHW-IR)
             img_nchw, err = _run_workload(
                 'resnet50', backend, reduced, timeout,
-                env={'PADDLE_TPU_CONV_LAYOUT': 'NCHW'})
+                env={'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'})
             if err:
-                errors['resnet50_nchw'] = err
+                errors['resnet50_nchw_ir'] = err
             else:
-                ablations['resnet50_img_per_sec_nchw'] = round(img_nchw, 1)
+                ablations['resnet50_img_per_sec_nchw_ir'] = round(
+                    img_nchw, 1)
                 if img_s is not None and img_nchw > img_s:
-                    ablations['resnet50_layout_winner'] = 'NCHW'
-                    layout_env = {'PADDLE_TPU_CONV_LAYOUT': 'NCHW'}
+                    ablations['resnet50_layout_winner'] = 'NCHW_IR'
+                    layout_env = {'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'}
                     img_s = img_nchw  # headline takes the faster layout
-                else:
-                    ablations['resnet50_layout_winner'] = 'NHWC'
+                elif img_s is not None:
+                    ablations['resnet50_layout_winner'] = 'NHWC_IR'
         if not over_budget():
             # carries the winning layout so only the BN compute differs
             img_bn, err = _run_workload(

@@ -123,12 +123,19 @@ def _pair(v):
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           use_mkldnn=False, act=None, name=None):
-    """2-D convolution, NCHW/OIHW (reference nn.py:conv2d, conv_op.cc)."""
+           use_mkldnn=False, act=None, name=None, data_format='NCHW'):
+    """2-D convolution (reference nn.py:conv2d, conv_op.cc).
+
+    data_format='NHWC' keeps the *activations* channels-last in the IR
+    (the TPU-native layout; the filter parameter stays OIHW so
+    checkpoints are layout-free). With it, a conv/bn/pool network runs
+    end-to-end without a single layout transpose.
+    """
     helper = LayerHelper('conv2d', **locals())
     dtype = input.dtype
     groups = groups or 1
-    num_channels = input.shape[1]
+    nhwc = data_format == 'NHWC'
+    num_channels = input.shape[3] if nhwc else input.shape[1]
     fh, fw = _pair(filter_size)
     sh, sw = _pair(stride)
     ph, pw = _pair(padding)
@@ -140,17 +147,23 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                                 dtype=dtype,
                                 default_initializer=Normal(0.0, std))
     pre_bias = helper.create_variable_for_type_inference(dtype)
-    n, c, h, w_in = input.shape
+    if nhwc:
+        n, h, w_in, c = input.shape
+    else:
+        n, c, h, w_in = input.shape
     oh = (h + 2 * ph - (dh * (fh - 1) + 1)) // sh + 1 if h and h > 0 else h
     ow = (w_in + 2 * pw - (dw * (fw - 1) + 1)) // sw + 1 \
         if w_in and w_in > 0 else w_in
-    pre_bias.shape = (n, num_filters, oh, ow)
+    pre_bias.shape = (n, oh, ow, num_filters) if nhwc \
+        else (n, num_filters, oh, ow)
     helper.append_op(
         type='conv2d', inputs={'Input': [input], 'Filter': [w]},
         outputs={'Output': [pre_bias]},
         attrs={'strides': [sh, sw], 'paddings': [ph, pw],
-               'dilations': [dh, dw], 'groups': groups})
-    pre_act = _append_bias(helper, pre_bias, [num_filters], axis=1)
+               'dilations': [dh, dw], 'groups': groups,
+               'data_format': data_format})
+    pre_act = _append_bias(helper, pre_bias, [num_filters],
+                           axis=3 if nhwc else 1)
     return helper.append_activation(pre_act)
 
 
@@ -192,26 +205,31 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, use_mkldnn=False, name=None, exclusive=True):
+           ceil_mode=False, use_mkldnn=False, name=None, exclusive=True,
+           data_format='NCHW'):
     helper = LayerHelper('pool2d', **locals())
     kh, kw = _pair(pool_size)
     sh, sw = _pair(pool_stride)
     ph, pw = _pair(pool_padding)
     out = helper.create_variable_for_type_inference(input.dtype)
-    n, c, h, w = input.shape
+    nhwc = data_format == 'NHWC'
+    if nhwc:
+        n, h, w, c = input.shape
+    else:
+        n, c, h, w = input.shape
     if global_pooling:
-        out.shape = (n, c, 1, 1)
+        out.shape = (n, 1, 1, c) if nhwc else (n, c, 1, 1)
     else:
         rnd = (lambda a, b: -(-a // b)) if ceil_mode else (lambda a, b: a // b)
-        out.shape = (n, c,
-                     rnd(h + 2 * ph - kh, sh) + 1 if h and h > 0 else -1,
-                     rnd(w + 2 * pw - kw, sw) + 1 if w and w > 0 else -1)
+        oh = rnd(h + 2 * ph - kh, sh) + 1 if h and h > 0 else -1
+        ow = rnd(w + 2 * pw - kw, sw) + 1 if w and w > 0 else -1
+        out.shape = (n, oh, ow, c) if nhwc else (n, c, oh, ow)
     helper.append_op(
         type='pool2d', inputs={'X': [input]}, outputs={'Out': [out]},
         attrs={'pooling_type': pool_type, 'ksize': [kh, kw],
                'strides': [sh, sw], 'paddings': [ph, pw],
                'global_pooling': global_pooling, 'ceil_mode': ceil_mode,
-               'exclusive': exclusive})
+               'exclusive': exclusive, 'data_format': data_format})
     return out
 
 

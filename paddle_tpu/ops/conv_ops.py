@@ -29,8 +29,8 @@ def _conv_layout():
 
 @register('conv2d')
 def _conv2d(ctx):
-    x = ctx.input('Input')  # NCHW
-    w = ctx.input('Filter')  # OIHW
+    x = ctx.input('Input')  # NCHW (or NHWC when data_format says so)
+    w = ctx.input('Filter')  # OIHW (parameter layout is fixed either way)
     strides = tuple(ctx.attr('strides', [1, 1]))
     pads = ctx.attr('paddings', [0, 0])
     dilations = tuple(ctx.attr('dilations', [1, 1]))
@@ -38,7 +38,18 @@ def _conv2d(ctx):
     padding = [(pads[0], pads[0]), (pads[1], pads[1])] if len(pads) == 2 \
         else [(pads[0], pads[1]), (pads[2], pads[3])]
     pref = x.dtype if x.dtype == jnp.float32 else None
-    if _conv_layout() == 'NHWC':
+    if ctx.attr('data_format', 'NCHW') == 'NHWC':
+        # Activations are NHWC *in the IR* (layers.conv2d data_format=
+        # 'NHWC'): no boundary transposes at all — the whole network
+        # stays channels-last end-to-end, which is the TPU-native
+        # layout ((8,128) vector tiling over W,C).
+        out = jax.lax.conv_general_dilated(
+            x, w.transpose(2, 3, 1, 0),
+            window_strides=strides, padding=padding,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            preferred_element_type=pref)
+    elif _conv_layout() == 'NHWC':
         out = jax.lax.conv_general_dilated(
             x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
             window_strides=strides, padding=padding,
@@ -98,23 +109,36 @@ def _conv3d(ctx):
 
 
 def _pool2d_impl(x, pooling_type, ksize, strides, pads, global_pooling,
-                 ceil_mode=False, exclusive=True, adaptive=False):
-    n, c, h, w = x.shape
+                 ceil_mode=False, exclusive=True, adaptive=False,
+                 data_format='NCHW'):
+    if data_format == 'NHWC':
+        n, h, w, c = x.shape
+        spatial = (1, 2)
+    else:
+        n, c, h, w = x.shape
+        spatial = (2, 3)
     if global_pooling or (adaptive and tuple(ksize) == (1, 1)):
         if pooling_type == 'max':
-            return x.max(axis=(2, 3), keepdims=True)
-        return x.mean(axis=(2, 3), keepdims=True)
+            return x.max(axis=spatial, keepdims=True)
+        return x.mean(axis=spatial, keepdims=True)
     kh, kw = ksize
     sh, sw = strides
     ph, pw = pads
-    window = (1, 1, kh, kw)
-    stride = (1, 1, sh, sw)
-    padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    eh = ew = 0
     if ceil_mode:
         # pad extra on the bottom/right so ceil-division windows fit
         eh = max(0, (-(h + 2 * ph - kh) % sh))
         ew = max(0, (-(w + 2 * pw - kw) % sw))
+    if data_format == 'NHWC':
+        window = (1, kh, kw, 1)
+        stride = (1, sh, sw, 1)
+        padding = ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))
+        ones_shape = (1, h, w, 1)
+    else:
+        window = (1, 1, kh, kw)
+        stride = (1, 1, sh, sw)
         padding = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
+        ones_shape = (1, 1, h, w)
     if pooling_type == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -123,7 +147,7 @@ def _pool2d_impl(x, pooling_type, ksize, strides, pads, global_pooling,
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
                                    padding)
     if exclusive and (ph or pw or ceil_mode):
-        ones = jnp.ones((1, 1, h, w), dtype=x.dtype)
+        ones = jnp.ones(ones_shape, dtype=x.dtype)
         counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                        stride, padding)
         return summed / jnp.maximum(counts, 1.0)
@@ -142,7 +166,8 @@ def _pool2d(ctx):
         ctx.attr('paddings', [0, 0]),
         ctx.attr('global_pooling', False),
         ceil_mode=ctx.attr('ceil_mode', False),
-        exclusive=ctx.attr('exclusive', True))
+        exclusive=ctx.attr('exclusive', True),
+        data_format=ctx.attr('data_format', 'NCHW'))
     ctx.set_output('Out', out)
 
 
