@@ -286,10 +286,16 @@ def attention_microbench(batch_tokens=4096, d=64, heads=8, inner=8,
             jmany = jax.jit(many)
             # warm-up compiles; its OUTPUTS feed the timed call — the
             # relay memoizes byte-identical executions (SURVEY §5.1),
-            # so re-timing the same inputs would measure the relay
-            q1, k1, v1 = jax.block_until_ready(jmany(q0, k0, v0))
+            # so re-timing the same inputs would measure the relay.
+            # Sync via np.asarray, NOT block_until_ready: on the relay
+            # the latter returns at enqueue (_time_steps comment), and
+            # timing it produced physically impossible sub-FLOP-floor
+            # numbers (the original r4 capture's 0.014 ms "results").
+            q1, k1, v1 = jmany(q0, k0, v0)
+            np.asarray(q1)
             t0 = time.perf_counter()
-            jax.block_until_ready(jmany(q1, k1, v1))
+            q2, k2, v2 = jmany(q1, k1, v1)
+            np.asarray(q2)
             dt = (time.perf_counter() - t0) / inner
             out['seq%d_%s_fwdbwd_ms' % (seq, name)] = round(dt * 1e3, 3)
         xla = out['seq%d_xla_fwdbwd_ms' % seq]
