@@ -11,6 +11,8 @@ optimizer — into one XLA computation.
 from . import linear  # noqa: F401
 from . import lenet  # noqa: F401
 from . import vgg  # noqa: F401
+from . import alexnet  # noqa: F401
+from . import googlenet  # noqa: F401
 from . import resnet  # noqa: F401
 from . import mobilenet  # noqa: F401
 from . import resnext  # noqa: F401

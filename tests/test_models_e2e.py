@@ -213,3 +213,29 @@ def test_srl_crf_tagger_trains_and_decodes():
     paths = exe.run(feed=feed, fetch_list=[decoded])[0]
     acc = (paths == targets).mean()
     assert acc > 0.5, (acc, losses[-1])
+
+
+def test_alexnet_tiny():
+    from paddle_tpu.models.alexnet import alexnet
+    img = fluid.layers.data(name='img', shape=[3, 67, 67], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = alexnet(img, class_dim=10)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(11)
+    xs = rng.rand(4, 3, 67, 67).astype('float32')
+    ys = rng.randint(0, 10, (4, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=6)
+
+
+def test_googlenet_tiny():
+    from paddle_tpu.models.googlenet import googlenet
+    img = fluid.layers.data(name='img', shape=[3, 64, 64], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = googlenet(img, class_dim=10)
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    rng = np.random.RandomState(12)
+    xs = rng.rand(4, 3, 64, 64).astype('float32')
+    ys = rng.randint(0, 10, (4, 1)).astype('int64')
+    _train(loss, lambda i: {'img': xs, 'label': ys}, steps=6)
