@@ -13,3 +13,4 @@ from .collective import (all_gather, all_reduce, all_to_all, broadcast,  # noqa
 from .ring_attention import ring_attention  # noqa: F401
 from .pipeline import pipeline, pipelined_apply  # noqa: F401
 from .executor import ParallelExecutor  # noqa: F401
+from . import multihost  # noqa: F401
