@@ -665,6 +665,27 @@ def main():
         detail['ablations'] = ablations
     if errors:
         detail['errors'] = errors
+    if backend == 'cpu' and degraded:
+        # Relay outage at capture time (the round-3 failure mode): carry
+        # the most recent full-shape on-chip capture, clearly labeled,
+        # so the artifact still records the chip evidence + provenance.
+        try:
+            cap_path = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), 'BENCH_builder_r4_onchip.json')
+            with open(cap_path) as f:
+                cap = json.load(f)
+            detail['last_onchip_capture'] = {
+                'provenance': 'builder-run full bench.py on the real '
+                              'chip earlier this round (relay was up); '
+                              'file ' + os.path.basename(cap_path),
+                'transformer_tok_per_sec':
+                    cap['detail'].get('transformer_tok_per_sec'),
+                'resnet50_img_per_sec':
+                    cap['detail'].get('resnet50_img_per_sec'),
+                'vs_baseline': cap.get('vs_baseline'),
+            }
+        except Exception:
+            pass
 
     print(json.dumps({
         'metric': metric,
