@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from . import interpret_mode
 
-DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_Q = int(os.environ.get('PADDLE_TPU_PALLAS_BLOCK_Q', '512'))
 BLOCK_K = 128  # = one lane tile; keeps m/l lane-replication trivial
 _NEG_INF = -1e30
 
