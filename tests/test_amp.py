@@ -233,3 +233,34 @@ def test_resnet50_data_format_arg_builds_nhwc_shapes():
         n_filters = block.var(op.input('Filter')).shape[0]
         assert shape[-1] == n_filters, (shape, n_filters)
     assert any(op.type == 'transpose' for op in block.ops)
+
+
+def test_mobilenet_native_nhwc_matches_nchw():
+    """MobileNet's depthwise/pointwise stack threads data_format too
+    (depthwise convs are the layout-sensitive case: feature_group_count
+    = C with HWIO filters)."""
+    from paddle_tpu.models.mobilenet import mobile_net
+
+    def run(fmt):
+        fluid.reset_default_programs()
+        fluid.global_scope().clear()
+        fluid.default_main_program().random_seed = 5
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        pred = mobile_net(img, class_dim=10, scale=0.25, data_format=fmt)
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(1)
+        feed = {'img': rng.rand(4, 3, 32, 32).astype('f'),
+                'label': rng.randint(0, 10, (4, 1)).astype('int64')}
+        return [float(np.asarray(exe.run(feed=feed,
+                                         fetch_list=[cost])[0]).reshape(()))
+                for _ in range(3)]
+
+    np.testing.assert_allclose(run('NCHW'), run('NHWC'),
+                               rtol=2e-4, atol=2e-5)
