@@ -28,8 +28,15 @@ class ParallelStrategy(object):
     def __init__(self, data_parallel=True, tensor_parallel=False,
                  sequence_parallel=False, tp_rules=None, sp_vars=None,
                  shard_embeddings=True, pipeline_parallel=False,
-                 pipeline_microbatches=None):
+                 pipeline_microbatches=None, shard_optimizer_states=False):
         self.data_parallel = data_parallel
+        # ZeRO-1 (beyond reference; the scaling-book optimizer-state
+        # recipe): optimizer accumulators additionally shard over 'dp'
+        # on their first free divisible axis. GSPMD then derives the
+        # comms — the grad allreduce becomes reduce-scatter at the
+        # update and the fresh params all-gather into the next forward;
+        # per-chip state memory drops by ~dp x (2x params for Adam).
+        self.shard_optimizer_states = shard_optimizer_states
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
         # tp_rules: list of (param-name-substring, axis-index) pairs deciding
@@ -271,6 +278,24 @@ def transpile(program, mesh, strategy=None):
     # Velocity, ...). Name strings play no part, so colliding names
     # cannot mis-shard (reference analog: accumulators live beside the
     # param on its pserver shard, go/pserver/service.go).
+    n_dp = dict(mesh.shape).get('dp', 1)
+
+    def _zero1_spec(spec, shape):
+        """Extend a state var's param-derived spec with 'dp' on its
+        first free axis whose size divides evenly (ZeRO-1). Returns the
+        original spec when dp is off/1, the flag is off, or no axis
+        qualifies."""
+        if not strategy.shard_optimizer_states or n_dp <= 1 or not shape:
+            return spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        if 'dp' in parts:
+            return spec
+        for i, (p, dim) in enumerate(zip(parts, shape)):
+            if p is None and dim and dim % n_dp == 0:
+                parts[i] = 'dp'
+                return P(*parts)
+        return spec
+
     for op in block.ops:
         pnames = op.inputs.get('Param')
         if not pnames:
@@ -286,7 +311,7 @@ def transpile(program, mesh, strategy=None):
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable and n not in shardings \
                         and v.shape == pvar.shape:
-                    shardings[n] = spec
+                    shardings[n] = _zero1_spec(spec, v.shape)
 
     # Remaining persistable state (lr, beta_pow, BN stats, ...) replicates.
     for var in program.list_vars():
