@@ -28,7 +28,8 @@ class ParallelStrategy(object):
     def __init__(self, data_parallel=True, tensor_parallel=False,
                  sequence_parallel=False, tp_rules=None, sp_vars=None,
                  shard_embeddings=True, pipeline_parallel=False,
-                 pipeline_microbatches=None, shard_optimizer_states=False):
+                 pipeline_microbatches=None, shard_optimizer_states=False,
+                 fully_shard_parameters=False):
         self.data_parallel = data_parallel
         # ZeRO-1 (beyond reference; the scaling-book optimizer-state
         # recipe): optimizer accumulators additionally shard over 'dp'
@@ -37,6 +38,13 @@ class ParallelStrategy(object):
         # update and the fresh params all-gather into the next forward;
         # per-chip state memory drops by ~dp x (2x params for Adam).
         self.shard_optimizer_states = shard_optimizer_states
+        # ZeRO-3 / FSDP: the PARAMETERS themselves (and their grads,
+        # and — via the structural state loop — their accumulators)
+        # also take 'dp' on a free divisible axis. XLA all-gathers each
+        # weight at its use site and reduce-scatters its grad; weight
+        # memory drops ~dp x at the cost of per-layer all-gathers.
+        # Row-sharded sparse tables keep their own scheme (skipped).
+        self.fully_shard_parameters = fully_shard_parameters
         self.tensor_parallel = tensor_parallel
         self.sequence_parallel = sequence_parallel
         # tp_rules: list of (param-name-substring, axis-index) pairs deciding
@@ -249,6 +257,24 @@ def transpile(program, mesh, strategy=None):
         program.pipeline = {
             'n_micro': int(strategy.pipeline_microbatches or n_pp)}
 
+    n_dp = dict(mesh.shape).get('dp', 1)
+
+    def _dp_extend(spec, shape, enabled):
+        """Extend a spec with 'dp' on the first free axis whose size
+        divides the dp extent (the ZeRO family's sharding move).
+        Returns the original spec when disabled, dp <= 1, 'dp' is
+        already used, or no axis qualifies."""
+        if not enabled or n_dp <= 1 or not shape:
+            return spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        if 'dp' in parts:
+            return spec
+        for i, (p, dim) in enumerate(zip(parts, shape)):
+            if p is None and dim and dim % n_dp == 0:
+                parts[i] = 'dp'
+                return P(*parts)
+        return spec
+
     for var in program.list_vars():
         if var.shape is None:
             continue
@@ -259,8 +285,18 @@ def transpile(program, mesh, strategy=None):
                     if strategy.tp_rules else auto_tp.get(var.name)
             if spec is None:
                 spec = _expert_shard_spec_for(var, mesh)
+            row_sharded = False
             if spec is None and strategy.shard_embeddings:
                 spec = _row_shard_spec_for(var, mesh)
+                row_sharded = spec is not None
+            if not row_sharded:
+                # ZeRO-3/FSDP: weights themselves take 'dp'; row-sharded
+                # sparse tables keep their own scheme
+                spec = _dp_extend(spec if spec is not None else P(),
+                                  var.shape,
+                                  strategy.fully_shard_parameters)
+                if spec == P():
+                    spec = None
             shardings[var.name] = spec if spec is not None else P()
             if spec is not None:
                 shardings[var.name + GRAD_SUFFIX] = spec
@@ -278,24 +314,6 @@ def transpile(program, mesh, strategy=None):
     # Velocity, ...). Name strings play no part, so colliding names
     # cannot mis-shard (reference analog: accumulators live beside the
     # param on its pserver shard, go/pserver/service.go).
-    n_dp = dict(mesh.shape).get('dp', 1)
-
-    def _zero1_spec(spec, shape):
-        """Extend a state var's param-derived spec with 'dp' on its
-        first free axis whose size divides evenly (ZeRO-1). Returns the
-        original spec when dp is off/1, the flag is off, or no axis
-        qualifies."""
-        if not strategy.shard_optimizer_states or n_dp <= 1 or not shape:
-            return spec
-        parts = list(spec) + [None] * (len(shape) - len(spec))
-        if 'dp' in parts:
-            return spec
-        for i, (p, dim) in enumerate(zip(parts, shape)):
-            if p is None and dim and dim % n_dp == 0:
-                parts[i] = 'dp'
-                return P(*parts)
-        return spec
-
     for op in block.ops:
         pnames = op.inputs.get('Param')
         if not pnames:
@@ -311,7 +329,8 @@ def transpile(program, mesh, strategy=None):
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable and n not in shardings \
                         and v.shape == pvar.shape:
-                    shardings[n] = _zero1_spec(spec, v.shape)
+                    shardings[n] = _dp_extend(
+                        spec, v.shape, strategy.shard_optimizer_states)
 
     # Remaining persistable state (lr, beta_pow, BN stats, ...) replicates.
     for var in program.list_vars():

@@ -733,3 +733,23 @@ def test_zero1_composes_with_tensor_parallel():
     # (6 % 2 == 0 under dp=2)
     m1 = tuple(shardings['w1_moment1_acc'])
     assert 'tp' in m1 and 'dp' in m1, m1
+
+
+def test_fsdp_parameter_sharding_matches_single_device():
+    """ParallelStrategy(fully_shard_parameters=True): weights, grads,
+    and state all take 'dp' (ZeRO-3/FSDP); XLA all-gathers weights at
+    use and reduce-scatters grads. Numerics == single device."""
+    loss_1, w1_1 = _train_k_steps(mesh=None, opt='adam')
+    mesh = make_mesh(dp=8)
+    loss_f, w1_f = _train_k_steps(
+        mesh=mesh,
+        strategy=ParallelStrategy(data_parallel=True,
+                                  fully_shard_parameters=True,
+                                  shard_optimizer_states=True),
+        opt='adam')
+    assert abs(loss_1 - loss_f) < 1e-4, (loss_1, loss_f)
+    np.testing.assert_allclose(w1_1, w1_f, rtol=1e-4, atol=1e-5)
+    shardings = fluid.default_main_program().var_shardings
+    # w1 [6,16]: axis0 % 8 != 0, axis1 16 % 8 == 0 -> P(None, 'dp')
+    assert 'dp' in tuple(shardings['w1']), shardings['w1']
+    assert tuple(shardings['w1_moment1_acc']) == tuple(shardings['w1'])
