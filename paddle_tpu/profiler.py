@@ -108,3 +108,45 @@ class StepTimer(object):
     @property
     def mean(self):
         return sum(self.times) / len(self.times) if self.times else 0.0
+
+
+def memory_report(exe=None, program=None, feed=None, fetch_list=None):
+    """Compile the training/eval step for `program` (default main) and
+    return XLA's memory analysis as a dict of byte counts:
+
+        {'temp_bytes', 'argument_bytes', 'output_bytes',
+         'alias_bytes', 'generated_code_bytes', 'peak_estimate_bytes'}
+
+    peak_estimate = temp + argument (donated args alias outputs, so
+    this upper-bounds live HBM during the step). The reference exposes
+    allocator telemetry via its profiler; here memory is XLA's, so the
+    compiled executable is the source of truth. Works on any backend
+    (CPU included) — useful for sizing remat policies and ZeRO/FSDP
+    shardings before touching hardware."""
+    import jax
+    from .core.executor import Executor
+    from .core.place import CPUPlace
+
+    exe = exe or Executor(CPUPlace())
+    fn, scope_vals, feed_vals = exe.compile_step(
+        program=program, feed=feed or {}, fetch_list=fetch_list or [])
+    import numpy as np
+    compiled = jax.jit(fn).lower(scope_vals, feed_vals,
+                                 np.int32(0)).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return {}
+    out = {}
+    for key, attr in (('temp_bytes', 'temp_size_in_bytes'),
+                      ('argument_bytes', 'argument_size_in_bytes'),
+                      ('output_bytes', 'output_size_in_bytes'),
+                      ('alias_bytes', 'alias_size_in_bytes'),
+                      ('generated_code_bytes',
+                       'generated_code_size_in_bytes')):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if 'temp_bytes' in out and 'argument_bytes' in out:
+        out['peak_estimate_bytes'] = (out['temp_bytes'] +
+                                      out['argument_bytes'])
+    return out
