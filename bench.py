@@ -340,6 +340,17 @@ def _run_workload_child(workload, backend, reduced):
     if backend == 'cpu':
         from paddle_tpu.core.platform_boot import force_host_cpu
         force_host_cpu()
+    cache_dir = os.environ.get('JAX_COMPILATION_CACHE_DIR')
+    if cache_dir:
+        # env alone does not arm the cache on this jax build; the
+        # explicit config does (verified: entries appear). A re-run of a
+        # workload killed mid-compile then starts from the cached
+        # executable instead of re-burning its watchdog budget.
+        try:
+            import jax
+            jax.config.update('jax_compilation_cache_dir', cache_dir)
+        except Exception:
+            pass
     if workload == 'pallas_parity':
         print('RESULT_JSON %s' % json.dumps(pallas_parity()), flush=True)
         return
@@ -434,6 +445,12 @@ def _run_workload(workload, backend, reduced, timeout, env=None):
 
 def main():
     t_start = time.time()
+    # Persistent XLA compile cache, inherited by every workload child: a
+    # re-run of a workload that previously timed out mid-compile starts
+    # from the cached executable instead of burning its watchdog budget
+    # on the same compile. Harmless where the backend ignores it.
+    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                          '/tmp/paddle_tpu_jax_cache')
     forced = os.environ.get('BENCH_BACKEND')
     if forced:
         backend, degraded = forced, False
