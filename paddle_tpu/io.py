@@ -55,14 +55,14 @@ def _gather_to_host(value):
     return value
 
 
-def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
-    import jax
-    main_program = main_program or default_main_program()
+def _snapshot_vars(main_program, vars=None, predicate=None):
+    """Device->host snapshot of the requested vars: the synchronous half
+    of a save. Must run on the caller's thread BEFORE the next training
+    step — donated parameter buffers are reused by the step, so a
+    deferred read would touch deleted buffers."""
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
-    os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
     arrays, manifest = {}, {}
     for v in vars:
@@ -73,11 +73,34 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         arrays[v.name] = arr
         manifest[v.name] = {'dtype': dtype_name,
                             'shape': list(np.asarray(arr).shape)}
+    return arrays, manifest
+
+
+def _write_snapshot(dirname, arrays, manifest, filename=None):
+    """Disk half of a save: atomic via tmp + rename, so a crash mid-
+    write cannot corrupt a previous checkpoint in the same dirname."""
+    os.makedirs(dirname, exist_ok=True)
+    params_path = os.path.join(dirname, filename or _PARAMS_FILE)
+    if not params_path.endswith('.npz'):
+        params_path += '.npz'
+    tmp = params_path + '.tmp'
+    with open(tmp, 'wb') as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, params_path)
+    man_path = os.path.join(dirname, _MANIFEST_FILE)
+    with open(man_path + '.tmp', 'w') as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(man_path + '.tmp', man_path)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax
+    main_program = main_program or default_main_program()
+    arrays, manifest = _snapshot_vars(main_program, vars, predicate)
     # one writer per pod: every host gathered the same global values
     if jax.process_index() == 0:
-        np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
-        with open(os.path.join(dirname, _MANIFEST_FILE), 'w') as f:
-            json.dump(manifest, f, indent=1)
+        _write_snapshot(dirname, arrays, manifest, filename)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices('paddle_tpu_save_vars')
@@ -171,29 +194,82 @@ def load_inference_model(dirname, executor, model_filename=None,
     return program, meta['feed_names'], fetch_vars
 
 
+class AsyncSaveHandle(object):
+    """Returned by save_checkpoint(async_save=True). result() joins the
+    writer thread and re-raises any write error."""
+
+    def __init__(self, thread, errbox):
+        self._thread = thread
+        self._errbox = errbox
+
+    def done(self):
+        return not self._thread.is_alive()
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError('checkpoint write still in progress')
+        if self._errbox:
+            raise self._errbox[0]
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=None,
-                    reader=None):
+                    reader=None, async_save=False):
     """Full training checkpoint: every persistable incl. optimizer state.
 
     reader: a reader.CheckpointableReader — its (epoch, offset, seed)
     is persisted alongside, so load_checkpoint resumes the data stream
     mid-epoch with exactly the untrained remainder (the reference data
     master's etcd task-queue recovery, go/master/service.go:165-213,
-    done masterless via deterministic replay)."""
-    save_persistables(executor, dirname, main_program)
+    done masterless via deterministic replay).
+
+    async_save: snapshot device->host synchronously (donated buffers
+    make deferred reads unsafe), then serialize + write on a background
+    thread; training continues immediately. Returns an AsyncSaveHandle
+    whose result() is the completeness point; writes are atomic (tmp +
+    rename), so a crash mid-write leaves the previous checkpoint
+    intact. Multihost runs fall back to the synchronous path — the
+    completion barrier may not run off-thread (it would race the
+    training step's collectives)."""
+    import jax
     meta = {}
     if step is not None:
         meta['step'] = int(step)
     if reader is not None:
         meta['reader'] = reader.state_dict()
-    if meta:
-        import jax
-        # single writer, like save_persistables; positional sharding
-        # advances every host's reader identically, so process 0's
-        # (epoch, offset) is valid for all shards
-        if jax.process_index() == 0:
-            with open(os.path.join(dirname, 'checkpoint.json'), 'w') as f:
+
+    def _write_meta():
+        if meta:
+            # single writer, like save_persistables; positional sharding
+            # advances every host's reader identically, so process 0's
+            # (epoch, offset) is valid for all shards
+            path = os.path.join(dirname, 'checkpoint.json')
+            with open(path + '.tmp', 'w') as f:
                 json.dump(meta, f)
+            os.replace(path + '.tmp', path)
+
+    if async_save and jax.process_count() == 1:
+        main = main_program or default_main_program()
+        arrays, manifest = _snapshot_vars(main, predicate=_is_persistable)
+        errbox = []
+
+        def _writer():
+            try:
+                _write_snapshot(dirname, arrays, manifest)
+                _write_meta()
+            except BaseException as e:  # surfaced via handle.result()
+                errbox.append(e)
+
+        import threading
+        t = threading.Thread(target=_writer, daemon=True,
+                             name='paddle_tpu_async_save')
+        t.start()
+        return AsyncSaveHandle(t, errbox)
+
+    save_persistables(executor, dirname, main_program)
+    if jax.process_index() == 0:
+        _write_meta()
+    return None
 
 
 def load_checkpoint(executor, dirname, main_program=None, reader=None):

@@ -120,3 +120,42 @@ def test_reader_state_mismatched_seed_rejected(tmp_path):
     import pytest
     with pytest.raises(ValueError, match='seed'):
         other.load_state_dict(state)
+
+
+def test_async_checkpoint_snapshot_isolation(tmp_path):
+    """save_checkpoint(async_save=True): training continues while the
+    write happens; the checkpoint holds the values AT save time, not
+    the post-save ones; writes are atomic."""
+    import paddle_tpu as fluid
+    d = str(tmp_path / 'ckpt_async')
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name='aw'))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(8, 4).astype('f'), 'y': rng.rand(8, 1).astype('f')}
+    exe.run(feed=feed, fetch_list=[loss])
+    w_at_save = np.asarray(fluid.global_scope().find('aw')).copy()
+
+    handle = fluid.io.save_checkpoint(exe, d, step=1, async_save=True)
+    # keep training while the writer thread runs
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+    w_after = np.asarray(fluid.global_scope().find('aw'))
+    assert not np.allclose(w_at_save, w_after)  # training moved on
+    handle.result(timeout=30)
+    assert handle.done()
+
+    # restore into a fresh scope: must equal the AT-SAVE values
+    fluid.global_scope().clear()
+    exe.run(fluid.default_startup_program())
+    step = fluid.io.load_checkpoint(exe, d)
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find('aw')), w_at_save)
