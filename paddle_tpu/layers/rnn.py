@@ -9,7 +9,7 @@ paddle_tpu/ops/rnn_ops.py for the lax.scan recurrences).
 from .helper import LayerHelper
 
 __all__ = ['dynamic_lstm', 'dynamic_lstmp', 'dynamic_gru', 'gru_unit',
-           'lstm_unit']
+           'lstm_unit', 'simple_rnn']
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
@@ -117,6 +117,33 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
         attrs={'is_reverse': is_reverse,
                'gate_activation': gate_activation,
                'activation': candidate_activation})
+    return hidden
+
+
+def simple_rnn(input, act='tanh', is_reverse=False, param_attr=None,
+               bias_attr=None, h_0=None, name=None, length=None):
+    """Elman RNN h_t = act(x_t + h_{t-1} @ W + b) over a padded
+    [B, T, D] batch (the v1 recurrent_layer; no fluid analog — the
+    reference serves this via recurrent_group + mixed steps)."""
+    helper = LayerHelper('simple_rnn', **locals())
+    dtype = input.dtype
+    d = int(input.shape[-1])
+    w = helper.create_parameter(attr=helper.param_attr, shape=[d, d],
+                                dtype=dtype)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    hidden.shape = tuple(input.shape)
+    inputs = {'Input': [input], 'Weight': [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(attr=helper.bias_attr, shape=[1, d],
+                                       dtype=dtype, is_bias=True)
+        inputs['Bias'] = [bias]
+    if h_0 is not None:
+        inputs['H0'] = [h_0]
+    if length is not None:
+        inputs['Length'] = [length]
+    helper.append_op(type='simple_rnn', inputs=inputs,
+                     outputs={'Hidden': [hidden]},
+                     attrs={'activation': act, 'is_reverse': is_reverse})
     return hidden
 
 

@@ -116,6 +116,38 @@ def gru_scan(x_proj, w_h, bias, h0, length=None, gate_act=jax.nn.sigmoid,
     return hidden
 
 
+def simple_rnn_scan(x_proj, w_h, bias, h0, length=None, act=jnp.tanh,
+                    is_reverse=False):
+    """Elman recurrence h_t = act(x_t + h_{t-1} @ W + b) over x_proj
+    [B, T, D] (the v1 recurrent_layer / gserver RecurrentLayer
+    semantics — the input is already projected, like lstm/gru here)."""
+    b, t, d = x_proj.shape
+    mask = _mask_from_length(length, b, t, x_proj.dtype)
+    if is_reverse:
+        x_proj = jnp.flip(x_proj, axis=1)
+        if mask is not None:
+            mask = jnp.flip(mask, axis=1)
+    xs = jnp.swapaxes(x_proj, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(h_prev, inp):
+        xt, m = inp if ms is not None else (inp, None)
+        pre = xt + h_prev @ w_h
+        if bias is not None:
+            pre = pre + bias.reshape(1, -1)
+        h = act(pre)
+        if m is not None:
+            h = m * h + (1 - m) * h_prev
+        return h, h
+
+    inputs = xs if ms is None else (xs, ms)
+    _, hs = jax.lax.scan(step, h0, inputs)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hidden = jnp.flip(hidden, axis=1)
+    return hidden
+
+
 _ACTS = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh, 'relu': jax.nn.relu,
          'identity': (lambda x: x)}
 
@@ -213,6 +245,21 @@ def _gru(ctx):
         x, w, bias, h0, length,
         gate_act=_ACTS[ctx.attr('gate_activation', 'sigmoid')],
         cand_act=_ACTS[ctx.attr('activation', 'tanh')],
+        is_reverse=ctx.attr('is_reverse', False))
+    ctx.set_output('Hidden', hidden)
+
+
+@register('simple_rnn')
+def _simple_rnn(ctx):
+    x = ctx.input('Input')          # [B, T, D] pre-projected
+    w = ctx.input('Weight')         # [D, D]
+    bias = ctx.input('Bias') if ctx.has_input('Bias') else None
+    length = ctx.input('Length') if ctx.has_input('Length') else None
+    h0 = ctx.input('H0') if ctx.has_input('H0') else \
+        jnp.zeros((x.shape[0], w.shape[0]), x.dtype)
+    hidden = simple_rnn_scan(
+        x, w, bias, h0, length,
+        act=_ACTS[ctx.attr('activation', 'tanh')],
         is_reverse=ctx.attr('is_reverse', False))
     ctx.set_output('Hidden', hidden)
 

@@ -1,0 +1,19 @@
+"""v1 `trainer_config_helpers` compatibility surface.
+
+Reference: python/paddle/trainer_config_helpers/__init__.py (star-export
+of layers/networks/activations/poolings/attrs/optimizers/evaluators —
+the declarative API the legacy trainer consumed, and the layer
+vocabulary v2 re-exported). A v1 config ports by changing
+`from paddle.trainer_config_helpers import *` to
+`from paddle_tpu.trainer_config_helpers import *`; every helper builds
+fluid IR eagerly (see layers.py for the semantics and the documented
+divergences).
+"""
+
+from .activations import *      # noqa: F401,F403
+from .attrs import *            # noqa: F401,F403
+from .layers import *           # noqa: F401,F403
+from .networks import *         # noqa: F401,F403
+from .optimizers import *       # noqa: F401,F403
+from .poolings import *         # noqa: F401,F403
+from . import evaluators        # noqa: F401

@@ -1,0 +1,44 @@
+"""v1 activation objects (reference:
+python/paddle/trainer_config_helpers/activations.py — each class carries
+the config-time name of a gserver activation). Here each carries the
+fluid activation string the layer shim hands to the registered lowering.
+"""
+
+__all__ = ['BaseActivation', 'TanhActivation', 'SigmoidActivation',
+           'SoftmaxActivation', 'IdentityActivation', 'LinearActivation',
+           'SequenceSoftmaxActivation', 'ExpActivation', 'ReluActivation',
+           'BReluActivation', 'SoftReluActivation', 'STanhActivation',
+           'AbsActivation', 'SquareActivation', 'LogActivation',
+           'SqrtActivation', 'ReciprocalActivation', 'SoftSignActivation']
+
+
+class BaseActivation(object):
+    name = None
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+def _mk(cls_name, act):
+    cls = type(cls_name, (BaseActivation,), {'name': act})
+    return cls
+
+
+TanhActivation = _mk('TanhActivation', 'tanh')
+SigmoidActivation = _mk('SigmoidActivation', 'sigmoid')
+SoftmaxActivation = _mk('SoftmaxActivation', 'softmax')
+IdentityActivation = _mk('IdentityActivation', None)
+LinearActivation = IdentityActivation
+SequenceSoftmaxActivation = _mk('SequenceSoftmaxActivation',
+                                'sequence_softmax')
+ExpActivation = _mk('ExpActivation', 'exp')
+ReluActivation = _mk('ReluActivation', 'relu')
+BReluActivation = _mk('BReluActivation', 'brelu')
+SoftReluActivation = _mk('SoftReluActivation', 'soft_relu')
+STanhActivation = _mk('STanhActivation', 'stanh')
+AbsActivation = _mk('AbsActivation', 'abs')
+SquareActivation = _mk('SquareActivation', 'square')
+LogActivation = _mk('LogActivation', 'log')
+SqrtActivation = _mk('SqrtActivation', 'sqrt')
+ReciprocalActivation = _mk('ReciprocalActivation', 'reciprocal')
+SoftSignActivation = _mk('SoftSignActivation', 'softsign')

@@ -1,0 +1,99 @@
+"""v1 parameter/layer attributes (reference:
+python/paddle/trainer_config_helpers/attrs.py — ParameterAttribute
+carries init/regularization/lr config into the config protobuf). Here
+`ParameterAttribute.to_fluid()` builds the equivalent fluid ParamAttr;
+the layer shim calls it on every param_attr it receives, so both v1
+attribute objects and plain fluid ParamAttr work.
+"""
+
+__all__ = ['HookAttr', 'ParamAttr', 'ExtraAttr', 'ParameterAttribute',
+           'ExtraLayerAttribute']
+
+
+class HookAttr(object):
+    """Config-time parameter hook (pruning era); recorded, not applied."""
+
+    def __init__(self, type=None, sparsity_ratio=None):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+
+
+class ParameterAttribute(object):
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, update_hooks=None,
+                 initializer=None):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+        self.initializer = initializer
+
+    def to_fluid(self):
+        from ..param_attr import ParamAttr as FluidParamAttr
+        from .. import initializer as I
+        from .. import regularizer as R
+        init = self.initializer
+        if init is None and (self.initial_std is not None
+                             or self.initial_mean is not None):
+            init = I.Normal(loc=self.initial_mean or 0.0,
+                            scale=self.initial_std
+                            if self.initial_std is not None else 0.01)
+        elif init is None and (self.initial_max is not None
+                               or self.initial_min is not None):
+            init = I.Uniform(low=self.initial_min or 0.0,
+                             high=self.initial_max or 1.0)
+        reg = None
+        if self.l2_rate:
+            reg = R.L2Decay(self.l2_rate)
+        elif self.l1_rate:
+            reg = R.L1Decay(self.l1_rate)
+        return FluidParamAttr(
+            name=self.name, initializer=init,
+            learning_rate=self.learning_rate
+            if self.learning_rate is not None else 1.0,
+            regularizer=reg, trainable=not self.is_static)
+
+
+class ExtraLayerAttribute(object):
+    """drop_rate is honored (the shim appends a dropout op); device/
+    error_clipping belong to Place/var.error_clip in this framework."""
+
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+ParamAttr = ParameterAttribute
+ExtraAttr = ExtraLayerAttribute
+
+
+def to_fluid_param_attr(attr):
+    """ParameterAttribute | fluid ParamAttr | str | None -> fluid form."""
+    if attr is None or isinstance(attr, (str, bool)):
+        return attr
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_fluid()
+    return attr
+
+
+def apply_extra_attr(out, layer_attr):
+    """Post-layer hook for ExtraLayerAttribute (drop_rate, error clip)."""
+    if layer_attr is None:
+        return out
+    if getattr(layer_attr, 'error_clipping_threshold', None):
+        out.error_clip = layer_attr.error_clipping_threshold
+    if getattr(layer_attr, 'drop_rate', None):
+        from .. import layers as _fl
+        out = _fl.dropout(out, dropout_prob=layer_attr.drop_rate)
+    return out

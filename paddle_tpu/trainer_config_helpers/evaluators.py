@@ -1,0 +1,99 @@
+"""v1 evaluators (reference:
+python/paddle/trainer_config_helpers/evaluators.py — config-time
+declarations resolved by gserver evaluator kernels). Each shim appends
+the corresponding metric op(s) to the program and returns the metric
+var(s) for fetch_list; printer evaluators map to layers.Print.
+"""
+
+from .. import layers as _fl
+
+__all__ = ['evaluator_base', 'classification_error_evaluator',
+           'auc_evaluator', 'pnpair_evaluator',
+           'precision_recall_evaluator', 'ctc_error_evaluator',
+           'chunk_evaluator', 'sum_evaluator', 'column_sum_evaluator',
+           'value_printer_evaluator', 'gradient_printer_evaluator',
+           'maxid_printer_evaluator', 'maxframe_printer_evaluator',
+           'seqtext_printer_evaluator',
+           'classification_error_printer_evaluator',
+           'detection_map_evaluator']
+
+
+def evaluator_base(*args, **kwargs):
+    raise NotImplementedError('subclass-style evaluator declaration is '
+                              'config-era; call a concrete *_evaluator')
+
+
+def classification_error_evaluator(input, label, name=None, weight=None,
+                                   top_k=1, **kwargs):
+    acc = _fl.accuracy(input=input, label=label, k=top_k)
+    return _fl.scale(acc, scale=-1.0, bias=1.0)  # error = 1 - accuracy
+
+
+def auc_evaluator(input, label, name=None, weight=None):
+    auc, _, _ = _fl.auc(input=input, label=label)
+    return auc
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None):
+    pos, neg, _ = _fl.positive_negative_pair(input, label, query_id)
+    return pos, neg
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               weight=None, name=None):
+    idx = _fl.argmax(input, axis=-1)
+    return _fl.precision_recall(indices=idx, labels=label,
+                                class_number=int(input.shape[-1]))
+
+
+def ctc_error_evaluator(input, label, name=None):
+    decoded = _fl.ctc_greedy_decoder(input=input, blank=0)
+    dist, _ = _fl.edit_distance(decoded, label)
+    return dist
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None):
+    return _fl.chunk_eval(input=input, label=label,
+                          chunk_scheme=chunk_scheme,
+                          num_chunk_types=num_chunk_types)
+
+
+def sum_evaluator(input, name=None, weight=None):
+    return _fl.reduce_sum(input)
+
+
+def column_sum_evaluator(input, name=None, weight=None):
+    return _fl.reduce_sum(input, dim=0)
+
+
+def value_printer_evaluator(input, name=None):
+    return _fl.Print(input, message=name or 'value')
+
+
+def gradient_printer_evaluator(input, name=None):
+    return _fl.Print(input, message=name or 'gradient',
+                     print_phase='backward')
+
+
+def maxid_printer_evaluator(input, name=None):
+    return _fl.Print(_fl.argmax(input, axis=-1), message=name or 'maxid')
+
+
+def maxframe_printer_evaluator(input, name=None):
+    return _fl.Print(_fl.reduce_max(input, dim=-1),
+                     message=name or 'maxframe')
+
+
+def seqtext_printer_evaluator(input, result_file=None, name=None, **kw):
+    return _fl.Print(input, message=name or 'seqtext')
+
+
+def classification_error_printer_evaluator(input, label, name=None):
+    err = classification_error_evaluator(input, label)
+    return _fl.Print(err, message=name or 'classification_error')
+
+
+def detection_map_evaluator(input, label, name=None, **kwargs):
+    from ..metrics import DetectionMAP
+    return DetectionMAP(**kwargs)

@@ -1,0 +1,849 @@
+"""v1 layer API over the fluid IR.
+
+Reference: python/paddle/trainer_config_helpers/layers.py:1 (7,531 LoC of
+declarative layer definitions emitting the v1 config protobuf that the
+legacy trainer/gserver stack consumed). Here every helper builds fluid
+IR ops EAGERLY into the default program, exactly like the v2 shim
+(`paddle_tpu/v2/layer.py`) — LayerOutput IS the fluid Variable, and a v1
+config function becomes an ordinary model builder whose Program compiles
+to one XLA computation. SURVEY §6.2 descoped the v1 *runtime* (gserver);
+this module closes the v1 *API* gap on top of the lowerings we already
+have, so legacy configs port by changing only the import line.
+
+Divergences (documented, tested):
+- Sequence-ness lives on the data layer (`seq_type=1` / `dtype=`), not
+  in a DataProvider config — there is no config parser here. Sequences
+  are padded [B, T, ...] with a companion '<name>_len' mask var
+  (SURVEY §6 LoD stance), carried through sequence-preserving layers.
+- recurrent_group / beam_search generation: use fluid DynamicRNN /
+  layers.beam_search — the step-function style maps 1:1.
+- Unlisted names raise NotImplementedError naming the fluid equivalent.
+"""
+
+import math
+
+from .. import layers as _fl
+from .activations import BaseActivation
+from .attrs import apply_extra_attr, to_fluid_param_attr
+
+__all__ = [
+    'LayerOutput', 'data_layer', 'fc_layer', 'embedding_layer',
+    'mixed_layer', 'full_matrix_projection', 'identity_projection',
+    'table_projection', 'dotmul_projection', 'scaling_projection',
+    'trans_full_matrix_projection', 'context_projection',
+    'dotmul_operator',
+    'pooling_layer', 'last_seq', 'first_seq', 'expand_layer',
+    'repeat_layer', 'seq_reshape_layer', 'seq_concat_layer',
+    'lstmemory', 'grumemory', 'recurrent_layer',
+    'img_conv_layer', 'img_pool_layer', 'batch_norm_layer',
+    'img_cmrnorm_layer', 'maxout_layer', 'spp_layer', 'pad_layer',
+    'roi_pool_layer', 'bilinear_interp_layer',
+    'addto_layer', 'concat_layer', 'cos_sim', 'l2_distance_layer',
+    'trans_layer', 'rotate_layer', 'scaling_layer', 'slope_intercept_layer',
+    'interpolation_layer', 'power_layer', 'sum_to_one_norm_layer',
+    'row_l2_norm_layer', 'clip_layer', 'dropout_layer', 'prelu_layer',
+    'maxid_layer', 'sampling_id_layer', 'multiplex_layer',
+    'tensor_layer', 'dot_prod_layer', 'out_prod_layer', 'row_conv_layer',
+    'crop_layer', 'conv_shift_layer', 'gated_unit_layer',
+    'linear_comb_layer', 'convex_comb_layer',
+    'square_error_cost', 'regression_cost', 'classification_cost',
+    'cross_entropy', 'multi_binary_label_cross_entropy', 'sum_cost',
+    'rank_cost', 'huber_regression_cost', 'huber_classification_cost',
+    'smooth_l1_cost', 'lambda_cost', 'cross_entropy_with_selfnorm',
+    'crf_layer', 'crf_decoding_layer', 'ctc_layer', 'warp_ctc_layer',
+    'nce_layer', 'hsigmoid',
+    'print_layer', 'printer_layer', 'eos_layer',
+    'AggregateLevel', 'ExpandLevel', 'layer_support',
+]
+
+#: v1 LayerOutput == fluid Variable (eager IR build; docstring above).
+from ..core.program import Variable as LayerOutput  # noqa: E402
+
+
+class AggregateLevel(object):
+    TO_NO_SEQUENCE = 'non-seq'
+    TO_SEQUENCE = 'seq'
+    EACH_TIMESTEP = 'non-seq'
+
+
+class ExpandLevel(object):
+    FROM_NO_SEQUENCE = 'non-seq'
+    FROM_SEQUENCE = 'seq'
+
+
+def layer_support(*args, **kwargs):  # decorator in v1; identity here
+    def deco(fn):
+        return fn
+    return deco if not (len(args) == 1 and callable(args[0])) else args[0]
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, BaseActivation) or isinstance(type(act), type) and \
+            hasattr(act, 'name'):
+        return act.name
+    return act
+
+
+def _apply_act(x, act):
+    name = _act_name(act)
+    if name is None:
+        return x
+    fn = getattr(_fl, name, None)
+    if fn is None:
+        raise ValueError('unknown activation %r' % name)
+    return fn(x)
+
+
+def _pa(attr):
+    return to_fluid_param_attr(attr)
+
+
+def _propagate_len(src, out):
+    lv = getattr(src, '_v2_len_var', None)
+    if lv is not None:
+        out._v2_len_var = lv
+    return out
+
+
+def _len_of(x):
+    return getattr(x, '_v2_len_var', None)
+
+
+def data_layer(name, size, depth=None, height=None, width=None,
+               dtype='float32', seq_type=0, layer_attr=None):
+    """v1 data_layer is a flat float slot of `size` (images reshape at
+    the first conv). Divergence: integer-id and sequence slots are
+    declared HERE (dtype='int64' / seq_type=1) instead of in a
+    DataProvider config."""
+    if seq_type:
+        shape = [-1] if dtype.startswith('int') and size > 1 else \
+            ([-1, size] if not dtype.startswith('int') else [-1])
+        var = _fl.data(name=name, shape=shape, dtype=dtype, lod_level=1)
+        var._v2_len_var = _fl.data(name=name + '_len', shape=[],
+                                   dtype='int32')
+    elif height and width:
+        ch = size // (height * width)
+        var = _fl.data(name=name, shape=[ch, height, width], dtype=dtype)
+    else:
+        var = _fl.data(name=name, shape=[size] if size > 1 or
+                       not dtype.startswith('int') else [1], dtype=dtype)
+    var._v1_size = size
+    return var
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    if isinstance(input, (list, tuple)):
+        input = _fl.concat([_flatten2(v) for v in input], axis=-1)
+    out = _fl.fc(input=input, size=size, act=_act_name(act),
+                 param_attr=_pa(param_attr), bias_attr=_pa(bias_attr)
+                 if bias_attr is not None else None, name=name,
+                 num_flatten_dims=2 if _is_seq(input) else 1)
+    return apply_extra_attr(_propagate_len(input, out), layer_attr)
+
+
+def _is_seq(v):
+    return _len_of(v) is not None
+
+
+def _flatten2(v):
+    if v.shape is not None and len(v.shape) > 2 and not _is_seq(v):
+        return _fl.reshape(v, [v.shape[0] if v.shape[0] else -1, -1])
+    return v
+
+
+def embedding_layer(input, size, name=None, param_attr=None,
+                    layer_attr=None):
+    vocab = getattr(input, '_v1_size', None)
+    if vocab is None or not str(input.dtype).startswith('int'):
+        raise ValueError(
+            "embedding_layer needs an integer data_layer input "
+            "(data_layer(..., dtype='int64', seq_type=1), size=vocab)")
+    out = _fl.embedding(input=input, size=[vocab, size],
+                        param_attr=_pa(param_attr))
+    return apply_extra_attr(_propagate_len(input, out), layer_attr)
+
+
+# ---------------------------------------------------------------- mixed
+
+class _Projection(object):
+    """Config-time projection marker; materialized by mixed_layer
+    (reference layers.py full_matrix_projection et al. — each became a
+    gserver Projection appended to a MixedLayer)."""
+
+    def __init__(self, kind, input, size=0, param_attr=None, **kw):
+        self.kind = kind
+        self.input = input
+        self.size = size
+        self.param_attr = param_attr
+        self.kw = kw
+
+    def build(self, size):
+        x = self.input
+        size = self.size or size
+        if self.kind == 'full':
+            return _fl.fc(input=x, size=size, bias_attr=False,
+                          param_attr=_pa(self.param_attr),
+                          num_flatten_dims=2 if _is_seq(x) else 1)
+        if self.kind == 'trans_full':
+            w = _fl.create_parameter(shape=[size, int(x.shape[-1])],
+                                     dtype='float32',
+                                     attr=_pa(self.param_attr))
+            return _fl.matmul(x, w, transpose_y=True)
+        if self.kind == 'identity':
+            off = self.kw.get('offset')
+            if off is not None:
+                return _fl.slice(x, axes=[x.ndim - 1 if hasattr(x, 'ndim')
+                                          else len(x.shape) - 1],
+                                 starts=[off], ends=[off + size])
+            return x
+        if self.kind == 'table':
+            vocab = getattr(x, '_v1_size')
+            return _fl.embedding(input=x, size=[vocab, size],
+                                 param_attr=_pa(self.param_attr))
+        if self.kind == 'dotmul':
+            w = _fl.create_parameter(shape=[int(x.shape[-1])],
+                                     dtype='float32',
+                                     attr=_pa(self.param_attr))
+            return _fl.elementwise_mul(x, w)
+        if self.kind == 'scaling':
+            w = _fl.create_parameter(shape=[1], dtype='float32',
+                                     attr=_pa(self.param_attr))
+            return _fl.elementwise_mul(x, w)
+        if self.kind == 'context':
+            return _context_concat(x, self.kw['context_start'],
+                                   self.kw['context_len'])
+        raise NotImplementedError(self.kind)
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    return _Projection('full', input, size, param_attr)
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    return _Projection('trans_full', input, size, param_attr)
+
+
+def identity_projection(input, offset=None, size=None):
+    return _Projection('identity', input, size or 0, offset=offset)
+
+
+def table_projection(input, size=0, param_attr=None):
+    return _Projection('table', input, size, param_attr)
+
+
+def dotmul_projection(input, param_attr=None):
+    return _Projection('dotmul', input, 0, param_attr)
+
+
+def scaling_projection(input, param_attr=None):
+    return _Projection('scaling', input, 0, param_attr)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False):
+    start = context_start if context_start is not None \
+        else -(context_len // 2)
+    return _Projection('context', input, 0, None,
+                       context_start=start, context_len=context_len)
+
+
+def dotmul_operator(a, b, scale=1.0):
+    """Binary operator form: scale * a .* b (no parameter)."""
+    out = _fl.elementwise_mul(a, b)
+    if scale != 1.0:
+        out = _fl.scale(out, scale=scale)
+    return out
+
+
+def _context_concat(x, start, length):
+    """[B, T, D] -> [B, T, D*length]: concat of time-shifted copies,
+    zero-padded at the borders (gserver ContextProjection semantics).
+    T is dynamic at build time, so the shifts use end-relative slices."""
+    outs = []
+    for i in range(length):
+        off = start + i
+        if off > 0:   # y[t] = x[t+off]: drop the head, zero-pad the tail
+            shifted = _fl.pad(x, [0, 0, 0, off, 0, 0])
+            shifted = _fl.slice(shifted, axes=[1], starts=[off],
+                                ends=[2 ** 31 - 1])
+        elif off < 0:  # zero-pad the head, drop the tail
+            shifted = _fl.pad(x, [0, 0, -off, 0, 0, 0])
+            shifted = _fl.slice(shifted, axes=[1], starts=[0], ends=[off])
+        else:
+            shifted = x
+        outs.append(shifted)
+    return _fl.concat(outs, axis=-1)
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=None,
+                layer_attr=None):
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    terms = []
+    src_seq = None
+    for p in projs:
+        if isinstance(p, _Projection):
+            terms.append(p.build(size))
+            if _is_seq(p.input):
+                src_seq = p.input
+        else:  # a raw var or operator result acts as identity
+            terms.append(p)
+            if _is_seq(p):
+                src_seq = p
+    out = terms[0]
+    for t in terms[1:]:
+        out = _fl.elementwise_add(out, t)
+    if bias_attr is not None and bias_attr is not False:
+        bias = _fl.create_parameter(
+            shape=[int(out.shape[-1])], dtype='float32',
+            attr=_pa(bias_attr) if not isinstance(bias_attr, bool) else None,
+            is_bias=True)
+        out = _fl.elementwise_add(out, bias)
+    out = _apply_act(out, act)
+    if src_seq is not None:
+        out = _propagate_len(src_seq, out)
+    return apply_extra_attr(out, layer_attr)
+
+
+# ------------------------------------------------------------- sequence
+
+def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
+                  agg_level=None, layer_attr=None):
+    ptype = getattr(pooling_type, 'name', pooling_type) or 'max'
+    from ..layers import sequence
+    return sequence.sequence_pool(input=input, pool_type=ptype,
+                                  length=_len_of(input))
+
+
+def last_seq(input, agg_level=None, name=None, layer_attr=None):
+    return _fl.sequence_last_step(input, length=_len_of(input))
+
+
+def first_seq(input, agg_level=None, name=None, layer_attr=None):
+    return _fl.sequence_first_step(input, length=_len_of(input))
+
+
+def expand_layer(input, expand_as, name=None, bias_attr=False,
+                 expand_level=None, layer_attr=None):
+    out = _fl.sequence_expand(input, expand_as)
+    return _propagate_len(expand_as, out)
+
+
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, layer_attr=None):
+    """[a b c] -> [a b c a b c] (row-vector mode) or
+    [a a b b c c] (column-vector mode), per the reference docstring."""
+    d = int(input.shape[-1])
+    if as_row_vector:
+        out = _fl.concat([input] * num_repeats, axis=-1)
+    else:
+        out = _fl.reshape(
+            _fl.expand(_fl.unsqueeze(input, axes=[2]),
+                       [1] * len(input.shape) + [num_repeats]),
+            list(input.shape[:-1]) + [d * num_repeats])
+    return _apply_act(out, act)
+
+
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      layer_attr=None, bias_attr=None):
+    return _apply_act(_fl.sequence_reshape(input, reshape_size), act)
+
+
+def seq_concat_layer(a, b, act=None, name=None, layer_attr=None,
+                     bias_attr=None):
+    out = _fl.sequence_concat([a, b])
+    return _apply_act(out, act)
+
+
+def lstmemory(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, state_act=None, param_attr=None,
+              bias_attr=None, layer_attr=None):
+    """v1 lstmemory consumes a 4*size pre-projection (reference
+    layers.py lstmemory doc: 'input of this layer should be the fc
+    projected sum'); identical contract to fluid dynamic_lstm."""
+    in_dim = int(input.shape[-1])
+    hidden, _ = _fl.dynamic_lstm(
+        input=input, size=in_dim, is_reverse=reverse,
+        gate_activation=_act_name(gate_act) or 'sigmoid',
+        cell_activation=_act_name(state_act) or 'tanh',
+        candidate_activation=_act_name(act) or 'tanh',
+        param_attr=_pa(param_attr), bias_attr=_pa(bias_attr),
+        length=_len_of(input))
+    return _propagate_len(input, hidden)
+
+
+def grumemory(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, param_attr=None, bias_attr=None,
+              layer_attr=None):
+    """Consumes a 3*size pre-projection, like fluid dynamic_gru."""
+    in_dim = int(input.shape[-1])
+    out = _fl.dynamic_gru(
+        input=input, size=in_dim // 3, is_reverse=reverse,
+        gate_activation=_act_name(gate_act) or 'sigmoid',
+        candidate_activation=_act_name(act) or 'tanh',
+        param_attr=_pa(param_attr), bias_attr=_pa(bias_attr),
+        length=_len_of(input))
+    return _propagate_len(input, out)
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, layer_attr=None):
+    """Plain elman recurrence h_t = act(x_t + W h_{t-1}) over the padded
+    time axis (reference recurrent_layer; fluid has no direct analog so
+    it is built from the rnn scan op)."""
+    from ..layers.rnn import simple_rnn
+    out = simple_rnn(input, act=_act_name(act) or 'tanh',
+                     is_reverse=reverse, param_attr=_pa(param_attr),
+                     bias_attr=_pa(bias_attr) if bias_attr is not None
+                     else None, length=_len_of(input))
+    return _propagate_len(input, out)
+
+
+# ---------------------------------------------------------------- image
+
+def _maybe_image(input, num_channels):
+    """v1 conv/pool accept the flat data_layer slot; reshape to NCHW
+    using the declared size (square images, like the reference's
+    inferred height/width)."""
+    if input.shape is not None and len(input.shape) == 2 and num_channels:
+        hw = int(input.shape[-1]) // num_channels
+        side = int(math.isqrt(hw))
+        return _fl.reshape(input, [-1, num_channels, side, side])
+    return input
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, dilation=1, groups=1, act=None,
+                   name=None, bias_attr=None, param_attr=None,
+                   shared_biases=True, layer_attr=None, trans=False):
+    x = _maybe_image(input, num_channels)
+    fn = _fl.conv2d_transpose if trans else _fl.conv2d
+    out = fn(input=x, num_filters=num_filters, filter_size=filter_size,
+             stride=stride, padding=padding, groups=groups,
+             act=_act_name(act), param_attr=_pa(param_attr),
+             bias_attr=_pa(bias_attr) if bias_attr is not None else None)
+    return apply_extra_attr(out, layer_attr)
+
+
+def img_pool_layer(input, pool_size, num_channels=None, pool_type=None,
+                   stride=1, padding=0, name=None, ceil_mode=True,
+                   pool_size_y=None, stride_y=None, padding_y=None,
+                   exclude_mode=None, layer_attr=None):
+    x = _maybe_image(input, num_channels)
+    ptype = getattr(pool_type, 'name', pool_type) or 'max'
+    if ptype in ('average', 'sum', 'sqrt'):
+        ptype = 'avg'
+    return _fl.pool2d(input=x, pool_size=pool_size, pool_stride=stride,
+                      pool_padding=padding, pool_type=ptype,
+                      ceil_mode=ceil_mode)
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     bias_attr=None, param_attr=None, layer_attr=None,
+                     batch_norm_type=None, moving_average_fraction=0.9,
+                     use_global_stats=None, mean_var_names=None):
+    x = _maybe_image(input, num_channels)
+    return _fl.batch_norm(input=x, act=_act_name(act),
+                          momentum=moving_average_fraction,
+                          is_test=bool(use_global_stats))
+
+
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
+                      num_channels=None, layer_attr=None):
+    """Local response normalization across channels (reference
+    img_cmrnorm_layer -> gserver CMRProjectionNormLayer; fluid lrn)."""
+    x = _maybe_image(input, num_channels)
+    return _fl.lrn(x, n=size, alpha=scale, beta=power)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None,
+                 layer_attr=None):
+    return _fl.maxout(_maybe_image(input, num_channels), groups=groups)
+
+
+def spp_layer(input, name=None, num_channels=None, pool_type=None,
+              pyramid_height=None, layer_attr=None):
+    ptype = getattr(pool_type, 'name', pool_type) or 'max'
+    return _fl.spp(_maybe_image(input, num_channels),
+                   pyramid_height=pyramid_height or 2, pool_type=ptype)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+              layer_attr=None):
+    pads = []
+    for p in [(0, 0), tuple(pad_c or (0, 0)), tuple(pad_h or (0, 0)),
+              tuple(pad_w or (0, 0))]:
+        pads.extend(p)
+    return _fl.pad(input, pads)
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale, num_channels=None, name=None):
+    return _fl.roi_pool(input=_maybe_image(input, num_channels), rois=rois,
+                        pooled_height=pooled_height,
+                        pooled_width=pooled_width,
+                        spatial_scale=spatial_scale)
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          name=None, layer_attr=None):
+    return _fl.resize_bilinear(input, out_shape=[out_size_y, out_size_x])
+
+
+# ----------------------------------------------------------- arithmetic
+
+def addto_layer(input, act=None, name=None, bias_attr=None,
+                layer_attr=None):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = _fl.elementwise_add(out, t)
+    return _propagate_len(inputs[0], _apply_act(out, act))
+
+
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
+    out = _fl.concat(list(input), axis=-1)
+    return _propagate_len(input[0], _apply_act(out, act))
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
+    return _fl.scale(_fl.cos_sim(a, b), scale=float(scale))
+
+
+def l2_distance_layer(x, y, name=None, layer_attr=None):
+    return _fl.sqrt(_fl.reduce_sum(_fl.square(
+        _fl.elementwise_sub(x, y)), dim=-1, keep_dim=True))
+
+
+def trans_layer(input, name=None, layer_attr=None):
+    return _fl.transpose(input, [0, 2, 1] if len(input.shape) == 3
+                         else [1, 0])
+
+
+def rotate_layer(input, height, width, name=None, layer_attr=None):
+    """90° CCW rotation of the [h, w] plane (gserver RotateLayer)."""
+    c = int(input.shape[-1]) // (height * width)
+    x = _fl.reshape(input, [-1, c, height, width])
+    x = _fl.transpose(_fl.reverse(x, axis=[3]), [0, 1, 3, 2])
+    return _fl.reshape(x, [-1, c * height * width])
+
+
+def scaling_layer(input, weight, name=None, layer_attr=None):
+    """Row-wise scale: weight [B, 1] * input [B, D]."""
+    return _fl.elementwise_mul(input, weight)
+
+
+def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
+                          layer_attr=None):
+    return _fl.scale(input, scale=slope, bias=intercept)
+
+
+def interpolation_layer(input, weight, name=None, layer_attr=None):
+    """w * a + (1 - w) * b, weight [B, 1] (gserver InterpolationLayer)."""
+    a, b = input
+    return _fl.elementwise_add(
+        _fl.elementwise_mul(a, weight),
+        _fl.elementwise_mul(b, _fl.scale(weight, scale=-1.0, bias=1.0)))
+
+
+def power_layer(input, weight, name=None, layer_attr=None):
+    return _fl.elementwise_pow(input, weight)
+
+
+def sum_to_one_norm_layer(input, name=None, layer_attr=None):
+    s = _fl.reduce_sum(input, dim=-1, keep_dim=True)
+    return _fl.elementwise_div(input, s)
+
+
+def row_l2_norm_layer(input, name=None, layer_attr=None):
+    return _fl.l2_normalize(input, axis=-1)
+
+
+def clip_layer(input, min, max, name=None):
+    return _fl.clip(input, min=float(min), max=float(max))
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    return _propagate_len(input, _fl.dropout(input,
+                                             dropout_prob=dropout_rate))
+
+
+def prelu_layer(input, name=None, partial_sum=1, param_attr=None,
+                layer_attr=None):
+    mode = 'all' if partial_sum == 1 else 'channel'
+    return _fl.prelu(input, mode=mode, param_attr=_pa(param_attr))
+
+
+def maxid_layer(input, name=None, layer_attr=None):
+    return _fl.argmax(input, axis=-1)
+
+
+def sampling_id_layer(input, name=None, layer_attr=None):
+    """Sample an id from a probability row (gserver SamplingIdLayer):
+    inverse-CDF on a uniform draw, vectorized."""
+    u = _fl.uniform_random_batch_size_like(input, shape=[-1, 1], min=0.,
+                                           max=1.)
+    cdf = _fl.cumsum(input, axis=-1)
+    return _fl.reduce_sum(_fl.cast(_fl.less_than(cdf, u), 'int64'), dim=-1)
+
+
+def multiplex_layer(input, name=None, layer_attr=None):
+    index, rest = input[0], input[1:]
+    return _fl.multiplex(inputs=list(rest), index=index)
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, layer_attr=None):
+    return _fl.bilinear_tensor_product(a, b, size, act=_act_name(act),
+                                       param_attr=_pa(param_attr),
+                                       bias_attr=_pa(bias_attr))
+
+
+def dot_prod_layer(input1, input2, name=None, layer_attr=None):
+    return _fl.reduce_sum(_fl.elementwise_mul(input1, input2), dim=-1,
+                          keep_dim=True)
+
+
+def out_prod_layer(input1, input2, name=None, layer_attr=None):
+    return _fl.matmul(_fl.unsqueeze(input1, axes=[2]),
+                      _fl.unsqueeze(input2, axes=[1]))
+
+
+def row_conv_layer(input, context_len, act=None, name=None,
+                   param_attr=None, layer_attr=None):
+    return _fl.row_conv(input, context_len, param_attr=_pa(param_attr),
+                        act=_act_name(act))
+
+
+def crop_layer(input, offset, axis=2, shape=None, name=None,
+               layer_attr=None):
+    x, ref = input if isinstance(input, (list, tuple)) else (input, None)
+    if shape is None and ref is not None:
+        shape = list(ref.shape)
+    return _fl.crop(x, shape=shape, offsets=offset)
+
+
+def conv_shift_layer(a, b, name=None, layer_attr=None):
+    return _fl.conv_shift(a, b)
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=None,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=None, layer_attr=None):
+    proj = _fl.fc(input=input, size=size, act=_act_name(act),
+                  param_attr=_pa(inproj_param_attr))
+    gate = _fl.fc(input=input, size=size, act='sigmoid',
+                  param_attr=_pa(gate_param_attr))
+    return _fl.elementwise_mul(proj, gate)
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None,
+                      layer_attr=None):
+    """weights [B, M], vectors [B, M*size] -> [B, size]: per-row linear
+    combination of M sub-vectors (gserver LinearCombLayer)."""
+    m = int(weights.shape[-1])
+    size = size or int(vectors.shape[-1]) // m
+    v = _fl.reshape(vectors, [-1, m, size])
+    return _fl.squeeze(_fl.matmul(_fl.unsqueeze(weights, axes=[1]), v),
+                       axes=[1])
+
+
+convex_comb_layer = linear_comb_layer
+
+
+# ---------------------------------------------------------------- costs
+
+def square_error_cost(input, label, name=None, weight=None,
+                      coeff=1.0, layer_attr=None):
+    cost = _fl.mean(_fl.square_error_cost(input=input, label=label))
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+regression_cost = square_error_cost
+
+
+def classification_cost(input, label, name=None, weight=None,
+                        evaluator=None, coeff=1.0, layer_attr=None):
+    """input = class probabilities (fc + SoftmaxActivation), per the
+    reference contract."""
+    cost = _fl.cross_entropy(input=input, label=label)
+    if weight is not None:
+        cost = _fl.elementwise_mul(cost, weight)
+    cost = _fl.mean(cost)
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
+                  layer_attr=None):
+    return classification_cost(input, label, weight=weight, coeff=coeff)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0,
+                                     layer_attr=None):
+    """input = sigmoid probabilities; label = multi-hot."""
+    eps = 1e-8
+    cost = _fl.reduce_sum(
+        _fl.scale(_fl.elementwise_add(
+            _fl.elementwise_mul(label, _fl.log(
+                _fl.scale(input, bias=eps))),
+            _fl.elementwise_mul(
+                _fl.scale(label, scale=-1.0, bias=1.0),
+                _fl.log(_fl.scale(_fl.scale(input, scale=-1.0, bias=1.0),
+                                  bias=eps)))), scale=-1.0),
+        dim=-1)
+    cost = _fl.mean(cost)
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    return _fl.reduce_sum(input)
+
+
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
+    cost = _fl.mean(_fl.rank_loss(label=label, left=left, right=right))
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0, coeff=1.0,
+                          layer_attr=None):
+    cost = _fl.mean(_fl.huber_loss(input=input, label=label, delta=delta))
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def huber_classification_cost(input, label, name=None, coeff=1.0,
+                              layer_attr=None):
+    """Modified huber on {0,1} labels mapped to {-1,+1}."""
+    y = _fl.scale(_fl.cast(label, 'float32'), scale=2.0, bias=-1.0)
+    cost = _fl.mean(_fl.modified_huber_loss(x=input, y=y))
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    cost = _fl.mean(_fl.smooth_l1(x=input, y=label))
+    return _fl.scale(cost, scale=coeff) if coeff != 1.0 else cost
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    raise NotImplementedError(
+        'lambda_cost (LambdaRank) has no fluid lowering; rank_cost and '
+        'margin_rank_loss cover pairwise ranking')
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1,
+                                layer_attr=None):
+    raise NotImplementedError(
+        'cross_entropy_with_selfnorm is NCE-era; use nce_layer or '
+        'softmax_with_cross_entropy')
+
+
+# ------------------------------------------------------------- seq tags
+
+def crf_layer(input, label, size=None, weight=None, param_attr=None,
+              name=None, coeff=1.0, layer_attr=None):
+    ll = _fl.linear_chain_crf(input=input, label=label,
+                              param_attr=_pa(param_attr),
+                              length=_len_of(input))
+    return _fl.mean(ll)
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None):
+    return _fl.crf_decoding(input=input, param_attr=_pa(param_attr),
+                            length=_len_of(input))
+
+
+def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
+              layer_attr=None):
+    return _fl.warpctc(input=input, label=label,
+                       norm_by_times=norm_by_times,
+                       input_length=_len_of(input),
+                       label_length=_len_of(label))
+
+
+warp_ctc_layer = ctc_layer
+
+
+def nce_layer(input, label, num_classes=None, act=None, param_attr=None,
+              weight=None, num_neg_samples=10, neg_distribution=None,
+              name=None, bias_attr=None, layer_attr=None):
+    if isinstance(input, (list, tuple)):
+        input = _fl.concat(list(input), axis=-1)
+    return _fl.mean(_fl.nce(input=input, label=label,
+                            num_total_classes=num_classes,
+                            param_attr=_pa(param_attr),
+                            bias_attr=_pa(bias_attr),
+                            num_neg_samples=num_neg_samples))
+
+
+def hsigmoid(input, label, num_classes=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    raise NotImplementedError(
+        'hierarchical sigmoid is served by nce_layer here (same '
+        'large-softmax-approximation role, better MXU shape)')
+
+
+# ----------------------------------------------------------------- misc
+
+def print_layer(input, format=None, name=None):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    for v in inputs:
+        _fl.Print(v, message=format or '')
+    return inputs[0]
+
+
+printer_layer = print_layer
+
+
+def eos_layer(input, eos_id, name=None, layer_attr=None):
+    return _fl.cast(_fl.equal(input, _fl.fill_constant(
+        shape=[1], dtype=input.dtype, value=eos_id)), 'float32')
+
+
+_FLUID_EQUIV = {
+    'recurrent_group': 'fluid DynamicRNN / layers.rnn',
+    'memory': 'DynamicRNN.memory',
+    'beam_search': 'layers.beam_search (decode ops)',
+    'get_output_layer': 'the tuple returns of fluid layers',
+    'selective_fc_layer': 'layers.fc + masking',
+    'block_expand_layer': 'layers.im2sequence',
+    'kmax_seq_score_layer': 'layers.topk',
+    'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
+    'sub_seq_layer': 'layers.sequence_slice',
+    'seq_slice_layer': 'layers.sequence_slice',
+    'factorization_machine': 'wide_deep model (models/wide_deep.py)',
+    'priorbox_layer': 'layers.prior_box',
+    'multibox_loss_layer': 'layers.ssd_loss',
+    'detection_output_layer': 'layers.detection_output',
+    'cross_channel_norm_layer': 'layers.l2_normalize(axis=1)',
+    'img_conv3d_layer': 'layers.conv3d lowering (ops/conv_ops.py)',
+    'img_pool3d_layer': 'layers.pool2d pattern over 3d',
+    'scale_shift_layer': 'layers.scale',
+    'scale_sub_region_layer': 'layers.crop + scale + paste',
+    'resize_layer': 'layers.reshape',
+    'switch_order_layer': 'layers.transpose',
+    'gru_step_layer': 'layers.gru_unit',
+    'gru_step_naive_layer': 'layers.gru_unit',
+    'lstm_step_layer': 'layers.lstm_unit',
+    'slice_projection': 'identity_projection(offset=..., size=...)',
+    'conv_projection': 'img_conv_layer',
+    'conv_operator': 'img_conv_layer',
+    'StaticInput': 'DynamicRNN.static_input',
+    'GeneratedInput': 'transformer_greedy_decode / beam decode ops',
+    'SubsequenceInput': 'SURVEY §6 LoD stance: depth>1 descoped',
+    'BeamInput': 'layers.beam_search',
+    'cross_entropy_over_beam': 'layers.beam_search + softmax_with_cross_entropy',
+}
+
+
+def __getattr__(name):
+    if name in _FLUID_EQUIV:
+        raise NotImplementedError(
+            'v1 %s is not shimmed; use %s' % (name, _FLUID_EQUIV[name]))
+    raise AttributeError(name)

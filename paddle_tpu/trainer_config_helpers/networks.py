@@ -1,0 +1,254 @@
+"""v1 composite networks (reference:
+python/paddle/trainer_config_helpers/networks.py — pre-assembled
+combinations of v1 layers). Built on the layer shim + `paddle_tpu.nets`;
+same eager-IR semantics as layers.py.
+"""
+
+from .. import layers as _fl
+from .. import nets as _nets
+from .activations import ReluActivation, SigmoidActivation, TanhActivation
+from .attrs import to_fluid_param_attr as _pa
+from .layers import (_act_name, _apply_act, _len_of, _propagate_len,
+                     concat_layer, fc_layer, grumemory, img_conv_layer,
+                     img_pool_layer, lstmemory, pooling_layer)
+from .poolings import MaxPooling
+
+__all__ = ['sequence_conv_pool', 'simple_lstm', 'simple_img_conv_pool',
+           'img_conv_bn_pool', 'img_conv_group', 'small_vgg',
+           'vgg_16_network', 'gru_unit', 'gru_group', 'simple_gru',
+           'simple_gru2', 'bidirectional_gru', 'text_conv_pool',
+           'bidirectional_lstm', 'lstmemory_group', 'lstmemory_unit',
+           'simple_attention', 'dot_product_attention',
+           'img_separable_conv', 'multi_head_attention',
+           'inputs', 'outputs']
+
+
+def sequence_conv_pool(input, context_len, hidden_size,
+                       context_start=None, pool_type=None,
+                       context_proj_param_attr=None, fc_param_attr=None,
+                       fc_bias_attr=None, fc_act=None, pool_bias_attr=None,
+                       fc_attr=None, context_attr=None, name=None):
+    ptype = getattr(pool_type, 'name', pool_type) or 'max'
+    return _nets.sequence_conv_pool(
+        input=input, num_filters=hidden_size, filter_size=context_len,
+        act=_act_name(fc_act) or 'tanh', pool_type=ptype,
+        length=_len_of(input))
+
+
+text_conv_pool = sequence_conv_pool
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None, mixed_layer_attr=None,
+                lstm_cell_attr=None):
+    """fc(4*size) + lstmemory, the reference composition."""
+    proj = fc_layer(input, size * 4, act=None,
+                    param_attr=mat_param_attr, bias_attr=False)
+    return lstmemory(proj, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, state_act=state_act,
+                     param_attr=inner_param_attr,
+                     bias_attr=bias_param_attr)
+
+
+def lstmemory_unit(input, size, **kwargs):
+    """Single-step form; over padded batches the scan form is the
+    natural unit — delegate to simple_lstm."""
+    return simple_lstm(input, size, **{k: v for k, v in kwargs.items()
+                                       if k in ('act', 'gate_act',
+                                                'state_act', 'name')})
+
+
+def lstmemory_group(input, size, **kwargs):
+    return simple_lstm(input, size, **{k: v for k, v in kwargs.items()
+                                       if k in ('act', 'gate_act',
+                                                'state_act', 'reverse',
+                                                'name')})
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               mixed_bias_param_attr=None, gru_param_attr=None,
+               gru_bias_attr=None, act=None, gate_act=None,
+               mixed_layer_attr=None, gru_layer_attr=None):
+    proj = fc_layer(input, size * 3, act=None, param_attr=mixed_param_attr,
+                    bias_attr=False)
+    return grumemory(proj, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr,
+                     bias_attr=gru_bias_attr)
+
+
+simple_gru2 = simple_gru
+gru_unit = simple_gru
+gru_group = simple_gru
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False, **kwargs):
+    fwd = simple_lstm(input, size, reverse=False)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    # full-sequence summaries: LAST step of the forward scan, FIRST of
+    # the backward (bwd[:, 0] is the state after consuming the whole
+    # reversed sequence), as in reference networks.py bidirectional_lstm
+    return concat_layer([
+        _fl.sequence_last_step(fwd, length=_len_of(input)),
+        _fl.sequence_first_step(bwd, length=_len_of(input))])
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False, **kwargs):
+    fwd = simple_gru(input, size, reverse=False)
+    bwd = simple_gru(input, size, reverse=True)
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    return concat_layer([
+        _fl.sequence_last_step(fwd, length=_len_of(input)),
+        _fl.sequence_first_step(bwd, length=_len_of(input))])
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         name=None, pool_type=None, act=None,
+                         groups=1, conv_stride=1, conv_padding=0,
+                         bias_attr=None, num_channel=None, num_channels=None,
+                         param_attr=None, shared_bias=True,
+                         conv_layer_attr=None, pool_stride=1,
+                         pool_padding=0, pool_layer_attr=None):
+    from .layers import _maybe_image
+    x = _maybe_image(input, num_channels or num_channel)
+    ptype = getattr(pool_type, 'name', pool_type) or 'max'
+    if ptype in ('average', 'sum', 'sqrt'):
+        ptype = 'avg'
+    conv = _fl.conv2d(input=x, num_filters=num_filters,
+                      filter_size=filter_size, stride=conv_stride,
+                      padding=conv_padding, groups=groups,
+                      act=_act_name(act) or 'relu',
+                      param_attr=_pa(param_attr),
+                      bias_attr=_pa(bias_attr)
+                      if bias_attr is not None else None)
+    return _fl.pool2d(input=conv, pool_size=pool_size,
+                      pool_stride=pool_stride or pool_size,
+                      pool_padding=pool_padding, pool_type=ptype)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
+                     num_channels=None, conv_padding=0, conv_stride=1,
+                     act=None, pool_stride=1, pool_type=None, **kwargs):
+    conv = img_conv_layer(input, filter_size, num_filters,
+                          num_channels=num_channels, stride=conv_stride,
+                          padding=conv_padding, act=None)
+    bn = _fl.batch_norm(input=conv, act=_act_name(act) or 'relu')
+    return img_pool_layer(bn, pool_size, stride=pool_stride,
+                          pool_type=pool_type)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=1, pool_type=None, param_attr=None):
+    from .layers import _maybe_image
+    x = _maybe_image(input, num_channels)
+    n = len(conv_num_filter)
+
+    def rep(v):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    return _nets.img_conv_group(
+        input=x, conv_num_filter=list(conv_num_filter),
+        pool_size=pool_size, conv_padding=rep(conv_padding),
+        conv_filter_size=rep(conv_filter_size),
+        conv_act=_act_name(conv_act) or 'relu',
+        conv_with_batchnorm=rep(conv_with_batchnorm),
+        conv_batchnorm_drop_rate=rep(conv_batchnorm_drop_rate),
+        pool_stride=pool_stride,
+        pool_type=getattr(pool_type, 'name', pool_type) or 'max')
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """The cifar-scale VGG of reference networks.py small_vgg."""
+    from ..models.vgg import vgg_bn_drop
+    from .layers import _maybe_image
+    x = _maybe_image(input_image, num_channels)
+    return _fl.fc(input=vgg_bn_drop(x), size=num_classes, act='softmax')
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    x = input_image
+    for filters, reps in [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]:
+        x = img_conv_group(x, [filters] * reps, pool_size=2,
+                           num_channels=num_channels if filters == 64
+                           else None, pool_stride=2,
+                           conv_act=ReluActivation())
+    x = _fl.fc(input=x, size=4096, act='relu')
+    x = _fl.dropout(x, dropout_prob=0.5)
+    x = _fl.fc(input=x, size=4096, act='relu')
+    x = _fl.dropout(x, dropout_prob=0.5)
+    return _fl.fc(input=x, size=num_classes, act='softmax')
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, shared_bias=True,
+                       layer_attr=None, name=None):
+    """Depthwise (groups=C) + pointwise 1x1, the mobilenet block."""
+    from .layers import _maybe_image
+    x = _maybe_image(input, num_channels)
+    ch = num_channels or int(x.shape[1])
+    depth = _fl.conv2d(input=x, num_filters=ch * depth_multiplier,
+                       filter_size=filter_size, stride=stride,
+                       padding=padding, groups=ch, act=None,
+                       bias_attr=False)
+    return _fl.conv2d(input=depth, num_filters=num_out_channels,
+                      filter_size=1, act=_act_name(act),
+                      bias_attr=_pa(bias_attr)
+                      if bias_attr is not None else None)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None):
+    """Bahdanau-style additive attention over a padded sequence
+    (reference networks.py simple_attention)."""
+    d = int(encoded_proj.shape[-1])
+    dec = _fl.fc(input=decoder_state, size=d, bias_attr=False,
+                 param_attr=_pa(transform_param_attr))
+    combined = _fl.tanh(_fl.elementwise_add(
+        encoded_proj, _fl.unsqueeze(dec, axes=[1])))
+    scores = _fl.fc(input=combined, size=1, num_flatten_dims=2,
+                    bias_attr=False, param_attr=_pa(softmax_param_attr))
+    weights = _fl.sequence_softmax(_fl.squeeze(scores, axes=[2]),
+                                   length=_len_of(encoded_sequence))
+    ctx = _fl.matmul(_fl.unsqueeze(weights, axes=[1]), encoded_sequence)
+    return _fl.squeeze(ctx, axes=[1])
+
+
+def dot_product_attention(attended_sequence, attending_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None):
+    scores = _fl.matmul(attended_sequence,
+                        _fl.unsqueeze(transformed_state, axes=[2]))
+    weights = _fl.sequence_softmax(_fl.squeeze(scores, axes=[2]),
+                                   length=_len_of(attended_sequence))
+    ctx = _fl.matmul(_fl.unsqueeze(weights, axes=[1]), attending_sequence)
+    return _fl.squeeze(ctx, axes=[1])
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type='dot-product attention',
+                         softmax_param_attr=None, name=None):
+    return _nets.scaled_dot_product_attention(
+        queries=query, keys=key, values=value, num_heads=head_num)
+
+
+def inputs(*args):
+    """Declares the feed order (reference networks.py inputs); the
+    Program already records data vars in creation order, so this is a
+    no-op kept for config compatibility."""
+    return list(args)
+
+
+def outputs(*args):
+    """Marks model outputs. Returns the vars; fetch_list plays the
+    protobuf output-layer role."""
+    outs = []
+    for a in args:
+        outs.extend(a if isinstance(a, (list, tuple)) else [a])
+    return outs if len(outs) > 1 else outs[0]

@@ -1,0 +1,142 @@
+"""v1 optimizer config objects (reference:
+python/paddle/trainer_config_helpers/optimizers.py — `settings()` wrote
+the optimization section of the trainer config protobuf). Here each
+class adapts to a fluid optimizer via `.to_fluid(learning_rate)`, and
+`settings()` returns a Settings whose `.minimize(loss)` applies the
+configured optimizer + regularization to the default program — the one
+piece of trainer-config behavior that still means something when the
+Program is the config.
+"""
+
+from .. import optimizer as _opt
+from .. import regularizer as _reg
+
+__all__ = ['Optimizer', 'BaseSGDOptimizer', 'MomentumOptimizer',
+           'AdamaxOptimizer', 'AdamOptimizer', 'AdaGradOptimizer',
+           'RMSPropOptimizer', 'DecayedAdaGradOptimizer',
+           'AdaDeltaOptimizer', 'BaseRegularization', 'L2Regularization',
+           'settings', 'ModelAverage']
+
+
+class Optimizer(object):
+    def to_fluid(self, learning_rate, regularization=None):
+        raise NotImplementedError
+
+
+class BaseSGDOptimizer(Optimizer):
+    pass
+
+
+def _regularizer(regularization):
+    if isinstance(regularization, L2Regularization):
+        return _reg.L2Decay(regularization.rate)
+    return None
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    def __init__(self, momentum=0.9, sparse=False):
+        self.momentum = momentum
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Momentum(learning_rate=learning_rate,
+                             momentum=self.momentum,
+                             regularization=_regularizer(regularization))
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adam(learning_rate=learning_rate, beta1=self.beta1,
+                         beta2=self.beta2, epsilon=self.epsilon,
+                         regularization=_regularizer(regularization))
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adamax(learning_rate=learning_rate, beta1=self.beta1,
+                           beta2=self.beta2,
+                           regularization=_regularizer(regularization))
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adagrad(learning_rate=learning_rate,
+                            regularization=_regularizer(regularization))
+
+
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.DecayedAdagrad(learning_rate=learning_rate,
+                                   decay=self.rho, epsilon=self.epsilon,
+                                   regularization=_regularizer(
+                                       regularization))
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adadelta(learning_rate=learning_rate, rho=self.rho,
+                             epsilon=self.epsilon,
+                             regularization=_regularizer(regularization))
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.RMSProp(learning_rate=learning_rate, rho=self.rho,
+                            epsilon=self.epsilon,
+                            regularization=_regularizer(regularization))
+
+
+class BaseRegularization(object):
+    pass
+
+
+class L2Regularization(BaseRegularization):
+    def __init__(self, rate):
+        self.rate = rate
+
+
+class ModelAverage(object):
+    """Recorded for config parity; the fluid-level ModelAverage hook is
+    not implemented (SURVEY §6.1 absence list)."""
+
+    def __init__(self, average_window, max_average_window=None):
+        self.average_window = average_window
+
+
+class Settings(object):
+    def __init__(self, batch_size, learning_rate, learning_method,
+                 regularization):
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.learning_method = learning_method or MomentumOptimizer(0.0)
+        self.regularization = regularization
+
+    def optimizer(self):
+        return self.learning_method.to_fluid(self.learning_rate,
+                                             self.regularization)
+
+    def minimize(self, loss):
+        return self.optimizer().minimize(loss)
+
+
+def settings(batch_size=256, learning_rate=1e-3, learning_method=None,
+             regularization=None, **kwargs):
+    """v1 `settings(...)` configured the global trainer; here it returns
+    a Settings handle — call `.minimize(loss)` where a v1 config would
+    have relied on the trainer reading the global section."""
+    return Settings(batch_size, learning_rate, learning_method,
+                    regularization)

@@ -1,0 +1,51 @@
+"""v1 pooling-type objects (reference:
+python/paddle/trainer_config_helpers/poolings.py). The `name` is the
+fluid pool_type string; Cudnn* variants are spatial-pool aliases kept
+for config compatibility (the XLA reduce_window lowering serves both).
+"""
+
+__all__ = ['BasePoolingType', 'MaxPooling', 'AvgPooling',
+           'MaxWithMaskPooling', 'CudnnMaxPooling', 'CudnnAvgPooling',
+           'CudnnAvgInclPadPooling', 'SumPooling', 'SquareRootNPooling']
+
+
+class BasePoolingType(object):
+    name = None
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class MaxPooling(BasePoolingType):
+    name = 'max'
+
+    def __init__(self, output_max_index=False):
+        self.output_max_index = output_max_index
+
+
+class MaxWithMaskPooling(BasePoolingType):
+    name = 'max'
+
+
+class CudnnMaxPooling(BasePoolingType):
+    name = 'max'
+
+
+class AvgPooling(BasePoolingType):
+    name = 'average'
+
+
+class CudnnAvgPooling(BasePoolingType):
+    name = 'average'
+
+
+class CudnnAvgInclPadPooling(BasePoolingType):
+    name = 'average'
+
+
+class SumPooling(BasePoolingType):
+    name = 'sum'
+
+
+class SquareRootNPooling(BasePoolingType):
+    name = 'sqrt'

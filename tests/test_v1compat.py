@@ -1,0 +1,251 @@
+"""v1 trainer_config_helpers compat shim: legacy configs build and train
+over the fluid IR (reference: python/paddle/trainer_config_helpers/
+layers.py, networks.py — the quick_start / fit-a-line era API)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.trainer_config_helpers import (
+    AdamOptimizer, AvgPooling, L2Regularization, LinearActivation,
+    MaxPooling, MomentumOptimizer, ParameterAttribute, ReluActivation,
+    SoftmaxActivation, TanhActivation, addto_layer, bidirectional_lstm,
+    classification_cost, concat_layer, context_projection, cos_sim,
+    data_layer, dotmul_projection, embedding_layer, fc_layer,
+    first_seq, full_matrix_projection, grumemory, identity_projection,
+    img_conv_layer, img_pool_layer, interpolation_layer, last_seq,
+    lstmemory, maxid_layer, mixed_layer, pooling_layer, recurrent_layer,
+    regression_cost, repeat_layer, settings, simple_gru,
+    simple_img_conv_pool, simple_lstm, slope_intercept_layer,
+    trans_layer)
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_fit_a_line_v1_style():
+    x = data_layer(name='x', size=13)
+    y = data_layer(name='y', size=1)
+    pred = fc_layer(input=x, size=1, act=LinearActivation())
+    cost = regression_cost(input=pred, label=y)
+    settings(learning_rate=0.05,
+             learning_method=MomentumOptimizer(momentum=0.9)).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    w = rng.randn(13, 1).astype('float32')
+    losses = []
+    for _ in range(60):
+        xs = rng.randn(32, 13).astype('float32')
+        loss, = exe.run(feed={'x': xs, 'y': xs @ w + 0.5},
+                        fetch_list=[cost])
+        losses.append(float(np.asarray(loss).reshape(())))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_mixed_layer_full_projection_matches_matmul():
+    x = data_layer(name='x', size=4)
+    out = mixed_layer(
+        size=3,
+        input=[full_matrix_projection(
+            x, param_attr=ParameterAttribute(
+                initializer=fluid.initializer.Constant(0.5)))],
+        bias_attr=False)
+    xs = np.arange(8, dtype='float32').reshape(2, 4)
+    _, (o,) = _run([out], {'x': xs})
+    np.testing.assert_allclose(o, xs @ np.full((4, 3), 0.5, 'f'),
+                               rtol=1e-5)
+
+
+def test_mixed_layer_identity_plus_dotmul():
+    x = data_layer(name='x', size=4)
+    out = mixed_layer(size=4,
+                      input=[identity_projection(x),
+                             dotmul_projection(
+                                 x, param_attr=ParameterAttribute(
+                                     initializer=fluid.initializer
+                                     .Constant(2.0)))],
+                      bias_attr=False)
+    xs = np.arange(4, dtype='float32').reshape(1, 4)
+    _, (o,) = _run([out], {'x': xs})
+    np.testing.assert_allclose(o, xs + 2.0 * xs, rtol=1e-5)
+
+
+def test_sentiment_config_trains():
+    """quick_start-style: embedding -> seq max-pool -> softmax fc."""
+    words = data_layer(name='words', size=100, dtype='int64', seq_type=1)
+    lbl = data_layer(name='lbl', size=1, dtype='int64')
+    emb = embedding_layer(input=words, size=16)
+    pooled = pooling_layer(input=emb, pooling_type=MaxPooling())
+    prob = fc_layer(input=pooled, size=2, act=SoftmaxActivation())
+    cost = classification_cost(input=prob, label=lbl)
+    AdamOptimizer().to_fluid(0.01).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    ws = rng.randint(1, 100, (16, 12)).astype('int64')
+    ys = (ws[:, 0] % 2).astype('int64').reshape(-1, 1)
+    lens = np.full((16,), 12, 'int32')
+    losses = []
+    for _ in range(40):
+        loss, = exe.run(feed={'words': ws, 'words_len': lens, 'lbl': ys},
+                        fetch_list=[cost])
+        losses.append(float(np.asarray(loss).reshape(())))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_seq_pooling_masks_padding():
+    words = data_layer(name='w', size=50, dtype='int64', seq_type=1)
+    emb = embedding_layer(input=words, size=4)
+    mx = pooling_layer(input=emb, pooling_type=MaxPooling())
+    av = pooling_layer(input=emb, pooling_type=AvgPooling())
+    lst = last_seq(input=emb)
+    fst = first_seq(input=emb)
+    ws = np.array([[3, 4, 0, 0], [5, 6, 7, 8]], dtype='int64')
+    lens = np.array([2, 4], dtype='int32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    table = fluid.global_scope().numpy(
+        [p for p in fluid.default_main_program().all_parameters()][0].name)
+    o_mx, o_av, o_l, o_f = (np.asarray(v) for v in exe.run(
+        feed={'w': ws, 'w_len': lens},
+        fetch_list=[mx, av, lst, fst]))
+    np.testing.assert_allclose(o_mx[0], table[[3, 4]].max(0), rtol=1e-5)
+    np.testing.assert_allclose(o_av[0], table[[3, 4]].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(o_l[0], table[4], rtol=1e-5)
+    np.testing.assert_allclose(o_f[0], table[3], rtol=1e-5)
+
+
+def test_lstm_gru_rnn_shapes_and_train():
+    x = data_layer(name='x', size=8, seq_type=1)
+    h_l = simple_lstm(input=x, size=6)
+    h_g = simple_gru(input=x, size=5)
+    h_r = recurrent_layer(input=fc_layer(x, 7, bias_attr=False),
+                          act=TanhActivation())
+    bi = bidirectional_lstm(input=x, size=4, return_seq=True)
+    cost = regression_cost(
+        input=fc_layer(concat_layer([last_seq(h_l), last_seq(h_g),
+                                     last_seq(h_r), last_seq(bi)]),
+                       size=1),
+        label=data_layer(name='y', size=1))
+    settings(learning_rate=0.01,
+             learning_method=AdamOptimizer()).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(3, 5, 8).astype('float32'),
+            'x_len': np.array([5, 3, 4], 'int32'),
+            'y': rng.randn(3, 1).astype('float32')}
+    vals = exe.run(feed=feed, fetch_list=[h_l, h_g, h_r, bi, cost])
+    assert np.asarray(vals[0]).shape == (3, 5, 6)
+    assert np.asarray(vals[1]).shape == (3, 5, 5)
+    assert np.asarray(vals[2]).shape == (3, 5, 7)
+    assert np.asarray(vals[3]).shape == (3, 5, 8)
+    l0 = float(np.asarray(vals[4]).reshape(()))
+    for _ in range(5):
+        loss, = exe.run(feed=feed, fetch_list=[cost])
+    assert float(np.asarray(loss).reshape(())) < l0
+
+
+def test_recurrent_layer_matches_numpy():
+    x = data_layer(name='x', size=3, seq_type=1)
+    h = recurrent_layer(
+        input=x, act=TanhActivation(),
+        param_attr=ParameterAttribute(
+            initializer=fluid.initializer.Constant(0.1)),
+        bias_attr=False)
+    xs = np.random.RandomState(0).randn(2, 4, 3).astype('float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o, = exe.run(feed={'x': xs, 'x_len': np.array([4, 4], 'int32')},
+                 fetch_list=[h])
+    o = np.asarray(o)
+    w = np.full((3, 3), 0.1, 'f')
+    h_prev = np.zeros((2, 3), 'f')
+    for t in range(4):
+        h_prev = np.tanh(xs[:, t] + h_prev @ w)
+        np.testing.assert_allclose(o[:, t], h_prev, rtol=1e-4, atol=1e-5)
+
+
+def test_image_stack_runs():
+    img = data_layer(name='img', size=1 * 16 * 16)
+    lbl = data_layer(name='lbl', size=1, dtype='int64')
+    cp = simple_img_conv_pool(input=img, filter_size=3, num_filters=4,
+                              pool_size=2, num_channels=1,
+                              act=ReluActivation(), conv_padding=1)
+    conv2 = img_conv_layer(cp, filter_size=3, num_filters=6, padding=1,
+                           act=ReluActivation())
+    pool2 = img_pool_layer(conv2, pool_size=2, stride=2)
+    prob = fc_layer(input=pool2, size=10, act=SoftmaxActivation())
+    cost = classification_cost(input=prob, label=lbl)
+    settings(learning_rate=0.01,
+             learning_method=MomentumOptimizer(0.9),
+             regularization=L2Regularization(1e-4)).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(8, 256).astype('float32'),
+            'lbl': rng.randint(0, 10, (8, 1)).astype('int64')}
+    l0 = exe.run(feed=feed, fetch_list=[cost])[0]
+    for _ in range(3):
+        l1 = exe.run(feed=feed, fetch_list=[cost])[0]
+    assert np.isfinite(np.asarray(l1)).all()
+
+
+def test_elementwise_helpers_match_numpy():
+    a = data_layer(name='a', size=4)
+    b = data_layer(name='b', size=4)
+    wvar = data_layer(name='w', size=1)
+    sums = addto_layer([a, b])
+    cs = cos_sim(a, b, scale=1)
+    interp = interpolation_layer([a, b], wvar)
+    si = slope_intercept_layer(a, slope=2.0, intercept=1.0)
+    tr = trans_layer(a)
+    rep = repeat_layer(a, 2)
+    mid = maxid_layer(a)
+    av = np.array([[1., 2., 3., 4.], [0., 1., 0., 1.]], 'f')
+    bv = np.array([[2., 2., 2., 2.], [1., 0., 1., 0.]], 'f')
+    wv = np.array([[0.25], [0.75]], 'f')
+    _, outs = _run([sums, cs, interp, si, tr, rep, mid],
+                   {'a': av, 'b': bv, 'w': wv})
+    o_sum, o_cs, o_in, o_si, o_tr, o_rep, o_mid = \
+        (np.asarray(v) for v in outs)
+    np.testing.assert_allclose(o_sum, av + bv, rtol=1e-5)
+    ref_cs = (av * bv).sum(1) / (np.linalg.norm(av, axis=1)
+                                 * np.linalg.norm(bv, axis=1))
+    np.testing.assert_allclose(o_cs.reshape(-1), ref_cs, rtol=1e-5)
+    np.testing.assert_allclose(o_in, wv * av + (1 - wv) * bv, rtol=1e-5)
+    np.testing.assert_allclose(o_si, 2 * av + 1, rtol=1e-5)
+    np.testing.assert_allclose(o_tr, av.T, rtol=1e-5)
+    np.testing.assert_allclose(o_rep, np.concatenate([av, av], 1))
+    np.testing.assert_allclose(o_mid.reshape(-1), av.argmax(1))
+
+
+def test_context_projection_matches_numpy():
+    x = data_layer(name='x', size=2, seq_type=1)
+    out = mixed_layer(input=[context_projection(x, context_len=3)],
+                      bias_attr=False)
+    xs = np.arange(12, dtype='float32').reshape(1, 6, 2)
+    _, (o,) = _run([out], {'x': xs,
+                           'x_len': np.array([6], 'int32')})
+    o = np.asarray(o)
+    assert o.shape == (1, 6, 6)
+    # middle offset (i=1) is the identity copy
+    np.testing.assert_allclose(o[0, :, 2:4], xs[0], rtol=1e-5)
+    # left context at t=0 is zero padding
+    np.testing.assert_allclose(o[0, 0, 0:2], np.zeros(2), atol=1e-6)
+    np.testing.assert_allclose(o[0, 1:, 0:2], xs[0, :-1], rtol=1e-5)
+    # right context at the end is zero padding
+    np.testing.assert_allclose(o[0, -1, 4:6], np.zeros(2), atol=1e-6)
+    np.testing.assert_allclose(o[0, :-1, 4:6], xs[0, 1:], rtol=1e-5)
+
+
+def test_unshimmed_name_names_fluid_equivalent():
+    import paddle_tpu.trainer_config_helpers.layers as v1l
+    with pytest.raises(NotImplementedError, match='DynamicRNN'):
+        v1l.recurrent_group
+    with pytest.raises(AttributeError):
+        v1l.definitely_not_a_layer
