@@ -5,8 +5,11 @@ Scope to an .npz bundle plus a JSON manifest — a single-file, orbax-free
 checkpoint format that round-trips bf16 via uint16 views.
 """
 
+import hashlib
 import json
 import os
+import tempfile
+import threading
 
 import numpy as np
 
@@ -76,21 +79,64 @@ def _snapshot_vars(main_program, vars=None, predicate=None):
     return arrays, manifest
 
 
-def _write_snapshot(dirname, arrays, manifest, filename=None):
-    """Disk half of a save: atomic via tmp + rename, so a crash mid-
-    write cannot corrupt a previous checkpoint in the same dirname."""
+# Serializes snapshot installs within this process: overlapping saves
+# (an async writer still in flight when the next save starts) must not
+# interleave their renames in one dirname.
+_SAVE_LOCK = threading.Lock()
+
+
+def _write_atomic(path, write_fn, mode='wb'):
+    """Write via a UNIQUE tmp file in the target directory + rename —
+    unique so concurrent writers never share a tmp (a fixed '.tmp'
+    suffix would let a second save corrupt an in-flight first one)."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or '.',
+                               prefix=os.path.basename(path) + '.')
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+        # mkstemp creates 0600; restore umask-governed perms so other
+        # accounts (eval/serving jobs on shared storage) can read
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _sha1_of(path):
+    h = hashlib.sha1()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_snapshot_locked(dirname, arrays, manifest, filename=None):
+    """Disk half of a save — caller must hold _SAVE_LOCK. Each file
+    lands atomically (unique tmp + rename). Returns the sha1 digests of
+    the installed (manifest, params) files; checkpoint meta records both
+    so load_checkpoint can detect a torn pairing (crash between any of
+    the renames)."""
     os.makedirs(dirname, exist_ok=True)
     params_path = os.path.join(dirname, filename or _PARAMS_FILE)
     if not params_path.endswith('.npz'):
         params_path += '.npz'
-    tmp = params_path + '.tmp'
-    with open(tmp, 'wb') as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, params_path)
     man_path = os.path.join(dirname, _MANIFEST_FILE)
-    with open(man_path + '.tmp', 'w') as f:
-        json.dump(manifest, f, indent=1)
-    os.replace(man_path + '.tmp', man_path)
+    _write_atomic(man_path,
+                  lambda f: f.write(json.dumps(manifest,
+                                               indent=1).encode()))
+    _write_atomic(params_path, lambda f: np.savez(f, **arrays))
+    return _sha1_of(man_path), _sha1_of(params_path)
+
+
+def _write_snapshot(dirname, arrays, manifest, filename=None):
+    with _SAVE_LOCK:
+        return _write_snapshot_locked(dirname, arrays, manifest, filename)
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -226,11 +272,14 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     async_save: snapshot device->host synchronously (donated buffers
     make deferred reads unsafe), then serialize + write on a background
     thread; training continues immediately. Returns an AsyncSaveHandle
-    whose result() is the completeness point; writes are atomic (tmp +
-    rename), so a crash mid-write leaves the previous checkpoint
-    intact. Multihost runs fall back to the synchronous path — the
-    completion barrier may not run off-thread (it would race the
-    training step's collectives)."""
+    whose result() is the completeness point (on multihost the write
+    runs synchronously — off-thread it would race the training step's
+    collectives — and an already-completed handle is returned so the
+    caller's .result() chain is portable). Each file lands atomically
+    via unique-tmp + rename, overlapping saves to one dirname
+    serialize, and checkpoint.json — written LAST — records the params
+    sha1: a crash between the renames leaves a pairing load_checkpoint
+    detects and refuses instead of silently resuming the wrong step."""
     import jax
     meta = {}
     if step is not None:
@@ -238,15 +287,22 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     if reader is not None:
         meta['reader'] = reader.state_dict()
 
-    def _write_meta():
-        if meta:
-            # single writer, like save_persistables; positional sharding
-            # advances every host's reader identically, so process 0's
-            # (epoch, offset) is valid for all shards
+    def _install(arrays, manifest):
+        # snapshot AND meta land under ONE lock acquisition: with the
+        # meta write outside it, two overlapping saves could install
+        # params from one and checkpoint.json from the other, tripping
+        # the torn check on a healthy directory. Single writer, like
+        # save_persistables; positional sharding advances every host's
+        # reader identically, so process 0's (epoch, offset) is valid
+        # for all shards.
+        with _SAVE_LOCK:
+            man_sha, params_sha = _write_snapshot_locked(
+                dirname, arrays, manifest)
+            meta['manifest_sha1'] = man_sha
+            meta['params_sha1'] = params_sha
             path = os.path.join(dirname, 'checkpoint.json')
-            with open(path + '.tmp', 'w') as f:
-                json.dump(meta, f)
-            os.replace(path + '.tmp', path)
+            _write_atomic(path,
+                          lambda f: f.write(json.dumps(meta).encode()))
 
     if async_save and jax.process_count() == 1:
         main = main_program or default_main_program()
@@ -255,26 +311,47 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
 
         def _writer():
             try:
-                _write_snapshot(dirname, arrays, manifest)
-                _write_meta()
+                _install(arrays, manifest)
             except BaseException as e:  # surfaced via handle.result()
                 errbox.append(e)
 
-        import threading
         t = threading.Thread(target=_writer, daemon=True,
                              name='paddle_tpu_async_save')
         t.start()
         return AsyncSaveHandle(t, errbox)
 
-    save_persistables(executor, dirname, main_program)
+    main = main_program or default_main_program()
+    arrays, manifest = _snapshot_vars(main, predicate=_is_persistable)
     if jax.process_index() == 0:
-        _write_meta()
+        _install(arrays, manifest)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices('paddle_tpu_save_checkpoint')
+    if async_save:  # multihost fallback: completed no-op handle
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        return AsyncSaveHandle(t, [])
     return None
 
 
 def load_checkpoint(executor, dirname, main_program=None, reader=None):
-    load_persistables(executor, dirname, main_program)
     path = os.path.join(dirname, 'checkpoint.json')
+    if os.path.exists(path):
+        with open(path) as f:
+            recorded = json.load(f)
+        for key, fname in (('params_sha1', _PARAMS_FILE),
+                           ('manifest_sha1', _MANIFEST_FILE)):
+            want = recorded.get(key)
+            if want is not None and \
+                    _sha1_of(os.path.join(dirname, fname)) != want:
+                raise ValueError(
+                    'load_checkpoint: %r is torn — %s does not match '
+                    'the sha1 recorded in checkpoint.json (a save was '
+                    'interrupted between renames). Restore from an '
+                    'older checkpoint; resuming here would pair weights '
+                    'with the wrong step/reader state.' % (dirname, fname))
+    load_persistables(executor, dirname, main_program)
     if not os.path.exists(path):
         if reader is not None:
             raise ValueError(

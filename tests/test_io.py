@@ -159,3 +159,19 @@ def test_async_checkpoint_snapshot_isolation(tmp_path):
     assert step == 1
     np.testing.assert_array_equal(
         np.asarray(fluid.global_scope().find('aw')), w_at_save)
+
+
+def test_torn_checkpoint_detected(tmp_path):
+    """Crash between the params rename and the checkpoint.json rename
+    (the torn-pair window): load_checkpoint must refuse, not silently
+    resume new weights against a stale step."""
+    import pytest
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe, steps=2)
+    fluid.io.save_checkpoint(exe, str(tmp_path), step=2)
+    # simulate the torn state: params.npz replaced after meta was cut
+    w = np.asarray(fluid.global_scope().find('w'))
+    fluid.global_scope().set('w', w + 1.0)
+    fluid.io.save_persistables(exe, str(tmp_path))
+    with pytest.raises(ValueError, match='torn'):
+        fluid.io.load_checkpoint(exe, str(tmp_path))

@@ -1,0 +1,141 @@
+"""Relay watcher: probe the tunneled TPU, drain a workload queue on
+recovery.
+
+The axon relay is intermittent (SURVEY §5.0/§7.14: up ~35 min one
+session, down 10 h the next, and it can answer a probe then hang
+mid-compile). This watcher turns chip availability into captured
+numbers without a human in the loop: every --interval seconds it
+launches a subprocess that jits a trivial matmul (timeout --probe-s;
+np.asarray sync — block_until_ready returns at enqueue on the relay);
+when the probe passes it runs the next pending workload from QUEUE,
+each in its own watchdogged subprocess, and appends one JSON line per
+attempt to --out (ONCHIP_r04.jsonl at the repo root by default).
+A workload that times out or errors is retried on a later recovery,
+up to --retries attempts; between workloads the probe re-runs so a
+mid-drain relay death stops the queue instead of burning every
+workload's timeout against a dead chip.
+
+Run: nohup python tools/onchip_watcher.py &   (stdout is the ledger)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_SRC = ("import jax, jax.numpy as jnp, numpy as np;"
+             "x = jnp.ones((256, 256), jnp.bfloat16);"
+             "y = jax.jit(lambda a: a @ a)(x);"
+             "np.asarray(y.astype(jnp.float32));"
+             "print('PROBE_OK', flush=True)")
+
+# (name, argv, timeout_s) — argv runs from the repo root
+QUEUE = [
+    ('conv_bwd_microbench',
+     [sys.executable, 'tools/conv_bwd_microbench.py', '--inner', '8'], 1500),
+    ('resnet50_anatomy',
+     [sys.executable, 'bench.py', '--workload', 'resnet50_anatomy',
+      '--backend', 'tpu'], 900),
+    ('attention_microbench',
+     [sys.executable, 'bench.py', '--workload', 'attention_microbench',
+      '--backend', 'tpu'], 900),
+    ('transformer_seq256',
+     [sys.executable, 'bench.py', '--workload', 'transformer_seq256',
+      '--backend', 'tpu'], 600),
+    ('moe_cap1.25',
+     [sys.executable, 'bench.py', '--workload', 'moe_cap1.25',
+      '--backend', 'tpu'], 600),
+    ('resnet50_bn_fp32',
+     [sys.executable, 'bench.py', '--workload', 'resnet50',
+      '--backend', 'tpu'], 600, {'PADDLE_TPU_BN_COMPUTE': 'fp32'}),
+    ('resnet50_nchw_ir',
+     [sys.executable, 'bench.py', '--workload', 'resnet50',
+      '--backend', 'tpu'], 600, {'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'}),
+]
+
+
+def probe(timeout):
+    try:
+        r = subprocess.run([sys.executable, '-c', PROBE_SRC],
+                           capture_output=True, text=True, timeout=timeout,
+                           cwd=REPO)
+        return 'PROBE_OK' in (r.stdout or '')
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_one(name, argv, timeout, extra_env=None):
+    env = dict(os.environ)
+    env.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/xla_cache')
+    env.update(extra_env or {})
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO, env=env)
+        ok = r.returncode == 0
+        out = r.stdout or ''
+    except subprocess.TimeoutExpired as e:
+        ok = False
+        out = (e.stdout.decode() if isinstance(e.stdout, bytes)
+               else (e.stdout or ''))
+    # keep every RESULT / RESULT_JSON / json line the child printed
+    results = [ln for ln in out.splitlines()
+               if ln.startswith(('RESULT', '{'))]
+    return {'workload': name, 'ok': ok, 'wall_s': round(time.time() - t0, 1),
+            'results': results[-40:],
+            'env': {k: v for k, v in (extra_env or {}).items()}}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--interval', type=float, default=180)
+    p.add_argument('--probe-s', type=float, default=75)
+    p.add_argument('--retries', type=int, default=3)
+    p.add_argument('--out', default=os.path.join(REPO, 'ONCHIP_r04.jsonl'))
+    args = p.parse_args()
+    attempts = {name: 0 for name, *_ in QUEUE}
+    done = set()
+
+    def emit(rec):
+        rec['ts'] = round(time.time(), 1)
+        with open(args.out, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+        print(json.dumps(rec), flush=True)
+
+    def exhausted():
+        return all(item[0] in done or attempts[item[0]] >= args.retries
+                   for item in QUEUE)
+
+    while not exhausted():
+        if not probe(args.probe_s):
+            time.sleep(args.interval)
+            continue
+        emit({'event': 'relay_up'})
+        for item in QUEUE:
+            name, argv, timeout = item[0], item[1], item[2]
+            extra_env = item[3] if len(item) > 3 else None
+            if name in done or attempts[name] >= args.retries:
+                continue
+            attempts[name] += 1
+            rec = run_one(name, argv, timeout, extra_env)
+            rec['attempt'] = attempts[name]
+            emit(rec)
+            if rec['ok']:
+                done.add(name)
+            elif not probe(args.probe_s):
+                emit({'event': 'relay_down_mid_drain'})
+                break
+        # failed-but-retryable workloads go around again; the probe at
+        # the top of the loop rate-limits re-drains while the relay
+        # flaps, and exhausted() is the only terminal condition
+        if not exhausted():
+            time.sleep(args.interval)
+    emit({'event': 'watcher_exit', 'done': sorted(done)})
+
+
+if __name__ == '__main__':
+    main()
