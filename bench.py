@@ -592,6 +592,17 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_single_dispatch'] = \
                     round(tok_1d, 1)
+        if not over_budget():
+            # custom_vjp fused CE (r4): ablation restores the
+            # materializing log_softmax form for the A/B
+            tok_nce, err = _run_workload(
+                'transformer', backend, reduced, timeout,
+                env={'PADDLE_TPU_FUSED_CE': '0'})
+            if err:
+                errors['transformer_naive_ce'] = err
+            else:
+                ablations['transformer_tok_per_sec_naive_ce'] = \
+                    round(tok_nce, 1)
         if not over_budget(extra=150.0):
             # seq-256 compile (run_steps scan over a longer-attention
             # graph) can exceed the standard watchdog — give it slack

@@ -5,12 +5,21 @@ softmax_with_cross_entropy_op,dropout_op,accuracy_op,...}.cc
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register
+
+
+def _fused_ce_enabled():
+    # Read at TRACE time: the leg is frozen into the compiled graph, so
+    # flipping it needs a fresh process (bench A/Bs run workload
+    # children) or a program-version bump — same contract as the other
+    # env knobs (PADDLE_TPU_BN_COMPUTE, PADDLE_TPU_CONV_LAYOUT).
+    return os.environ.get('PADDLE_TPU_FUSED_CE', '1') != '0'
 
 
 @register('lookup_table')
@@ -67,21 +76,36 @@ def _cross_entropy(ctx):
 def _softmax_xent(ctx):
     logits = ctx.input('Logits')
     label = ctx.input('Label')
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
     if ctx.attr('soft_label', False):
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
         loss = -jnp.sum(label * log_probs, axis=-1, keepdims=True)
+        ctx.set_output('Softmax', jnp.exp(log_probs))
+        ctx.set_output('Loss', loss)
+        return
+    if label.ndim == logits.ndim and label.shape[-1] == 1:
+        label = label.squeeze(-1)
+    if _fused_ce_enabled():
+        # hard labels: NLL == the eps=0 point of the fused label-
+        # smoothed CE — same custom_vjp, so no fp32 [.., V] log-prob
+        # tensor is materialized or saved (see _ls_ce_fused). The
+        # Softmax output is computed independently and DCE'd by XLA
+        # whenever unfetched; both outputs keep the logits dtype, as
+        # the materializing form did.
+        loss = _ls_ce_fused(logits, label, 0.0)[..., None] \
+            .astype(logits.dtype)
+        softmax = jax.nn.softmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(logits.dtype)
     else:
-        if label.ndim == logits.ndim and label.shape[-1] == 1:
-            label = label.squeeze(-1)
-        picked = jnp.take_along_axis(log_probs,
-                                     label[..., None].astype(jnp.int32),
-                                     axis=-1)
-        loss = -picked
-        ignore_index = ctx.attr('ignore_index', -100)
-        if ignore_index is not None and ignore_index >= 0:
-            mask = (label[..., None] != ignore_index)
-            loss = loss * mask.astype(loss.dtype)
-    ctx.set_output('Softmax', jnp.exp(log_probs))
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.take_along_axis(log_probs,
+                                    label[..., None].astype(jnp.int32),
+                                    axis=-1)
+        softmax = jnp.exp(log_probs)
+    ignore_index = ctx.attr('ignore_index', -100)
+    if ignore_index is not None and ignore_index >= 0:
+        mask = (label[..., None] != ignore_index)
+        loss = loss * mask.astype(loss.dtype)
+    ctx.set_output('Softmax', softmax)
     ctx.set_output('Loss', loss)
 
 
@@ -389,7 +413,14 @@ def _label_smoothed_xent(ctx):
     eps = ctx.attr('epsilon', 0.1)
     if label.ndim == logits.ndim:
         label = label.squeeze(-1)
-    loss = _ls_ce_fused(logits, label, float(eps))
+    if not _fused_ce_enabled():
+        # ablation leg: the naive materializing form, benchable A/B
+        lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(lsm, label[..., None].astype(jnp.int32),
+                                   axis=-1)[..., 0]
+        loss = (1.0 - eps) * nll + eps * -jnp.mean(lsm, axis=-1)
+    else:
+        loss = _ls_ce_fused(logits, label, float(eps))
     ctx.set_output('Loss', loss[..., None])
 
 
