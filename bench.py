@@ -569,6 +569,29 @@ def main():
                     img_s = img_nchw  # headline takes the faster layout
                 elif img_s is not None:
                     ablations['resnet50_layout_winner'] = 'NHWC_IR'
+        if backend not in ('cpu',) and not layout_env \
+                and not over_budget():
+            # space-to-depth stem rewrite (r4): exact-math 4x4 s1 conv
+            # over 2x2-stacked planes instead of the Cin=3 7x7 s2 stem.
+            # Only meaningful on the NHWC-native network (the lowering
+            # gates on data_format NHWC) — skipped if NCHW-IR won the
+            # layout A/B above (layout_env non-empty), where this run
+            # would re-measure an identical program.
+            img_s2d, err = _run_workload(
+                'resnet50', backend, reduced, timeout,
+                env=dict(layout_env, PADDLE_TPU_CONV_S2D='1'))
+            if err:
+                errors['resnet50_s2d_stem'] = err
+            else:
+                ablations['resnet50_img_per_sec_s2d_stem'] = round(
+                    img_s2d, 1)
+                if img_s is not None and img_s2d > img_s * 1.02:
+                    ablations['resnet50_stem_winner'] = 's2d'
+                    layout_env = dict(layout_env,
+                                      PADDLE_TPU_CONV_S2D='1')
+                    img_s = img_s2d
+                elif img_s is not None:
+                    ablations['resnet50_stem_winner'] = 'direct'
         if not over_budget():
             # carries the winning layout so only the BN compute differs
             img_bn, err = _run_workload(

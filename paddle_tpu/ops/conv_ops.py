@@ -27,6 +27,44 @@ def _conv_layout():
     return 'NHWC' if is_tpu_backend() else 'NCHW'
 
 
+def _s2d_stem(x_nhwc, w_oihw):
+    """Space-to-depth rewrite of the ResNet stem conv (k=7, s=2, p=3,
+    small Cin): exactly equivalent to the original conv, but over a
+    2x2-space-to-depth input — [B, H/2, W/2, 4*Cin] with a 4x4 stride-1
+    kernel — so the contraction dim grows 4x toward the MXU's 128 lanes
+    and the stride-2 pattern disappears (the MLPerf ResNet stem trick).
+
+    Derivation: out[y,x,o] = Σ_{dy,dx,c} w[dy,dx,c,o]·in[2y+dy-3, ...].
+    Write 2y+dy-3 = 2(y+uy)+py with py=(dy+1)%2, uy=(dy-3-py)//2 ∈
+    [-2,1]: a 4-tap stride-1 conv over the (py,c)-stacked planes with
+    asymmetric padding (2,1); kernel slot (uy,py) holds w[2uy+py+3]
+    (the single out-of-range slot dy=-1 is zero)."""
+    b, h, wdt, c = x_nhwc.shape
+    # [B, H/2, 2, W/2, 2, C] -> [B, H/2, W/2, 2, 2, C] -> merge
+    x2 = x_nhwc.reshape(b, h // 2, 2, wdt // 2, 2, c) \
+        .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, wdt // 2, 4 * c)
+    o = w_oihw.shape[0]
+    # build w2[uy+2, ux+2, (py,px,c), o] = w[o, c, 2uy+py+3, 2ux+px+3]
+    w_hwio = w_oihw.transpose(2, 3, 1, 0)  # [7,7,C,O]
+    wp = jnp.pad(w_hwio, [(1, 0), (1, 0), (0, 0), (0, 0)])  # dy=-1 slot
+    # wp index = dy+1 = 2uy+py+4 = 2(uy+2)+py: reshape [4,2,4,2,C,O]
+    w2 = wp.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5) \
+        .reshape(4, 4, 4 * c, o)
+    return jax.lax.conv_general_dilated(
+        x2, w2, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+def _s2d_applicable(x_nhwc, w, strides, pads, dilations, groups):
+    if os.environ.get('PADDLE_TPU_CONV_S2D', '0') != '1':
+        return False
+    return (w.shape[2] == 7 and w.shape[3] == 7 and strides == (2, 2)
+            and tuple(pads) in ((3, 3), (3, 3, 3, 3))
+            and dilations == (1, 1) and groups == 1
+            and w.shape[1] <= 4 and x_nhwc.shape[1] % 2 == 0
+            and x_nhwc.shape[2] % 2 == 0)
+
+
 @register('conv2d')
 def _conv2d(ctx):
     x = ctx.input('Input')  # NCHW (or NHWC when data_format says so)
@@ -39,6 +77,9 @@ def _conv2d(ctx):
         else [(pads[0], pads[1]), (pads[2], pads[3])]
     pref = x.dtype if x.dtype == jnp.float32 else None
     if ctx.attr('data_format', 'NCHW') == 'NHWC':
+        if _s2d_applicable(x, w, strides, pads, dilations, groups):
+            ctx.set_output('Output', _s2d_stem(x, w))
+            return
         # Activations are NHWC *in the IR* (layers.conv2d data_format=
         # 'NHWC'): no boundary transposes at all — the whole network
         # stays channels-last end-to-end, which is the TPU-native

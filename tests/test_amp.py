@@ -264,3 +264,40 @@ def test_mobilenet_native_nhwc_matches_nchw():
 
     np.testing.assert_allclose(run('NCHW'), run('NHWC'),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_s2d_stem_matches_direct_conv(monkeypatch):
+    """PADDLE_TPU_CONV_S2D=1 rewrites the ResNet stem conv (7x7 s2 p3,
+    small Cin, NHWC-native) onto a space-to-depth 4x4 s1 conv — exact
+    math, MXU-friendlier contraction (the MLPerf stem trick)."""
+    def _stem(steps=3):
+        fluid.reset_default_programs()
+        fluid.global_scope().clear()
+        fluid.default_main_program().random_seed = 11
+        img = fluid.layers.data(name='image', shape=[3, 32, 32],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        x = fluid.layers.transpose(img, [0, 2, 3, 1])
+        x = fluid.layers.conv2d(input=x, num_filters=16, filter_size=7,
+                                stride=2, padding=3, bias_attr=False,
+                                data_format='NHWC')
+        x = fluid.layers.pool2d(x, pool_type='avg', global_pooling=True,
+                                data_format='NHWC')
+        pred = fluid.layers.fc(x, size=10, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9) \
+            .minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(3)
+        feed = {'image': rng.rand(4, 3, 32, 32).astype('float32'),
+                'label': rng.randint(0, 10, (4, 1)).astype('int64')}
+        return [float(np.asarray(exe.run(feed=feed,
+                                         fetch_list=[loss])[0]))
+                for _ in range(steps)]
+
+    monkeypatch.delenv('PADDLE_TPU_CONV_S2D', raising=False)
+    base = _stem()
+    monkeypatch.setenv('PADDLE_TPU_CONV_S2D', '1')
+    s2d = _stem()
+    np.testing.assert_allclose(base, s2d, rtol=1e-4, atol=1e-5)

@@ -55,6 +55,15 @@ QUEUE = [
     ('resnet50_nchw_ir',
      [sys.executable, 'bench.py', '--workload', 'resnet50',
       '--backend', 'tpu'], 600, {'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'}),
+    ('resnet50_s2d_stem',
+     [sys.executable, 'bench.py', '--workload', 'resnet50',
+      '--backend', 'tpu'], 600, {'PADDLE_TPU_CONV_S2D': '1'}),
+    ('transformer_naive_ce',
+     [sys.executable, 'bench.py', '--workload', 'transformer',
+      '--backend', 'tpu'], 600, {'PADDLE_TPU_FUSED_CE': '0'}),
+    ('transformer_fused_ce',
+     [sys.executable, 'bench.py', '--workload', 'transformer',
+      '--backend', 'tpu'], 600),
 ]
 
 
