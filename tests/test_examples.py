@@ -41,3 +41,9 @@ def test_ctr_sparse_resume_example():
     out = _run_example('train_ctr_sparse_resume.py')
     assert 'expect 8' in out
     assert 'epoch finished' in out
+
+
+def test_v1_quickstart_example():
+    out = _run_example('train_v1_quickstart.py')
+    final = float(out.strip().splitlines()[-1].split()[-1])
+    assert final < 0.1
