@@ -737,6 +737,30 @@ def main():
             }
         except Exception:
             pass
+        try:
+            # watcher-captured workloads (tools/onchip_watcher.py drains
+            # its queue whenever the relay flaps up): surface the ok
+            # records so the artifact carries the freshest chip evidence
+            wpath = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), 'ONCHIP_r04.jsonl')
+            if os.path.exists(wpath):
+                ok = []
+                with open(wpath) as f:
+                    for ln in f:
+                        # per-line: the watcher may append concurrently,
+                        # and one torn line must not drop the rest
+                        try:
+                            r = json.loads(ln)
+                        except ValueError:
+                            continue
+                        if r.get('ok'):
+                            ok.append(r)
+                if ok:
+                    detail['watcher_onchip_results'] = {
+                        r['workload']: r.get('results', [])[-3:]
+                        for r in ok}
+        except Exception:
+            pass
 
     print(json.dumps({
         'metric': metric,

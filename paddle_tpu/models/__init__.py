@@ -19,4 +19,5 @@ from . import resnext  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import wide_deep  # noqa: F401
 from . import seq_models  # noqa: F401
+from . import rnn_search  # noqa: F401
 from . import transformer  # noqa: F401

@@ -1,0 +1,115 @@
+"""RNN encoder-decoder with attention — the machine_translation book
+chapter's model (reference: the seqToseq demo / book machine_translation
+chapter; v1 networks.py simple_attention + gru_group decoder).
+
+TPU-native: the bidirectional GRU encoder is two lax.scan recurrences
+(layers.dynamic_gru), and the attention decoder is a fluid DynamicRNN
+whose step block — additive attention over the full encoder output,
+gru_unit state update, vocab projection — compiles into ONE lax.scan
+body; the encoder states enter the scan as closed-over constants
+(ops/control_ops.py _scan_rnn outer_env), so the whole seq2seq trains
+as a single XLA computation like every other model here.
+"""
+
+import numpy as np
+
+from .. import layers
+
+
+def encoder(src_word, src_len, src_vocab, emb_dim=64, hidden_dim=64):
+    """Bi-GRU over the padded source: returns [B, Ts, 2H] states plus
+    the backward direction's summary (decoder boot, per the chapter)."""
+    emb = layers.embedding(input=src_word, size=[src_vocab, emb_dim])
+    fwd = layers.dynamic_gru(
+        input=layers.fc(input=emb, size=hidden_dim * 3, bias_attr=False,
+                        num_flatten_dims=2),
+        size=hidden_dim, length=src_len)
+    bwd = layers.dynamic_gru(
+        input=layers.fc(input=emb, size=hidden_dim * 3, bias_attr=False,
+                        num_flatten_dims=2),
+        size=hidden_dim, is_reverse=True, length=src_len)
+    encoded = layers.concat([fwd, bwd], axis=-1)          # [B, Ts, 2H]
+    boot = layers.fc(input=layers.sequence_first_step(bwd, length=src_len),
+                     size=hidden_dim, act='tanh')          # [B, H]
+    return encoded, boot
+
+
+def additive_attention(encoded, encoded_proj, state, hidden_dim,
+                       length=None):
+    """Bahdanau additive attention over a padded sequence, built from
+    fluid layers — safe inside a DynamicRNN step block. This is the ONE
+    home of the attention math; the v1 shim's simple_attention
+    (trainer_config_helpers/networks.py) delegates here."""
+    dec = layers.fc(input=state, size=hidden_dim, bias_attr=False)
+    combined = layers.tanh(layers.elementwise_add(
+        encoded_proj, layers.unsqueeze(dec, axes=[1])))
+    scores = layers.fc(input=combined, size=1, num_flatten_dims=2,
+                       bias_attr=False)                    # [B, Ts, 1]
+    weights = layers.sequence_softmax(
+        layers.squeeze(scores, axes=[2]), length=length)   # [B, Ts]
+    ctx = layers.matmul(layers.unsqueeze(weights, axes=[1]), encoded)
+    return layers.squeeze(ctx, axes=[1])                   # [B, ...]
+
+
+def rnn_search(src_vocab=1000, trg_vocab=1000, emb_dim=64, hidden_dim=64):
+    """Training graph: teacher-forced attention decoder. Returns
+    (avg_cost, feed names). Feeds: src_word [B,Ts] int64, src_len [B]
+    int32, trg_word [B,Tt] int64 (decoder input, <s>-shifted), lbl_word
+    [B,Tt] int64, lbl_mask [B,Tt] float32 (1 on real target steps)."""
+    src_word = layers.data(name='src_word', shape=[-1], dtype='int64',
+                           lod_level=1)
+    src_len = layers.data(name='src_len', shape=[], dtype='int32')
+    trg_word = layers.data(name='trg_word', shape=[-1], dtype='int64',
+                           lod_level=1)
+    lbl_word = layers.data(name='lbl_word', shape=[-1], dtype='int64',
+                           lod_level=1)
+    lbl_mask = layers.data(name='lbl_mask', shape=[-1], dtype='float32',
+                           lod_level=1)
+
+    encoded, boot = encoder(src_word, src_len, src_vocab, emb_dim,
+                            hidden_dim)
+    # shared attention key projection, computed once outside the scan
+    encoded_proj = layers.fc(input=encoded, size=hidden_dim,
+                             bias_attr=False, num_flatten_dims=2)
+    trg_emb = layers.embedding(input=trg_word,
+                               size=[trg_vocab, emb_dim])
+
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        emb_t = drnn.step_input(trg_emb)                   # [B, E]
+        state = drnn.memory(init=boot)                     # [B, H]
+        context = additive_attention(encoded, encoded_proj, state,
+                                     hidden_dim, length=src_len)
+        step_in = layers.fc(
+            input=layers.concat([emb_t, context], axis=-1),
+            size=hidden_dim * 3, bias_attr=False)
+        new_state, _, _ = layers.gru_unit(step_in, state,
+                                          size=hidden_dim * 3)
+        drnn.update_memory(state, new_state)
+        logits = layers.fc(input=new_state, size=trg_vocab)
+        drnn.output(logits)
+    logits = drnn()                                        # [B, Tt, V]
+
+    cost = layers.softmax_with_cross_entropy(
+        logits=logits, label=layers.unsqueeze(lbl_word, axes=[2]))
+    cost = layers.squeeze(cost, axes=[2])                  # [B, Tt]
+    weighted = layers.elementwise_mul(cost, lbl_mask)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(weighted),
+        layers.reduce_sum(lbl_mask))
+    return avg_cost, ['src_word', 'src_len', 'trg_word', 'lbl_word',
+                      'lbl_mask']
+
+
+def make_fake_batch(batch, src_seq, trg_seq, src_vocab, trg_vocab,
+                    seed=0):
+    """Synthetic copy-ish task feed (zero-egress environment)."""
+    rng = np.random.RandomState(seed)
+    src = rng.randint(2, src_vocab, (batch, src_seq)).astype('int64')
+    lbl = (src[:, :trg_seq] % (trg_vocab - 2) + 2).astype('int64')
+    trg = np.concatenate([np.ones((batch, 1), 'int64'),  # <s> = 1
+                          lbl[:, :-1]], axis=1)
+    return {'src_word': src,
+            'src_len': np.full((batch,), src_seq, 'int32'),
+            'trg_word': trg, 'lbl_word': lbl,
+            'lbl_mask': np.ones((batch, trg_seq), 'float32')}

@@ -206,18 +206,15 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
                      transform_param_attr=None, softmax_param_attr=None,
                      name=None):
     """Bahdanau-style additive attention over a padded sequence
-    (reference networks.py simple_attention)."""
-    d = int(encoded_proj.shape[-1])
-    dec = _fl.fc(input=decoder_state, size=d, bias_attr=False,
-                 param_attr=_pa(transform_param_attr))
-    combined = _fl.tanh(_fl.elementwise_add(
-        encoded_proj, _fl.unsqueeze(dec, axes=[1])))
-    scores = _fl.fc(input=combined, size=1, num_flatten_dims=2,
-                    bias_attr=False, param_attr=_pa(softmax_param_attr))
-    weights = _fl.sequence_softmax(_fl.squeeze(scores, axes=[2]),
-                                   length=_len_of(encoded_sequence))
-    ctx = _fl.matmul(_fl.unsqueeze(weights, axes=[1]), encoded_sequence)
-    return _fl.squeeze(ctx, axes=[1])
+    (reference networks.py simple_attention). The math lives in
+    models/rnn_search.py:additive_attention (one home); the *_param_attr
+    initializer hints are accepted for config compatibility but the
+    shared helper uses default initializers."""
+    from ..models.rnn_search import additive_attention
+    return additive_attention(encoded_sequence, encoded_proj,
+                              decoder_state,
+                              int(encoded_proj.shape[-1]),
+                              length=_len_of(encoded_sequence))
 
 
 def dot_product_attention(attended_sequence, attending_sequence,

@@ -239,3 +239,16 @@ def test_googlenet_tiny():
     xs = rng.rand(4, 3, 64, 64).astype('float32')
     ys = rng.randint(0, 10, (4, 1)).astype('int64')
     _train(loss, lambda i: {'img': xs, 'label': ys}, steps=6)
+
+
+def test_rnn_search_attention_seq2seq():
+    """machine_translation chapter: bi-GRU encoder + additive-attention
+    DynamicRNN decoder trains on a synthetic copy task; the whole
+    seq2seq (attention inside the decoder scan) is one XLA program."""
+    from paddle_tpu.models.rnn_search import make_fake_batch, rnn_search
+    loss, _feeds = rnn_search(src_vocab=50, trg_vocab=50, emb_dim=16,
+                              hidden_dim=16)
+    feed = make_fake_batch(8, 6, 5, 50, 50)
+    losses = _train(loss, lambda i: feed, steps=40,
+                    opt=fluid.optimizer.Adam(learning_rate=5e-3))
+    assert losses[-1] < losses[0] * 0.6, losses
