@@ -393,6 +393,15 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(batch=1, seq=1024, vocab=4096, iters=3) if reduced \
             else dict(batch=4, seq=1024, iters=10)
         val = bench_transformer(dropout=0.0, **kw)
+    elif workload == 'transformer_seq4096':
+        # longest-context config (batch 1 holds tokens/step at 4096);
+        # dropout 0 keeps the Pallas gate open, same as seq1024.
+        # reduced keeps seq=4096 (the label IS the sequence length —
+        # shrinking it would invert the long-context comparison) and
+        # cuts vocab/iters instead.
+        kw = dict(batch=1, seq=4096, vocab=4096, iters=2) if reduced \
+            else dict(batch=1, seq=4096, iters=8)
+        val = bench_transformer(dropout=0.0, **kw)
     elif workload.startswith('moe_cap'):
         cap = float(workload[len('moe_cap'):])
         kw = dict(batch=4, seq=16, vocab=512, num_experts=4, n_layer=2,
@@ -539,6 +548,27 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_seq1024'] = \
                     round(tok_1k, 1)
+        if backend not in ('cpu',) and not over_budget(
+                extra=timeout + 200.0):
+            # seq-4096 e2e pair: the long-context claim measured, both
+            # attention paths (VERDICT r3 #8's other data point)
+            tok_4k, err = _run_workload(
+                'transformer_seq4096', backend, reduced, timeout + 100)
+            if err:
+                errors['transformer_seq4096'] = err
+            else:
+                ablations['transformer_tok_per_sec_seq4096'] = \
+                    round(tok_4k, 1)
+                tok_4kp, err = _run_workload(
+                    'transformer_seq4096', backend, reduced,
+                    timeout + 100, env={'PADDLE_TPU_USE_PALLAS': '1'})
+                if err:
+                    errors['transformer_seq4096_pallas'] = err
+                else:
+                    ablations['transformer_tok_per_sec_seq4096_pallas'] \
+                        = round(tok_4kp, 1)
+                    ablations['seq4096_attention_winner'] = \
+                        'pallas' if tok_4kp > tok_4k * 1.02 else 'xla'
         if backend not in ('cpu',) and not over_budget():
             # isolated fwd+bwd attention, XLA vs Pallas, seq 1024/4096
             # d_head 64 (its own watchdog: relay Pallas compiles hang)
@@ -777,7 +807,8 @@ if __name__ == '__main__':
         p = argparse.ArgumentParser()
         p.add_argument('--workload',
                        choices=['transformer', 'transformer_seq256',
-                                'transformer_seq1024', 'resnet50',
+                                'transformer_seq1024',
+                                'transformer_seq4096', 'resnet50',
                                 'resnet50_anatomy', 'attention_microbench',
                                 'pallas_parity', 'moe_cap1.0',
                                 'moe_cap1.25', 'moe_cap2.0'])

@@ -35,16 +35,20 @@ def encoder(src_word, src_len, src_vocab, emb_dim=64, hidden_dim=64):
 
 
 def additive_attention(encoded, encoded_proj, state, hidden_dim,
-                       length=None):
+                       length=None, transform_param_attr=None,
+                       score_param_attr=None):
     """Bahdanau additive attention over a padded sequence, built from
     fluid layers — safe inside a DynamicRNN step block. This is the ONE
     home of the attention math; the v1 shim's simple_attention
-    (trainer_config_helpers/networks.py) delegates here."""
-    dec = layers.fc(input=state, size=hidden_dim, bias_attr=False)
+    (trainer_config_helpers/networks.py) delegates here. The param
+    attrs carry ParamAttr names for weight sharing across graphs."""
+    dec = layers.fc(input=state, size=hidden_dim, bias_attr=False,
+                    param_attr=transform_param_attr)
     combined = layers.tanh(layers.elementwise_add(
         encoded_proj, layers.unsqueeze(dec, axes=[1])))
     scores = layers.fc(input=combined, size=1, num_flatten_dims=2,
-                       bias_attr=False)                    # [B, Ts, 1]
+                       bias_attr=False,
+                       param_attr=score_param_attr)        # [B, Ts, 1]
     weights = layers.sequence_softmax(
         layers.squeeze(scores, axes=[2]), length=length)   # [B, Ts]
     ctx = layers.matmul(layers.unsqueeze(weights, axes=[1]), encoded)

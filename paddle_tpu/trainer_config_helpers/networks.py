@@ -207,14 +207,16 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
                      name=None):
     """Bahdanau-style additive attention over a padded sequence
     (reference networks.py simple_attention). The math lives in
-    models/rnn_search.py:additive_attention (one home); the *_param_attr
-    initializer hints are accepted for config compatibility but the
-    shared helper uses default initializers."""
+    models/rnn_search.py:additive_attention (one home); the param attrs
+    are forwarded so name-based weight sharing keeps working."""
     from ..models.rnn_search import additive_attention
     return additive_attention(encoded_sequence, encoded_proj,
                               decoder_state,
                               int(encoded_proj.shape[-1]),
-                              length=_len_of(encoded_sequence))
+                              length=_len_of(encoded_sequence),
+                              transform_param_attr=_pa(
+                                  transform_param_attr),
+                              score_param_attr=_pa(softmax_param_attr))
 
 
 def dot_product_attention(attended_sequence, attending_sequence,

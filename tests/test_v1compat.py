@@ -249,3 +249,31 @@ def test_unshimmed_name_names_fluid_equivalent():
         v1l.recurrent_group
     with pytest.raises(AttributeError):
         v1l.definitely_not_a_layer
+
+
+def test_simple_attention_shapes_and_sharing():
+    """The shim delegates to models/rnn_search.additive_attention;
+    param_attr NAMES must survive the delegation (weight sharing)."""
+    from paddle_tpu.trainer_config_helpers.networks import simple_attention
+    enc = data_layer(name='enc', size=8, seq_type=1)
+    dec_state = data_layer(name='st', size=6)
+    proj = fc_layer(input=enc, size=6, bias_attr=False)
+    ctx1 = simple_attention(
+        enc, proj, dec_state,
+        transform_param_attr=ParameterAttribute(name='attn_transform.w'),
+        softmax_param_attr=ParameterAttribute(name='attn_score.w'))
+    ctx2 = simple_attention(
+        enc, proj, dec_state,
+        transform_param_attr=ParameterAttribute(name='attn_transform.w'),
+        softmax_param_attr=ParameterAttribute(name='attn_score.w'))
+    names = [p.name for p in
+             fluid.default_main_program().all_parameters()]
+    assert names.count('attn_transform.w') == 1  # shared, not duplicated
+    assert names.count('attn_score.w') == 1
+    xs = np.random.RandomState(0).randn(3, 5, 8).astype('float32')
+    st = np.random.RandomState(1).randn(3, 6).astype('float32')
+    _, (o1, o2) = _run([ctx1, ctx2],
+                       {'enc': xs, 'enc_len': np.array([5, 3, 4], 'int32'),
+                        'st': st})
+    assert np.asarray(o1).shape == (3, 8)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-5)

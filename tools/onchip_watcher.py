@@ -64,6 +64,12 @@ QUEUE = [
     ('transformer_fused_ce',
      [sys.executable, 'bench.py', '--workload', 'transformer',
       '--backend', 'tpu'], 600),
+    ('transformer_seq4096',
+     [sys.executable, 'bench.py', '--workload', 'transformer_seq4096',
+      '--backend', 'tpu'], 700),
+    ('transformer_seq4096_pallas',
+     [sys.executable, 'bench.py', '--workload', 'transformer_seq4096',
+      '--backend', 'tpu'], 700, {'PADDLE_TPU_USE_PALLAS': '1'}),
 ]
 
 
