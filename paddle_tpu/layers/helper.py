@@ -85,8 +85,16 @@ class LayerHelper(object):
         kwargs.pop('name', None)
         param = block.create_parameter(
             name, shape=[int(s) for s in shape], dtype=dtype, **kwargs)
-        # Register the init op in the startup program.
-        attr.initializer(param)
+        # Register the init op in the startup program — unless one
+        # already exists for this name: a parameter shared by name
+        # across graphs (e.g. a train + infer program pair) must keep
+        # its FIRST init, not stack a second randomly-drawn one that
+        # wins by running later.
+        from ..core.program import default_startup_program
+        sblock = default_startup_program().global_block()
+        inited = any(name in op.output_names() for op in sblock.ops)
+        if not inited:
+            attr.initializer(param)
         self.main_program._startup_ref = self.startup_program
         return param
 

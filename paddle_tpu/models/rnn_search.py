@@ -8,29 +8,46 @@ whose step block — additive attention over the full encoder output,
 gru_unit state update, vocab projection — compiles into ONE lax.scan
 body; the encoder states enter the scan as closed-over constants
 (ops/control_ops.py _scan_rnn outer_env), so the whole seq2seq trains
-as a single XLA computation like every other model here.
+as a single XLA computation like every other model here. Greedy
+generation is a single rnn_search_greedy_decode op (lax.scan with
+argmax feedback) sharing the training parameters by name.
 """
 
 import numpy as np
 
 from .. import layers
+from ..param_attr import ParamAttr
+
+
+def _p(name):
+    return ParamAttr(name=name)
 
 
 def encoder(src_word, src_len, src_vocab, emb_dim=64, hidden_dim=64):
     """Bi-GRU over the padded source: returns [B, Ts, 2H] states plus
-    the backward direction's summary (decoder boot, per the chapter)."""
-    emb = layers.embedding(input=src_word, size=[src_vocab, emb_dim])
+    the backward direction's summary (decoder boot, per the chapter).
+    All parameters are named so the infer graph shares them."""
+    emb = layers.embedding(input=src_word, size=[src_vocab, emb_dim],
+                           param_attr=_p('rnnsearch_src_emb'))
     fwd = layers.dynamic_gru(
         input=layers.fc(input=emb, size=hidden_dim * 3, bias_attr=False,
-                        num_flatten_dims=2),
-        size=hidden_dim, length=src_len)
+                        num_flatten_dims=2,
+                        param_attr=_p('rnnsearch_enc_fwd.w')),
+        size=hidden_dim, length=src_len,
+        param_attr=_p('rnnsearch_enc_fwd_gru.w'),
+        bias_attr=_p('rnnsearch_enc_fwd_gru.b'))
     bwd = layers.dynamic_gru(
         input=layers.fc(input=emb, size=hidden_dim * 3, bias_attr=False,
-                        num_flatten_dims=2),
-        size=hidden_dim, is_reverse=True, length=src_len)
+                        num_flatten_dims=2,
+                        param_attr=_p('rnnsearch_enc_bwd.w')),
+        size=hidden_dim, is_reverse=True, length=src_len,
+        param_attr=_p('rnnsearch_enc_bwd_gru.w'),
+        bias_attr=_p('rnnsearch_enc_bwd_gru.b'))
     encoded = layers.concat([fwd, bwd], axis=-1)          # [B, Ts, 2H]
     boot = layers.fc(input=layers.sequence_first_step(bwd, length=src_len),
-                     size=hidden_dim, act='tanh')          # [B, H]
+                     size=hidden_dim, act='tanh',
+                     param_attr=_p('rnnsearch_boot.w'),
+                     bias_attr=_p('rnnsearch_boot.b'))     # [B, H]
     return encoded, boot
 
 
@@ -55,14 +72,19 @@ def additive_attention(encoded, encoded_proj, state, hidden_dim,
     return layers.squeeze(ctx, axes=[1])                   # [B, ...]
 
 
+def _build_inputs():
+    src_word = layers.data(name='src_word', shape=[-1], dtype='int64',
+                           lod_level=1)
+    src_len = layers.data(name='src_len', shape=[], dtype='int32')
+    return src_word, src_len
+
+
 def rnn_search(src_vocab=1000, trg_vocab=1000, emb_dim=64, hidden_dim=64):
     """Training graph: teacher-forced attention decoder. Returns
     (avg_cost, feed names). Feeds: src_word [B,Ts] int64, src_len [B]
     int32, trg_word [B,Tt] int64 (decoder input, <s>-shifted), lbl_word
     [B,Tt] int64, lbl_mask [B,Tt] float32 (1 on real target steps)."""
-    src_word = layers.data(name='src_word', shape=[-1], dtype='int64',
-                           lod_level=1)
-    src_len = layers.data(name='src_len', shape=[], dtype='int32')
+    src_word, src_len = _build_inputs()
     trg_word = layers.data(name='trg_word', shape=[-1], dtype='int64',
                            lod_level=1)
     lbl_word = layers.data(name='lbl_word', shape=[-1], dtype='int64',
@@ -74,23 +96,32 @@ def rnn_search(src_vocab=1000, trg_vocab=1000, emb_dim=64, hidden_dim=64):
                             hidden_dim)
     # shared attention key projection, computed once outside the scan
     encoded_proj = layers.fc(input=encoded, size=hidden_dim,
-                             bias_attr=False, num_flatten_dims=2)
+                             bias_attr=False, num_flatten_dims=2,
+                             param_attr=_p('rnnsearch_encproj.w'))
     trg_emb = layers.embedding(input=trg_word,
-                               size=[trg_vocab, emb_dim])
+                               size=[trg_vocab, emb_dim],
+                               param_attr=_p('rnnsearch_trg_emb'))
 
     drnn = layers.DynamicRNN()
     with drnn.block():
         emb_t = drnn.step_input(trg_emb)                   # [B, E]
         state = drnn.memory(init=boot)                     # [B, H]
-        context = additive_attention(encoded, encoded_proj, state,
-                                     hidden_dim, length=src_len)
+        context = additive_attention(
+            encoded, encoded_proj, state, hidden_dim, length=src_len,
+            transform_param_attr=_p('rnnsearch_att_trans.w'),
+            score_param_attr=_p('rnnsearch_att_score.w'))
         step_in = layers.fc(
             input=layers.concat([emb_t, context], axis=-1),
-            size=hidden_dim * 3, bias_attr=False)
-        new_state, _, _ = layers.gru_unit(step_in, state,
-                                          size=hidden_dim * 3)
+            size=hidden_dim * 3, bias_attr=False,
+            param_attr=_p('rnnsearch_step.w'))
+        new_state, _, _ = layers.gru_unit(
+            step_in, state, size=hidden_dim * 3,
+            param_attr=_p('rnnsearch_gru.w'),
+            bias_attr=_p('rnnsearch_gru.b'))
         drnn.update_memory(state, new_state)
-        logits = layers.fc(input=new_state, size=trg_vocab)
+        logits = layers.fc(input=new_state, size=trg_vocab,
+                           param_attr=_p('rnnsearch_out.w'),
+                           bias_attr=_p('rnnsearch_out.b'))
         drnn.output(logits)
     logits = drnn()                                        # [B, Tt, V]
 
@@ -103,6 +134,49 @@ def rnn_search(src_vocab=1000, trg_vocab=1000, emb_dim=64, hidden_dim=64):
         layers.reduce_sum(lbl_mask))
     return avg_cost, ['src_word', 'src_len', 'trg_word', 'lbl_word',
                       'lbl_mask']
+
+
+def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
+                            hidden_dim=64, max_out_len=16, bos_id=1,
+                            eos_id=0):
+    """Inference graph: encoder (training parameters, shared by name) +
+    ONE rnn_search_greedy_decode op — a lax.scan with argmax feedback.
+    Build under a program_guard on a fresh program; run with feeds
+    src_word/src_len, fetch the returned [B, max_out_len] ids."""
+    from ..layers.helper import LayerHelper
+    src_word, src_len = _build_inputs()
+    encoded, boot = encoder(src_word, src_len, src_vocab, emb_dim,
+                            hidden_dim)
+    encoded_proj = layers.fc(input=encoded, size=hidden_dim,
+                             bias_attr=False, num_flatten_dims=2,
+                             param_attr=_p('rnnsearch_encproj.w'))
+    helper = LayerHelper('rnn_search_greedy_decode')
+
+    def param(name, shape):
+        return layers.create_parameter(shape=shape, dtype='float32',
+                                       attr=_p(name))
+
+    inputs = {
+        'EncOut': [encoded], 'EncProj': [encoded_proj], 'Boot': [boot],
+        'SrcLen': [src_len],
+        'TrgEmb': [param('rnnsearch_trg_emb', [trg_vocab, emb_dim])],
+        'AttW': [param('rnnsearch_att_trans.w', [hidden_dim, hidden_dim])],
+        'ScoreW': [param('rnnsearch_att_score.w', [hidden_dim, 1])],
+        'StepW': [param('rnnsearch_step.w',
+                        [emb_dim + 2 * hidden_dim, 3 * hidden_dim])],
+        'GruW': [param('rnnsearch_gru.w', [hidden_dim, 3 * hidden_dim])],
+        'GruB': [param('rnnsearch_gru.b', [1, 3 * hidden_dim])],
+        'OutW': [param('rnnsearch_out.w', [hidden_dim, trg_vocab])],
+        'OutB': [param('rnnsearch_out.b', [trg_vocab])],
+    }
+    out = helper.create_variable_for_type_inference('int64')
+    if encoded.shape is not None:
+        out.shape = (encoded.shape[0], max_out_len)
+    helper.append_op(type='rnn_search_greedy_decode', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'max_out_len': max_out_len, 'bos_id': bos_id,
+                            'eos_id': eos_id})
+    return out, ['src_word', 'src_len']
 
 
 def make_fake_batch(batch, src_seq, trg_seq, src_vocab, trg_vocab,

@@ -264,6 +264,64 @@ def _simple_rnn(ctx):
     ctx.set_output('Hidden', hidden)
 
 
+@register('rnn_search_greedy_decode')
+def _rnn_search_greedy_decode(ctx):
+    """Greedy generation for the RNN-search seq2seq
+    (models/rnn_search.py): ONE lax.scan over output positions with
+    argmax feedback, instead of the reference's While-based infer
+    program re-running the decoder per emitted token. Each step is the
+    exact math of the training step block — additive attention over the
+    encoder states, the gru_unit recurrence, the vocab projection."""
+    enc = ctx.input('EncOut')          # [B, Ts, 2H]
+    proj = ctx.input('EncProj')        # [B, Ts, H]
+    state0 = ctx.input('Boot')         # [B, H]
+    src_len = ctx.input('SrcLen') if ctx.has_input('SrcLen') else None
+    emb = ctx.input('TrgEmb')          # [V, E]
+    att_w = ctx.input('AttW')          # [H, H]
+    score_w = ctx.input('ScoreW')      # [H, 1]
+    step_w = ctx.input('StepW')        # [E+2H, 3H]
+    gru_w = ctx.input('GruW')          # [H, 3H]
+    gru_b = ctx.input('GruB')          # [1, 3H]
+    out_w = ctx.input('OutW')          # [H, V]
+    out_b = ctx.input('OutB')          # [V]
+    t_max = ctx.attr('max_out_len')
+    bos_id = ctx.attr('bos_id', 1)
+    eos_id = ctx.attr('eos_id', 0)
+    b, ts = enc.shape[0], enc.shape[1]
+    h = state0.shape[-1]
+    kmask = None
+    if src_len is not None:
+        kmask = jnp.arange(ts)[None, :] < src_len.reshape(-1, 1)
+
+    def step(carry, _):
+        ids, state = carry
+        # additive attention (mirrors additive_attention + the
+        # sequence_softmax length mask)
+        dec = state @ att_w                              # [B, H]
+        combined = jnp.tanh(proj + dec[:, None, :])
+        scores = (combined @ score_w)[..., 0]            # [B, Ts]
+        if kmask is not None:
+            scores = jnp.where(kmask, scores, -1e9)
+        weights = jax.nn.softmax(scores, axis=-1)
+        context = jnp.einsum('bs,bsd->bd', weights, enc)
+        # step projection + the shared gru_unit recurrence
+        xt = jnp.concatenate([jnp.take(emb, ids, axis=0), context],
+                             axis=-1) @ step_w
+        new_state, _, _, _ = gru_step(xt, state, gru_w, gru_b)
+        logits = new_state @ out_w + out_b
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, new_state), nxt
+
+    ids0 = jnp.full((b,), bos_id, jnp.int32)
+    _, steps = jax.lax.scan(step, (ids0, state0), None, length=t_max)
+    ids = steps.T                                        # [B, t_max]
+    # freeze everything after the first EOS to EOS
+    is_eos = (ids == eos_id).astype(jnp.int32)
+    before = jnp.cumsum(is_eos, axis=1) - is_eos
+    ids = jnp.where(before > 0, eos_id, ids)
+    ctx.set_output('Out', ids.astype(ctx.out_dtype('Out', 'int64')))
+
+
 @register('lstm_unit')
 def _lstm_unit(ctx):
     """Single LSTM step (lstm_unit_op.cc): inputs are pre-projected gates."""
@@ -278,6 +336,21 @@ def _lstm_unit(ctx):
     ctx.set_output('H', h)
 
 
+def gru_step(xt, h_prev, w, bias, gate_act=jax.nn.sigmoid,
+             cand_act=jnp.tanh):
+    """One GRU step on a pre-projected input xt [B, 3D] — the single
+    home of the gate math, shared by the gru_unit op and the
+    rnn_search greedy decode so training and inference cannot drift.
+    Returns (h, u, r, c)."""
+    d = h_prev.shape[-1]
+    if bias is not None:
+        xt = xt + bias.reshape(1, -1)
+    ur = gate_act(xt[:, :2 * d] + h_prev @ w[:, :2 * d])
+    u, r = ur[:, :d], ur[:, d:]
+    c = cand_act(xt[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
+    return u * h_prev + (1 - u) * c, u, r, c
+
+
 @register('gru_unit')
 def _gru_unit(ctx):
     """Single GRU step (gru_unit_op.cc)."""
@@ -285,16 +358,10 @@ def _gru_unit(ctx):
     h_prev = ctx.input('HiddenPrev')
     w = ctx.input('Weight')         # [D, 3D]
     bias = ctx.input('Bias') if ctx.has_input('Bias') else None
-    d = h_prev.shape[-1]
-    if bias is not None:
-        x = x + bias.reshape(1, -1)
-    gate_act = _ACTS[ctx.attr('gate_activation', 'sigmoid')]
-    cand_act = _ACTS[ctx.attr('activation', 'tanh')]
-    x_ur, x_c = x[:, :2 * d], x[:, 2 * d:]
-    ur = gate_act(x_ur + h_prev @ w[:, :2 * d])
-    u, r = ur[:, :d], ur[:, d:]
-    c = cand_act(x_c + (r * h_prev) @ w[:, 2 * d:])
-    h = u * h_prev + (1 - u) * c
+    h, u, r, c = gru_step(
+        x, h_prev, w, bias,
+        gate_act=_ACTS[ctx.attr('gate_activation', 'sigmoid')],
+        cand_act=_ACTS[ctx.attr('activation', 'tanh')])
     ctx.set_output('Gate', jnp.concatenate([u, r, c], axis=-1))
     ctx.set_output('ResetHiddenPrev', r * h_prev)
     ctx.set_output('Hidden', h)

@@ -348,3 +348,26 @@ def test_label_smoothed_ce_fused_gradient_parity():
     gf = jax.grad(fused)(xb).astype(jnp.float32)
     gn = jax.grad(naive)(x)
     assert np.max(np.abs(gf - gn)) < 0.02
+
+
+def test_shared_param_keeps_first_init():
+    """A parameter shared by NAME across two graphs (train + infer)
+    must register exactly one startup init op — a second create would
+    otherwise stack a later-running random init over the first (bias
+    zeros clobbered by Xavier; regression from the rnn_search infer
+    graph)."""
+    x = fluid.layers.data(name='xs', shape=[4], dtype='float32')
+    fluid.layers.fc(input=x, size=3,
+                    param_attr=fluid.ParamAttr(name='shared.w'),
+                    bias_attr=fluid.ParamAttr(name='shared.b'))
+    fluid.layers.fc(input=x, size=3,
+                    param_attr=fluid.ParamAttr(name='shared.w'),
+                    bias_attr=fluid.ParamAttr(name='shared.b'))
+    outs = [n for op in
+            fluid.default_startup_program().global_block().ops
+            for n in op.output_names()]
+    assert outs.count('shared.w') == 1
+    assert outs.count('shared.b') == 1
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    assert np.all(fluid.global_scope().numpy('shared.b') == 0.0)

@@ -252,3 +252,29 @@ def test_rnn_search_attention_seq2seq():
     losses = _train(loss, lambda i: feed, steps=40,
                     opt=fluid.optimizer.Adam(learning_rate=5e-3))
     assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_rnn_search_greedy_decode_reproduces_training():
+    """rnn_search_greedy_decode (one lax.scan with argmax feedback,
+    training params shared by name) reproduces the trained copy task."""
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.models.rnn_search import (make_fake_batch, rnn_search,
+                                              rnn_search_greedy_infer)
+    cost, _ = rnn_search(src_vocab=30, trg_vocab=30, emb_dim=16,
+                         hidden_dim=16)
+    fluid.optimizer.Adam(learning_rate=8e-3).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = make_fake_batch(8, 5, 5, 30, 30)
+    for _ in range(200):
+        exe.run(feed=feed, fetch_list=[cost])
+    infer_prog = Program()
+    with program_guard(infer_prog, fluid.default_startup_program()):
+        ids, _feeds = rnn_search_greedy_infer(
+            src_vocab=30, trg_vocab=30, emb_dim=16, hidden_dim=16,
+            max_out_len=5)
+    got = np.asarray(exe.run(program=infer_prog,
+                             feed={'src_word': feed['src_word'],
+                                   'src_len': feed['src_len']},
+                             fetch_list=[ids])[0])
+    assert (got == feed['lbl_word']).mean() > 0.8
