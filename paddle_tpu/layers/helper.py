@@ -11,6 +11,12 @@ from ..initializer import Constant, Xavier
 from ..param_attr import ParamAttr, WeightNormParamAttr
 
 
+def _startup_has(name):
+    """True iff the default startup program already initializes `name`
+    (every initializer create_var()s its target there first)."""
+    return name in default_startup_program().global_block().vars
+
+
 class LayerHelper(object):
     def __init__(self, layer_type, **kwargs):
         self.kwargs = kwargs
@@ -89,11 +95,10 @@ class LayerHelper(object):
         # already exists for this name: a parameter shared by name
         # across graphs (e.g. a train + infer program pair) must keep
         # its FIRST init, not stack a second randomly-drawn one that
-        # wins by running later.
-        from ..core.program import default_startup_program
-        sblock = default_startup_program().global_block()
-        inited = any(name in op.output_names() for op in sblock.ops)
-        if not inited:
+        # wins by running later. Every initializer create_var()s its
+        # target in the startup block first, so membership there is an
+        # O(1) already-initialized check.
+        if not _startup_has(name):
             attr.initializer(param)
         self.main_program._startup_ref = self.startup_program
         return param
@@ -119,7 +124,8 @@ class LayerHelper(object):
         v_kwargs.pop('name', None)
         v = block.create_parameter(name + '.wn_v', shape=shape,
                                    dtype=dtype, **v_kwargs)
-        attr.initializer(v)
+        if not _startup_has(v.name):  # first init wins (shared-by-name)
+            attr.initializer(v)
         g_shape = [1] if dim is None else [shape[dim]]
         # g inherits every training-relevant attr field (clip included);
         # only the initializer differs (the startup norm op below)
@@ -129,11 +135,12 @@ class LayerHelper(object):
                                    dtype=dtype, **g_kwargs)
         # startup: g <- ||v|| (runs after v's init op, same program)
         sb = self.startup_program.global_block()
-        sb.create_var(name=g.name, shape=tuple(g_shape), dtype=dtype,
-                      persistable=True)
-        sb.append_op(type='weight_norm_g_init', inputs={'V': [v]},
-                     outputs={'G': [g]},
-                     attrs={'dim': -1 if dim is None else int(dim)})
+        if g.name not in sb.vars:  # first init wins (shared-by-name)
+            sb.create_var(name=g.name, shape=tuple(g_shape), dtype=dtype,
+                          persistable=True)
+            sb.append_op(type='weight_norm_g_init', inputs={'V': [v]},
+                         outputs={'G': [g]},
+                         attrs={'dim': -1 if dim is None else int(dim)})
         self.main_program._startup_ref = self.startup_program
         w = self.block.create_var(name=name, dtype=dtype)
         w.shape = tuple(shape)

@@ -152,9 +152,12 @@ def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
                              param_attr=_p('rnnsearch_encproj.w'))
     helper = LayerHelper('rnn_search_greedy_decode')
 
-    def param(name, shape):
+    def param(name, shape, is_bias=False):
+        # is_bias matters even for shared params: if the infer graph is
+        # built FIRST, its default initializer (Constant 0 for biases)
+        # is the one that sticks under first-init-wins
         return layers.create_parameter(shape=shape, dtype='float32',
-                                       attr=_p(name))
+                                       attr=_p(name), is_bias=is_bias)
 
     inputs = {
         'EncOut': [encoded], 'EncProj': [encoded_proj], 'Boot': [boot],
@@ -165,9 +168,10 @@ def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
         'StepW': [param('rnnsearch_step.w',
                         [emb_dim + 2 * hidden_dim, 3 * hidden_dim])],
         'GruW': [param('rnnsearch_gru.w', [hidden_dim, 3 * hidden_dim])],
-        'GruB': [param('rnnsearch_gru.b', [1, 3 * hidden_dim])],
+        'GruB': [param('rnnsearch_gru.b', [1, 3 * hidden_dim],
+                       is_bias=True)],
         'OutW': [param('rnnsearch_out.w', [hidden_dim, trg_vocab])],
-        'OutB': [param('rnnsearch_out.b', [trg_vocab])],
+        'OutB': [param('rnnsearch_out.b', [trg_vocab], is_bias=True)],
     }
     out = helper.create_variable_for_type_inference('int64')
     if encoded.shape is not None:

@@ -81,8 +81,6 @@ def gru_scan(x_proj, w_h, bias, h0, length=None, gate_act=jax.nn.sigmoid,
     """
     b, t, d3 = x_proj.shape
     d = d3 // 3
-    w_ur = w_h[:, :2 * d]
-    w_c = w_h[:, 2 * d:]
     mask = _mask_from_length(length, b, t, x_proj.dtype)
     if is_reverse:
         x_proj = jnp.flip(x_proj, axis=1)
@@ -97,13 +95,8 @@ def gru_scan(x_proj, w_h, bias, h0, length=None, gate_act=jax.nn.sigmoid,
             m = None
         else:
             xt, m = inp
-        if bias is not None:
-            xt = xt + bias.reshape(1, -1)
-        x_ur, x_c = xt[:, :2 * d], xt[:, 2 * d:]
-        ur = gate_act(x_ur + h_prev @ w_ur)
-        u, r = ur[:, :d], ur[:, d:]
-        c = cand_act(x_c + (r * h_prev) @ w_c)
-        h = u * h_prev + (1 - u) * c
+        h, _, _, _ = gru_step(xt, h_prev, w_h, bias,
+                              gate_act=gate_act, cand_act=cand_act)
         if m is not None:
             h = m * h + (1 - m) * h_prev
         return h, h
@@ -339,8 +332,9 @@ def _lstm_unit(ctx):
 def gru_step(xt, h_prev, w, bias, gate_act=jax.nn.sigmoid,
              cand_act=jnp.tanh):
     """One GRU step on a pre-projected input xt [B, 3D] — the single
-    home of the gate math, shared by the gru_unit op and the
-    rnn_search greedy decode so training and inference cannot drift.
+    home of the gate math, shared by gru_scan (dynamic_gru), the
+    gru_unit op, and the rnn_search greedy decode so no two GRU
+    consumers can drift.
     Returns (h, u, r, c)."""
     d = h_prev.shape[-1]
     if bias is not None:

@@ -1,0 +1,216 @@
+"""v1 shim layer sweep: numeric/shape checks for every shimmed
+layer family not covered by test_v1compat.py (costs, image ops, misc
+projections/arithmetic, evaluators)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.trainer_config_helpers as v1
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in exe.run(feed=feed, fetch_list=fetches)]
+
+
+def test_v1_rank_cost():
+    a = v1.data_layer(name='a', size=1); b = v1.data_layer(name='b', size=1)
+    l = v1.data_layer(name='l', size=1)
+    cost = v1.rank_cost(a, b, l)
+    out, = _run([cost], {'a': np.array([[0.3]],'f'), 'b': np.array([[0.6]],'f'), 'l': np.array([[1.0]],'f')})
+    assert out.shape == () or out.size == 1
+
+
+def test_v1_huber_regression_cost():
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=3)
+    cost = v1.huber_regression_cost(a, b)
+    _run([cost], {'a': np.ones((2,3),'f'), 'b': np.zeros((2,3),'f')})
+
+
+def test_v1_huber_classification_cost():
+    a = v1.data_layer(name='a', size=1)
+    lbl = v1.data_layer(name='l', size=1, dtype='int64')
+    cost = v1.huber_classification_cost(a, lbl)
+    _run([cost], {'a': np.array([[0.3],[-0.7]],'f'), 'l': np.array([[1],[0]],'i8')})
+
+
+def test_v1_multi_binary_label_cross_entropy():
+    p = v1.data_layer(name='p', size=4)
+    lbl = v1.data_layer(name='l', size=4)
+    cost = v1.multi_binary_label_cross_entropy(p, lbl)
+    out, = _run([cost], {'p': np.full((2,4),0.5,'f'), 'l': np.array([[1,0,1,0],[0,1,0,1]],'f')})
+
+
+def test_v1_smooth_l1_cost():
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=3)
+    _run([v1.smooth_l1_cost(a, b)], {'a': np.ones((2,3),'f'), 'b': np.zeros((2,3),'f')})
+
+
+def test_v1_sum_cost():
+    a = v1.data_layer(name='a', size=3)
+    _run([v1.sum_cost(a)], {'a': np.ones((2,3),'f')})
+
+
+def test_v1_batch_norm_layer():
+    img = v1.data_layer(name='im', size=3*8*8)
+    out = v1.batch_norm_layer(v1.img_conv_layer(img, 3, 4, num_channels=3, padding=1), act=v1.ReluActivation())
+    _run([out], {'im': np.random.rand(2,192).astype('f')})
+
+
+def test_v1_img_cmrnorm_layer():
+    img = v1.data_layer(name='im', size=4*8*8)
+    out = v1.img_cmrnorm_layer(img, size=5, num_channels=4)
+    _run([out], {'im': np.random.rand(2,256).astype('f')})
+
+
+def test_v1_maxout_layer():
+    img = v1.data_layer(name='im', size=4*4*4)
+    out = v1.maxout_layer(img, groups=2, num_channels=4)
+    _run([out], {'im': np.random.rand(2,64).astype('f')})
+
+
+def test_v1_spp_layer():
+    img = v1.data_layer(name='im', size=3*8*8)
+    out = v1.spp_layer(img, num_channels=3, pyramid_height=2)
+    _run([out], {'im': np.random.rand(2,192).astype('f')})
+
+
+def test_v1_pad_layer():
+    img = v1.data_layer(name='im', size=3*4*4)
+    x = v1.img_conv_layer(img, 3, 3, num_channels=3, padding=1)
+    out = v1.pad_layer(x, pad_c=[1,1], pad_h=[0,0], pad_w=[0,0])
+    _run([out], {'im': np.random.rand(2,48).astype('f')})
+
+
+def test_v1_bilinear_interp_layer():
+    img = v1.data_layer(name='im', size=3*4*4)
+    x = v1.img_conv_layer(img, 3, 3, num_channels=3, padding=1)
+    out = v1.bilinear_interp_layer(x, out_size_x=8, out_size_y=8)
+    _run([out], {'im': np.random.rand(2,48).astype('f')})
+
+
+def test_v1_tensor_layer():
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=4)
+    out = v1.tensor_layer(a, b, size=5)
+    o, = _run([out], {'a': np.ones((2,3),'f'), 'b': np.ones((2,4),'f')})
+    assert o.shape == (2,5), o.shape
+
+
+def test_v1_multiplex_layer():
+    idx = v1.data_layer(name='i', size=1, dtype='int64')
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=3)
+    out = v1.multiplex_layer([idx, a, b])
+    o, = _run([out], {'i': np.array([[0],[1]],'i8'), 'a': np.zeros((2,3),'f'), 'b': np.ones((2,3),'f')})
+    assert np.allclose(o[0], 0) and np.allclose(o[1], 1), o
+
+
+def test_v1_sampling_id_layer():
+    p = v1.data_layer(name='p', size=4)
+    out = v1.sampling_id_layer(p)
+    o, = _run([out], {'p': np.array([[0,0,1,0],[1,0,0,0]],'f')})
+    assert o[0] == 2 and o[1] == 0, o
+
+
+def test_v1_out_prod_layer():
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=4)
+    o, = _run([v1.out_prod_layer(a, b)], {'a': np.ones((2,3),'f'), 'b': np.ones((2,4),'f')})
+    assert o.shape == (2,3,4), o.shape
+
+
+def test_v1_linear_comb_layer():
+    w = v1.data_layer(name='w', size=2); vv = v1.data_layer(name='v', size=6)
+    o, = _run([v1.linear_comb_layer(w, vv, size=3)],
+             {'w': np.array([[1,2]],'f'), 'v': np.arange(6,dtype='f').reshape(1,6)})
+    assert o.shape == (1,3)
+    np.testing.assert_allclose(o[0], 1*np.arange(3) + 2*np.arange(3,6))
+
+
+def test_v1_rotate_layer():
+    img = v1.data_layer(name='im', size=1*2*3)
+    o, = _run([v1.rotate_layer(img, height=2, width=3)],
+             {'im': np.arange(6,dtype='f').reshape(1,6)})
+    ref = np.rot90(np.arange(6,dtype='f').reshape(2,3)).reshape(-1)
+    np.testing.assert_allclose(o.reshape(-1), ref)
+
+
+def test_v1_eos_layer():
+    x = v1.data_layer(name='x', size=1, dtype='int64')
+    o, = _run([v1.eos_layer(x, eos_id=2)], {'x': np.array([[2],[3]],'i8')})
+    assert o[0] == 1.0 and o[1] == 0.0, o
+
+
+def test_v1_l2_distance_layer():
+    a = v1.data_layer(name='a', size=3); b = v1.data_layer(name='b', size=3)
+    o, = _run([v1.l2_distance_layer(a, b)], {'a': np.zeros((2,3),'f'), 'b': np.ones((2,3),'f')})
+    np.testing.assert_allclose(o.reshape(-1), [3**0.5]*2, rtol=1e-5)
+
+
+def test_v1_norm_layers():
+    a = v1.data_layer(name='a', size=4)
+    o1, o2 = _run([v1.sum_to_one_norm_layer(a), v1.row_l2_norm_layer(a)],
+                 {'a': np.array([[1,1,2,4]],'f')})
+    np.testing.assert_allclose(o1.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(o2), 1.0, rtol=1e-5)
+
+
+def test_v1_gated_unit_layer():
+    x = v1.data_layer(name='x', size=4)
+    o, = _run([v1.gated_unit_layer(x, size=3)], {'x': np.ones((2,4),'f')})
+    assert o.shape == (2,3)
+
+
+def test_v1_conv_shift_layer():
+    a = v1.data_layer(name='a', size=5); b = v1.data_layer(name='b', size=3)
+    o, = _run([v1.conv_shift_layer(a, b)], {'a': np.ones((1,5),'f'), 'b': np.ones((1,3),'f')})
+    assert o.shape == (1,5)
+
+
+def test_v1_crop_layer():
+    img = v1.data_layer(name='im', size=3*4*4)
+    x = v1.img_conv_layer(img, 3, 3, num_channels=3, padding=1)
+    o, = _run([v1.crop_layer(x, offset=[0,0,1,1], shape=[2,3,2,2])],
+             {'im': np.random.rand(2,48).astype('f')})
+    assert o.shape == (2,3,2,2), o.shape
+
+
+def test_v1_prelu_layer():
+    x = v1.data_layer(name='x', size=4)
+    o, = _run([v1.prelu_layer(x)], {'x': np.array([[-1,1,-2,2]],'f')})
+    assert o.shape == (1,4)
+
+
+def test_v1_scaling_layer():
+    x = v1.data_layer(name='x', size=4); w = v1.data_layer(name='w', size=1)
+    o, = _run([v1.scaling_layer(x, w)], {'x': np.ones((2,4),'f'), 'w': np.array([[2],[3]],'f')})
+    np.testing.assert_allclose(o, [[2]*4,[3]*4])
+
+
+def test_v1_power_layer():
+    x = v1.data_layer(name='x', size=4); w = v1.data_layer(name='w', size=1)
+    o, = _run([v1.power_layer(x, w)], {'x': np.full((1,4),2.0,'f'), 'w': np.array([[3]],'f')})
+    np.testing.assert_allclose(o, np.full((1,4),8.0), rtol=1e-5)
+
+
+def test_v1_seq_reshape_layer():
+    x = v1.data_layer(name='x', size=4, seq_type=1)
+    r = v1.seq_reshape_layer(x, 2)
+    o, = _run([r], {'x': np.arange(8,dtype='f').reshape(1,2,4), 'x_len': np.array([2],'i4')})
+    assert o.shape == (1,4,2), o.shape
+
+
+def test_v1_expand_layer():
+    x = v1.data_layer(name='x', size=3)
+    seq = v1.data_layer(name='s', size=2, seq_type=1)
+    o, = _run([v1.expand_layer(x, seq)],
+             {'x': np.ones((2,3),'f'), 's': np.ones((2,4,2),'f'), 's_len': np.array([4,4],'i4')})
+    assert o.shape == (2,4,3), o.shape
+
+
+def test_v1_classification_error_evaluator():
+    p = v1.data_layer(name='p', size=5)
+    lbl = v1.data_layer(name='l', size=1, dtype='int64')
+    err = v1.evaluators.classification_error_evaluator(p, lbl)
+    o, = _run([err], {'p': np.eye(5,dtype='f')[:3], 'l': np.array([[0],[1],[3]],'i8')})
+    np.testing.assert_allclose(float(o), 1/3, rtol=1e-4)
+
