@@ -136,6 +136,32 @@ def rnn_search(src_vocab=1000, trg_vocab=1000, emb_dim=64, hidden_dim=64):
                       'lbl_mask']
 
 
+def _decoder_param_inputs(encoded, encoded_proj, boot, src_len,
+                          src_vocab, trg_vocab, emb_dim, hidden_dim):
+    """Decode-op input dict: the training decoder's parameters,
+    re-declared by NAME (first-init-wins keeps one init either build
+    order; is_bias=True makes the bias init Constant(0) when the infer
+    graph is built first)."""
+    def param(name, shape, is_bias=False):
+        return layers.create_parameter(shape=shape, dtype='float32',
+                                       attr=_p(name), is_bias=is_bias)
+
+    return {
+        'EncOut': [encoded], 'EncProj': [encoded_proj], 'Boot': [boot],
+        'SrcLen': [src_len],
+        'TrgEmb': [param('rnnsearch_trg_emb', [trg_vocab, emb_dim])],
+        'AttW': [param('rnnsearch_att_trans.w', [hidden_dim, hidden_dim])],
+        'ScoreW': [param('rnnsearch_att_score.w', [hidden_dim, 1])],
+        'StepW': [param('rnnsearch_step.w',
+                        [emb_dim + 2 * hidden_dim, 3 * hidden_dim])],
+        'GruW': [param('rnnsearch_gru.w', [hidden_dim, 3 * hidden_dim])],
+        'GruB': [param('rnnsearch_gru.b', [1, 3 * hidden_dim],
+                       is_bias=True)],
+        'OutW': [param('rnnsearch_out.w', [hidden_dim, trg_vocab])],
+        'OutB': [param('rnnsearch_out.b', [trg_vocab], is_bias=True)],
+    }
+
+
 def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
                             hidden_dim=64, max_out_len=16, bos_id=1,
                             eos_id=0):
@@ -151,28 +177,9 @@ def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
                              bias_attr=False, num_flatten_dims=2,
                              param_attr=_p('rnnsearch_encproj.w'))
     helper = LayerHelper('rnn_search_greedy_decode')
-
-    def param(name, shape, is_bias=False):
-        # is_bias matters even for shared params: if the infer graph is
-        # built FIRST, its default initializer (Constant 0 for biases)
-        # is the one that sticks under first-init-wins
-        return layers.create_parameter(shape=shape, dtype='float32',
-                                       attr=_p(name), is_bias=is_bias)
-
-    inputs = {
-        'EncOut': [encoded], 'EncProj': [encoded_proj], 'Boot': [boot],
-        'SrcLen': [src_len],
-        'TrgEmb': [param('rnnsearch_trg_emb', [trg_vocab, emb_dim])],
-        'AttW': [param('rnnsearch_att_trans.w', [hidden_dim, hidden_dim])],
-        'ScoreW': [param('rnnsearch_att_score.w', [hidden_dim, 1])],
-        'StepW': [param('rnnsearch_step.w',
-                        [emb_dim + 2 * hidden_dim, 3 * hidden_dim])],
-        'GruW': [param('rnnsearch_gru.w', [hidden_dim, 3 * hidden_dim])],
-        'GruB': [param('rnnsearch_gru.b', [1, 3 * hidden_dim],
-                       is_bias=True)],
-        'OutW': [param('rnnsearch_out.w', [hidden_dim, trg_vocab])],
-        'OutB': [param('rnnsearch_out.b', [trg_vocab], is_bias=True)],
-    }
+    inputs = _decoder_param_inputs(encoded, encoded_proj, boot, src_len,
+                                   src_vocab, trg_vocab, emb_dim,
+                                   hidden_dim)
     out = helper.create_variable_for_type_inference('int64')
     if encoded.shape is not None:
         out.shape = (encoded.shape[0], max_out_len)
@@ -181,6 +188,37 @@ def rnn_search_greedy_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
                      attrs={'max_out_len': max_out_len, 'bos_id': bos_id,
                             'eos_id': eos_id})
     return out, ['src_word', 'src_len']
+
+
+def rnn_search_beam_infer(src_vocab=1000, trg_vocab=1000, emb_dim=64,
+                          hidden_dim=64, max_out_len=16, beam_size=4,
+                          bos_id=1, eos_id=0):
+    """Beam-search generation (the seqToseq demo's mode): encoder +
+    ONE rnn_search_beam_decode op. Returns (ids [B, beam, T] sorted
+    best-first, scores [B, beam], feed names)."""
+    from ..layers.helper import LayerHelper
+    src_word, src_len = _build_inputs()
+    encoded, boot = encoder(src_word, src_len, src_vocab, emb_dim,
+                            hidden_dim)
+    encoded_proj = layers.fc(input=encoded, size=hidden_dim,
+                             bias_attr=False, num_flatten_dims=2,
+                             param_attr=_p('rnnsearch_encproj.w'))
+    helper = LayerHelper('rnn_search_beam_decode')
+    inputs = _decoder_param_inputs(encoded, encoded_proj, boot, src_len,
+                                   src_vocab, trg_vocab, emb_dim,
+                                   hidden_dim)
+    ids = helper.create_variable_for_type_inference('int64')
+    scores = helper.create_variable_for_type_inference('float32')
+    if encoded.shape is not None:
+        ids.shape = (encoded.shape[0], beam_size, max_out_len)
+        scores.shape = (encoded.shape[0], beam_size)
+    helper.append_op(type='rnn_search_beam_decode', inputs=inputs,
+                     outputs={'SentenceIds': [ids],
+                              'SentenceScores': [scores]},
+                     attrs={'max_out_len': max_out_len,
+                            'beam_size': beam_size, 'bos_id': bos_id,
+                            'eos_id': eos_id})
+    return ids, scores, ['src_word', 'src_len']
 
 
 def make_fake_batch(batch, src_seq, trg_seq, src_vocab, trg_vocab,

@@ -257,51 +257,61 @@ def _simple_rnn(ctx):
     ctx.set_output('Hidden', hidden)
 
 
+
+def _rnn_search_params(ctx):
+    """Common input unpack for the rnn_search decode ops."""
+    return dict(
+        enc=ctx.input('EncOut'), proj=ctx.input('EncProj'),
+        state0=ctx.input('Boot'),
+        src_len=ctx.input('SrcLen') if ctx.has_input('SrcLen') else None,
+        emb=ctx.input('TrgEmb'), att_w=ctx.input('AttW'),
+        score_w=ctx.input('ScoreW'), step_w=ctx.input('StepW'),
+        gru_w=ctx.input('GruW'), gru_b=ctx.input('GruB'),
+        out_w=ctx.input('OutW'), out_b=ctx.input('OutB'))
+
+
+def _rnn_search_step(last_ids, state, enc, proj, kmask, p):
+    """ONE decoder step — additive attention (mirroring
+    additive_attention + the sequence_softmax length mask), the shared
+    gru_step recurrence, and the vocab projection. The single home of
+    the step math: the greedy and beam decode ops both call it, so the
+    two generation modes cannot drift from each other (they share the
+    training parameters by name already)."""
+    dec = state @ p['att_w']
+    combined = jnp.tanh(proj + dec[:, None, :])
+    scores = (combined @ p['score_w'])[..., 0]
+    if kmask is not None:
+        scores = jnp.where(kmask, scores, -1e9)
+    weights = jax.nn.softmax(scores, axis=-1)
+    context = jnp.einsum('bs,bsd->bd', weights, enc)
+    xt = jnp.concatenate([jnp.take(p['emb'], last_ids, axis=0), context],
+                         axis=-1) @ p['step_w']
+    new_state, _, _, _ = gru_step(xt, state, p['gru_w'], p['gru_b'])
+    logits = new_state @ p['out_w'] + p['out_b']
+    return new_state, logits
+
+
 @register('rnn_search_greedy_decode')
 def _rnn_search_greedy_decode(ctx):
     """Greedy generation for the RNN-search seq2seq
     (models/rnn_search.py): ONE lax.scan over output positions with
     argmax feedback, instead of the reference's While-based infer
-    program re-running the decoder per emitted token. Each step is the
-    exact math of the training step block — additive attention over the
-    encoder states, the gru_unit recurrence, the vocab projection."""
-    enc = ctx.input('EncOut')          # [B, Ts, 2H]
-    proj = ctx.input('EncProj')        # [B, Ts, H]
-    state0 = ctx.input('Boot')         # [B, H]
-    src_len = ctx.input('SrcLen') if ctx.has_input('SrcLen') else None
-    emb = ctx.input('TrgEmb')          # [V, E]
-    att_w = ctx.input('AttW')          # [H, H]
-    score_w = ctx.input('ScoreW')      # [H, 1]
-    step_w = ctx.input('StepW')        # [E+2H, 3H]
-    gru_w = ctx.input('GruW')          # [H, 3H]
-    gru_b = ctx.input('GruB')          # [1, 3H]
-    out_w = ctx.input('OutW')          # [H, V]
-    out_b = ctx.input('OutB')          # [V]
+    program re-running the decoder per emitted token."""
+    p = _rnn_search_params(ctx)
     t_max = ctx.attr('max_out_len')
     bos_id = ctx.attr('bos_id', 1)
     eos_id = ctx.attr('eos_id', 0)
+    enc, proj, state0, src_len = \
+        p['enc'], p['proj'], p['state0'], p['src_len']
     b, ts = enc.shape[0], enc.shape[1]
-    h = state0.shape[-1]
     kmask = None
     if src_len is not None:
         kmask = jnp.arange(ts)[None, :] < src_len.reshape(-1, 1)
 
     def step(carry, _):
         ids, state = carry
-        # additive attention (mirrors additive_attention + the
-        # sequence_softmax length mask)
-        dec = state @ att_w                              # [B, H]
-        combined = jnp.tanh(proj + dec[:, None, :])
-        scores = (combined @ score_w)[..., 0]            # [B, Ts]
-        if kmask is not None:
-            scores = jnp.where(kmask, scores, -1e9)
-        weights = jax.nn.softmax(scores, axis=-1)
-        context = jnp.einsum('bs,bsd->bd', weights, enc)
-        # step projection + the shared gru_unit recurrence
-        xt = jnp.concatenate([jnp.take(emb, ids, axis=0), context],
-                             axis=-1) @ step_w
-        new_state, _, _, _ = gru_step(xt, state, gru_w, gru_b)
-        logits = new_state @ out_w + out_b
+        new_state, logits = _rnn_search_step(ids, state, enc, proj,
+                                             kmask, p)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, new_state), nxt
 
@@ -313,6 +323,63 @@ def _rnn_search_greedy_decode(ctx):
     before = jnp.cumsum(is_eos, axis=1) - is_eos
     ids = jnp.where(before > 0, eos_id, ids)
     ctx.set_output('Out', ids.astype(ctx.out_dtype('Out', 'int64')))
+
+
+@register('rnn_search_beam_decode')
+def _rnn_search_beam_decode(ctx):
+    """Beam search for the RNN-search seq2seq in ONE lax.scan: beams
+    fold into the batch axis for the shared _rnn_search_step, the
+    candidate expansion/pruning is the shared beam_search_step math,
+    and the final backtrack is beam_backtrack (decode_ops.py) — the
+    reference seqToseq demo's beam generation without its per-token
+    While re-runs."""
+    p = _rnn_search_params(ctx)
+    t_max = ctx.attr('max_out_len')
+    beam = ctx.attr('beam_size', 4)
+    bos_id = ctx.attr('bos_id', 1)
+    eos_id = ctx.attr('eos_id', 0)
+    enc, proj, state0, src_len = \
+        p['enc'], p['proj'], p['state0'], p['src_len']
+    b, ts = enc.shape[0], enc.shape[1]
+
+    enc_b = jnp.repeat(enc, beam, axis=0)        # [B*K, Ts, 2H]
+    proj_b = jnp.repeat(proj, beam, axis=0)      # [B*K, Ts, H]
+    kmask = None
+    if src_len is not None:
+        kmask = jnp.arange(ts)[None, :] < \
+            jnp.repeat(src_len.reshape(-1), beam).reshape(-1, 1)
+
+    last0 = jnp.full((b * beam,), bos_id, jnp.int32)
+    state_b0 = jnp.repeat(state0, beam, axis=0)  # [B*K, H]
+    pre_ids0 = jnp.full((b, beam), bos_id, jnp.int32)
+    pre_scores0 = jnp.where(jnp.arange(beam)[None, :] == 0, 0.0, -1e9) * \
+        jnp.ones((b, 1), jnp.float32)
+
+    def step(carry, _):
+        last, pre_ids, pre_scores, state = carry
+        new_state, logits = _rnn_search_step(last, state, enc_b, proj_b,
+                                             kmask, p)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        top_scores, top_ids = jax.lax.top_k(logp, beam)
+        from .decode_ops import beam_search_step
+        sel_ids, sel_scores, parent = beam_search_step(
+            pre_ids, pre_scores, top_ids.reshape(b, beam, beam),
+            top_scores.reshape(b, beam, beam), beam, eos_id)
+        state_k = jnp.take_along_axis(
+            new_state.reshape(b, beam, -1), parent[:, :, None],
+            axis=1).reshape(b * beam, -1)
+        carry = (sel_ids.reshape(-1).astype(jnp.int32), sel_ids,
+                 sel_scores, state_k)
+        return carry, (sel_ids, parent)
+
+    (_, _, final_scores, _), (step_ids, step_parents) = jax.lax.scan(
+        step, (last0, pre_ids0, pre_scores0, state_b0), None,
+        length=t_max)
+    from .decode_ops import beam_backtrack
+    seq = beam_backtrack(step_ids, step_parents, eos_id)  # [B, K, T]
+    ctx.set_output('SentenceIds',
+                   seq.astype(ctx.out_dtype('SentenceIds', 'int64')))
+    ctx.set_output('SentenceScores', final_scores)
 
 
 @register('lstm_unit')

@@ -254,11 +254,14 @@ def test_rnn_search_attention_seq2seq():
     assert losses[-1] < losses[0] * 0.6, losses
 
 
-def test_rnn_search_greedy_decode_reproduces_training():
-    """rnn_search_greedy_decode (one lax.scan with argmax feedback,
-    training params shared by name) reproduces the trained copy task."""
+def test_rnn_search_decodes_reproduce_training():
+    """rnn_search greedy AND beam decode ops (one lax.scan each,
+    training params shared by name) reproduce the trained copy task;
+    the top beam equals greedy on the peaked model and beam scores
+    come back sorted best-first."""
     from paddle_tpu.core.program import Program, program_guard
     from paddle_tpu.models.rnn_search import (make_fake_batch, rnn_search,
+                                              rnn_search_beam_infer,
                                               rnn_search_greedy_infer)
     cost, _ = rnn_search(src_vocab=30, trg_vocab=30, emb_dim=16,
                          hidden_dim=16)
@@ -268,13 +271,19 @@ def test_rnn_search_greedy_decode_reproduces_training():
     feed = make_fake_batch(8, 5, 5, 30, 30)
     for _ in range(200):
         exe.run(feed=feed, fetch_list=[cost])
-    infer_prog = Program()
-    with program_guard(infer_prog, fluid.default_startup_program()):
-        ids, _feeds = rnn_search_greedy_infer(
+    gp, bp = Program(), Program()
+    with program_guard(gp, fluid.default_startup_program()):
+        gids, _feeds = rnn_search_greedy_infer(
             src_vocab=30, trg_vocab=30, emb_dim=16, hidden_dim=16,
             max_out_len=5)
-    got = np.asarray(exe.run(program=infer_prog,
-                             feed={'src_word': feed['src_word'],
-                                   'src_len': feed['src_len']},
-                             fetch_list=[ids])[0])
-    assert (got == feed['lbl_word']).mean() > 0.8
+    with program_guard(bp, fluid.default_startup_program()):
+        bids, bscores, _feeds = rnn_search_beam_infer(
+            src_vocab=30, trg_vocab=30, emb_dim=16, hidden_dim=16,
+            max_out_len=5, beam_size=4)
+    f = {'src_word': feed['src_word'], 'src_len': feed['src_len']}
+    g = np.asarray(exe.run(program=gp, feed=f, fetch_list=[gids])[0])
+    bi, bs = (np.asarray(v) for v in
+              exe.run(program=bp, feed=f, fetch_list=[bids, bscores]))
+    assert (g == feed['lbl_word']).mean() > 0.8
+    assert (bi[:, 0, :] == g).mean() > 0.9
+    assert np.all(np.diff(bs, axis=1) <= 1e-5)  # sorted best-first
