@@ -502,10 +502,11 @@ def main():
         return False
 
     if not reduced and os.environ.get('BENCH_ABLATIONS', '1') != '0':
-        # Priority order: the round-4 diagnostics (step anatomy, the
-        # seq-1024 XLA-vs-Pallas pair, the attention microbench) run
-        # FIRST after the headline so a tight driver budget captures
-        # them; the long-standing ablations and sweeps follow.
+        # Priority order under a tight driver budget: step anatomy,
+        # the seq-1024 XLA-vs-Pallas pair, the ResNet story (layout /
+        # s2d stem / BN dtype), the dispatch-mode and fused-CE A/Bs,
+        # then the attention microbench + seq-4096 pair, then the
+        # long-standing sweeps (seq-256, scan, MoE, PRNG, parity).
         if backend not in ('cpu',) and not over_budget(extra=150.0):
             # fwd/bwd wall split + XLA cost analysis: decides whether
             # the ResNet bwd gap is HBM-bandwidth floor (VERDICT r3 #2)
@@ -548,36 +549,6 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_seq1024'] = \
                     round(tok_1k, 1)
-        if backend not in ('cpu',) and not over_budget(
-                extra=timeout + 200.0):
-            # seq-4096 e2e pair: the long-context claim measured, both
-            # attention paths (VERDICT r3 #8's other data point)
-            tok_4k, err = _run_workload(
-                'transformer_seq4096', backend, reduced, timeout + 100)
-            if err:
-                errors['transformer_seq4096'] = err
-            else:
-                ablations['transformer_tok_per_sec_seq4096'] = \
-                    round(tok_4k, 1)
-                tok_4kp, err = _run_workload(
-                    'transformer_seq4096', backend, reduced,
-                    timeout + 100, env={'PADDLE_TPU_USE_PALLAS': '1'})
-                if err:
-                    errors['transformer_seq4096_pallas'] = err
-                else:
-                    ablations['transformer_tok_per_sec_seq4096_pallas'] \
-                        = round(tok_4kp, 1)
-                    ablations['seq4096_attention_winner'] = \
-                        'pallas' if tok_4kp > tok_4k * 1.02 else 'xla'
-        if backend not in ('cpu',) and not over_budget():
-            # isolated fwd+bwd attention, XLA vs Pallas, seq 1024/4096
-            # d_head 64 (its own watchdog: relay Pallas compiles hang)
-            attn, err = _run_workload('attention_microbench', backend,
-                                      reduced, timeout)
-            if err:
-                errors['attention_microbench'] = err
-            else:
-                ablations['attention_fwdbwd_microbench'] = attn
         layout_env = {}
         if backend not in ('cpu',) and not over_budget():
             # default on TPU is now the IR-native NHWC network (zero
@@ -656,6 +627,36 @@ def main():
             else:
                 ablations['transformer_tok_per_sec_naive_ce'] = \
                     round(tok_nce, 1)
+        if backend not in ('cpu',) and not over_budget():
+            # isolated fwd+bwd attention, XLA vs Pallas, seq 1024/4096
+            # d_head 64 (its own watchdog: relay Pallas compiles hang)
+            attn, err = _run_workload('attention_microbench', backend,
+                                      reduced, timeout)
+            if err:
+                errors['attention_microbench'] = err
+            else:
+                ablations['attention_fwdbwd_microbench'] = attn
+        if backend not in ('cpu',) and not over_budget(
+                extra=timeout + 200.0):
+            # seq-4096 e2e pair: the long-context claim measured, both
+            # attention paths (VERDICT r3 #8's other data point)
+            tok_4k, err = _run_workload(
+                'transformer_seq4096', backend, reduced, timeout + 100)
+            if err:
+                errors['transformer_seq4096'] = err
+            else:
+                ablations['transformer_tok_per_sec_seq4096'] = \
+                    round(tok_4k, 1)
+                tok_4kp, err = _run_workload(
+                    'transformer_seq4096', backend, reduced,
+                    timeout + 100, env={'PADDLE_TPU_USE_PALLAS': '1'})
+                if err:
+                    errors['transformer_seq4096_pallas'] = err
+                else:
+                    ablations['transformer_tok_per_sec_seq4096_pallas'] \
+                        = round(tok_4kp, 1)
+                    ablations['seq4096_attention_winner'] = \
+                        'pallas' if tok_4kp > tok_4k * 1.02 else 'xla'
         if not over_budget(extra=150.0):
             # seq-256 compile (run_steps scan over a longer-attention
             # graph) can exceed the standard watchdog — give it slack
@@ -681,10 +682,13 @@ def main():
             # tighter capacity drops more tokens but dispatches less
             moe_sweep = {}
             for cap in ('1.0', '1.25', '2.0'):
-                if over_budget():
+                if over_budget(extra=150.0):
                     break
+                # MoE compile is the slow part (r4 capture: 250 s
+                # timeouts before first result) — same slack as the
+                # other compile-heavy workloads
                 tok_moe, err = _run_workload('moe_cap' + cap, backend,
-                                             reduced, timeout)
+                                             reduced, timeout + 150)
                 if err:
                     errors['moe_cap' + cap] = err
                 else:
