@@ -143,11 +143,15 @@ def bench_moe(batch=32, seq=64, vocab=32000, num_experts=8,
     the drop-rate/throughput trade the Switch paper tunes."""
     fluid = _fresh()
     from paddle_tpu.models.moe import switch_transformer_lm
+    # scan_layers: the unrolled 4-block MoE graph was the one workload
+    # that out-compiled its watchdog on the relay (250 s timeouts, r4
+    # capture); the moe_layer_stack scan compiles flat over depth
     avg_cost, _ = switch_transformer_lm(
         vocab_size=vocab, seq_len=seq, n_layer=n_layer, n_head=8,
         d_model=512, d_inner=2048, num_experts=num_experts,
         capacity_factor=capacity_factor, dropout_rate=0.1,
-        max_length=max(512, seq))
+        max_length=max(512, seq),
+        scan_layers=os.environ.get('BENCH_MOE_SCAN', '1') != '0')
     fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     fluid.default_main_program().amp = 'bf16'
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -694,6 +698,11 @@ def main():
                 else:
                     moe_sweep['tok_per_sec_cap' + cap] = round(tok_moe, 1)
             if moe_sweep:
+                # record which layer-stacking mode produced the numbers
+                # (scan vs unrolled throughput differ; cross-round
+                # comparisons must not conflate mode with routing cost)
+                moe_sweep['layer_mode'] = 'scan' if os.environ.get(
+                    'BENCH_MOE_SCAN', '1') != '0' else 'unrolled'
                 ablations['moe_capacity_sweep'] = moe_sweep
         if backend not in ('cpu',) and not over_budget():
             # default PRNG on TPU is now rbg (executor._default_prng);
