@@ -309,6 +309,17 @@ def transformer_base(src_vocab_size=32000, trg_vocab_size=32000,
     return transformer(src_vocab_size, trg_vocab_size, **cfg)
 
 
+def transformer_big(src_vocab_size=32000, trg_vocab_size=32000,
+                    src_seq_len=64, trg_seq_len=64, **overrides):
+    """The reference "big" configuration (benchmark NMT suite:
+    d_model 1024, 16 heads, d_inner 4096, dropout 0.3)."""
+    cfg = dict(n_layer=6, n_head=16, d_key=64, d_value=64, d_model=1024,
+               d_inner=4096, dropout_rate=0.3, label_smooth_eps=0.1,
+               src_seq_len=src_seq_len, trg_seq_len=trg_seq_len)
+    cfg.update(overrides)
+    return transformer(src_vocab_size, trg_vocab_size, **cfg)
+
+
 FEED_NAMES = ['src_word', 'src_length', 'trg_word', 'lbl_word', 'lbl_weight']
 
 

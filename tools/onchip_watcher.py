@@ -70,6 +70,9 @@ QUEUE = [
     ('transformer_seq4096_pallas',
      [sys.executable, 'bench.py', '--workload', 'transformer_seq4096',
       '--backend', 'tpu'], 700, {'PADDLE_TPU_USE_PALLAS': '1'}),
+    ('transformer_big',
+     [sys.executable, 'bench.py', '--workload', 'transformer_big',
+      '--backend', 'tpu'], 700),
 ]
 
 
