@@ -110,14 +110,18 @@ def _to_device(feed):
 
 
 def bench_transformer(batch=64, seq=64, vocab=32000, iters=20,
-                      dropout=0.1, big=False):
+                      dropout=None, big=False):
+    """dropout=None keeps each builder's canonical rate (base 0.1,
+    big 0.3) — an explicit value is an override, not a default, so
+    big=True cannot silently bench a lighter model."""
     fluid = _fresh()
     from paddle_tpu.models import transformer as T
     builder = T.transformer_big if big else T.transformer_base
+    overrides = {} if dropout is None else {'dropout_rate': dropout}
     avg_cost, _ = builder(
         src_vocab_size=vocab, trg_vocab_size=vocab,
-        src_seq_len=seq, trg_seq_len=seq, dropout_rate=dropout,
-        max_length=max(256, seq))
+        src_seq_len=seq, trg_seq_len=seq,
+        max_length=max(256, seq), **overrides)
     fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
     fluid.default_main_program().amp = 'bf16'
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -404,9 +408,7 @@ def _run_workload_child(workload, backend, reduced):
         # not in the default driver ablations (budget)
         kw = dict(batch=4, seq=32, vocab=4096, iters=3) if reduced \
             else dict(batch=32, seq=64, iters=10)
-        # dropout 0.3 IS part of the big config; without it the number
-        # would misattribute a lighter model as the reference config
-        val = bench_transformer(big=True, dropout=0.3, **kw)
+        val = bench_transformer(big=True, **kw)  # canonical dropout 0.3
     elif workload == 'transformer_seq4096':
         # longest-context config (batch 1 holds tokens/step at 4096);
         # dropout 0 keeps the Pallas gate open, same as seq1024.
