@@ -349,17 +349,11 @@ def _run_workload_child(workload, backend, reduced):
     if backend == 'cpu':
         from paddle_tpu.core.platform_boot import force_host_cpu
         force_host_cpu()
-    cache_dir = os.environ.get('JAX_COMPILATION_CACHE_DIR')
-    if cache_dir:
-        # env alone does not arm the cache on this jax build; the
-        # explicit config does (verified: entries appear). A re-run of a
-        # workload killed mid-compile then starts from the cached
-        # executable instead of re-burning its watchdog budget.
-        try:
-            import jax
-            jax.config.update('jax_compilation_cache_dir', cache_dir)
-        except Exception:
-            pass
+    # one home for the cache-arming quirk (env alone does not arm it on
+    # this jax build); a workload killed mid-compile then restarts from
+    # the cached executable instead of re-burning its watchdog budget
+    from paddle_tpu.core.platform_boot import arm_compile_cache
+    arm_compile_cache()
     if workload == 'pallas_parity':
         print('RESULT_JSON %s' % json.dumps(pallas_parity()), flush=True)
         return

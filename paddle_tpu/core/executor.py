@@ -193,6 +193,8 @@ class Executor(object):
         self.place = place if place is not None else TPUPlace(0)
         self._cache = {}
         self._step = 0
+        from .platform_boot import arm_compile_cache
+        arm_compile_cache()
 
     # ------------------------------------------------------------------ run
     def run(self, program=None, feed=None, fetch_list=None, scope=None,

@@ -29,6 +29,48 @@ def force_host_cpu(n_devices=None):
     jax.config.update('jax_platforms', 'cpu')
 
 
+_cache_armed = False
+
+
+def arm_compile_cache():
+    """Arm jax's persistent XLA compile cache (idempotent; called at
+    Executor construction). Re-runs of any program — across processes
+    and across driver rounds — start from the cached executable instead
+    of recompiling; on the tunneled relay that also shields against
+    mid-compile hangs on re-runs. Default dir is stable per machine;
+    JAX_COMPILATION_CACHE_DIR overrides, PADDLE_TPU_COMPILE_CACHE=0
+    disables. On this jax build the env var alone does not arm the
+    cache — the explicit config call does (bench.py verified entries
+    appear)."""
+    global _cache_armed
+    if _cache_armed:
+        return
+    from .flags import get_flag
+    if not get_flag('compile_cache'):  # PADDLE_TPU_COMPILE_CACHE=0/false
+        return
+    _cache_armed = True
+    import getpass
+    import tempfile
+    # per-user default: a fixed shared-tmp name would break (or poison)
+    # across users on a shared machine
+    try:
+        user = getpass.getuser()
+    except Exception:
+        user = str(os.getuid()) if hasattr(os, 'getuid') else 'default'
+    cache_dir = os.environ.get(
+        'JAX_COMPILATION_CACHE_DIR',
+        os.path.join(tempfile.gettempdir(),
+                     'paddle_tpu_xla_cache_%s' % user))
+    try:
+        import jax
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        # compile times on the relay are tens of seconds; cache even
+        # fast compiles so CPU test reruns benefit too
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)
+    except Exception:
+        pass  # older jax without the knobs: cache is an optimization
+
+
 def is_tpu_backend():
     """True when the default jax backend is real TPU hardware — the
     'tpu' platform, or the hosted 'axon' relay in case a jax version
