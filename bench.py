@@ -168,6 +168,40 @@ def bench_moe(batch=32, seq=64, vocab=32000, num_experts=8,
     return batch * seq / _time_multi(exe, feed, [avg_cost], iters)
 
 
+def bench_rnn_lstm(batch=128, seq=100, vocab=30000, hidden=128,
+                   lstm_num=1, iters=20):
+    """The reference benchmark/paddle/rnn/rnn.py config (stacked-LSTM
+    IMDB sentiment), built VERBATIM through the v1
+    trainer_config_helpers shim — the rnn/ half of the benchmark suite
+    beside image/. Reports tokens/s (batch*seq / step)."""
+    fluid = _fresh()
+    from paddle_tpu.trainer_config_helpers import (
+        AdamOptimizer, L2Regularization, SoftmaxActivation,
+        classification_cost, data_layer, embedding_layer, fc_layer,
+        last_seq, settings, simple_lstm)
+    net = data_layer('data', size=vocab, dtype='int64', seq_type=1)
+    net = embedding_layer(input=net, size=128)
+    for _ in range(lstm_num):
+        net = simple_lstm(input=net, size=hidden)
+    net = last_seq(input=net)
+    net = fc_layer(input=net, size=2, act=SoftmaxActivation())
+    lab = data_layer('label', 1, dtype='int64')
+    loss = classification_cost(input=net, label=lab)
+    settings(batch_size=batch, learning_rate=2e-3,
+             learning_method=AdamOptimizer(),
+             regularization=L2Regularization(8e-4),
+             gradient_clipping_threshold=25).minimize(loss)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _to_device({
+        'data': rng.randint(1, vocab, (batch, seq)).astype('int64'),
+        'data_len': np.full((batch,), seq, 'int32'),
+        'label': rng.randint(0, 2, (batch, 1)).astype('int64')})
+    return batch * seq / _time_multi(exe, feed, [loss], iters)
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -396,6 +430,9 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(batch=1, seq=1024, vocab=4096, iters=3) if reduced \
             else dict(batch=4, seq=1024, iters=10)
         val = bench_transformer(dropout=0.0, **kw)
+    elif workload == 'rnn_lstm':
+        kw = dict(batch=8, seq=16, vocab=512, iters=3) if reduced else {}
+        val = bench_rnn_lstm(**kw)
     elif workload == 'transformer_big':
         # the reference benchmark suite's other NMT config (d_model
         # 1024 / 16 heads / d_inner 4096); watcher-queue workload —
@@ -827,7 +864,7 @@ if __name__ == '__main__':
         p.add_argument('--workload',
                        choices=['transformer', 'transformer_seq256',
                                 'transformer_seq1024',
-                                'transformer_seq4096', 'transformer_big', 'resnet50',
+                                'transformer_seq4096', 'transformer_big', 'rnn_lstm', 'resnet50',
                                 'resnet50_anatomy', 'attention_microbench',
                                 'pallas_parity', 'moe_cap1.0',
                                 'moe_cap1.25', 'moe_cap2.0'])

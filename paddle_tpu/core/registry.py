@@ -34,6 +34,10 @@ def get_lowering(op_type):
 AMP_WHITELIST = {
     'mul', 'matmul', 'conv2d', 'conv2d_transpose', 'fused_attention',
     'sequence_conv', 'row_conv',
+    # recurrences: the per-step h @ W rides the MXU; uniform bf16
+    # inputs also keep the lax.scan carry dtype stable (a fp32 weight
+    # against a bf16 pre-projection would promote h to fp32 mid-scan)
+    'lstm', 'lstmp', 'gru', 'simple_rnn', 'gru_unit', 'lstm_unit',
 }
 AMP_BLACKLIST = {
     'softmax', 'softmax_with_cross_entropy', 'cross_entropy',

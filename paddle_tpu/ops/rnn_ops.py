@@ -59,6 +59,10 @@ def lstm_scan(x_proj, w_h, bias, h0, c0, length=None, gate_act=jax.nn.sigmoid,
         if m is not None:
             h = m * h + (1 - m) * h_prev
             c = m * c + (1 - m) * c_prev
+        # pin the carry dtype: a mixed-precision weight would otherwise
+        # promote h/c mid-scan and break lax.scan's carry contract
+        h = h.astype(h_prev.dtype)
+        c = c.astype(c_prev.dtype)
         return (h, c), (h, c)
 
     inputs = xs if ms is None else (xs, ms)
@@ -99,6 +103,7 @@ def gru_scan(x_proj, w_h, bias, h0, length=None, gate_act=jax.nn.sigmoid,
                               gate_act=gate_act, cand_act=cand_act)
         if m is not None:
             h = m * h + (1 - m) * h_prev
+        h = h.astype(h_prev.dtype)  # pin carry dtype (see lstm_scan)
         return h, h
 
     inputs = xs if ms is None else (xs, ms)
@@ -131,6 +136,7 @@ def simple_rnn_scan(x_proj, w_h, bias, h0, length=None, act=jnp.tanh,
         h = act(pre)
         if m is not None:
             h = m * h + (1 - m) * h_prev
+        h = h.astype(h_prev.dtype)  # pin carry dtype (see lstm_scan)
         return h, h
 
     inputs = xs if ms is None else (xs, ms)
@@ -209,6 +215,8 @@ def _lstmp(ctx):
         if m is not None:
             r = m * r + (1 - m) * r_prev
             c = m * c + (1 - m) * c_prev
+        r = r.astype(r_prev.dtype)  # pin carry dtype (see lstm_scan)
+        c = c.astype(c_prev.dtype)
         return (r, c), (r, c)
 
     r0 = jnp.zeros((b, p), x.dtype)

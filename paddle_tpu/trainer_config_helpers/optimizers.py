@@ -119,24 +119,32 @@ class ModelAverage(object):
 
 class Settings(object):
     def __init__(self, batch_size, learning_rate, learning_method,
-                 regularization):
+                 regularization, gradient_clipping_threshold=None):
         self.batch_size = batch_size
         self.learning_rate = learning_rate
         self.learning_method = learning_method or MomentumOptimizer(0.0)
         self.regularization = regularization
+        self.gradient_clipping_threshold = gradient_clipping_threshold
 
     def optimizer(self):
         return self.learning_method.to_fluid(self.learning_rate,
                                              self.regularization)
 
     def minimize(self, loss):
+        if self.gradient_clipping_threshold:
+            # v1 gradient_clipping_threshold is a global-norm clip
+            from ..clip import GradientClipByGlobalNorm, set_gradient_clip
+            set_gradient_clip(GradientClipByGlobalNorm(
+                float(self.gradient_clipping_threshold)))
         return self.optimizer().minimize(loss)
 
 
 def settings(batch_size=256, learning_rate=1e-3, learning_method=None,
-             regularization=None, **kwargs):
+             regularization=None, gradient_clipping_threshold=None,
+             **kwargs):
     """v1 `settings(...)` configured the global trainer; here it returns
     a Settings handle — call `.minimize(loss)` where a v1 config would
-    have relied on the trainer reading the global section."""
+    have relied on the trainer reading the global section.
+    gradient_clipping_threshold maps to the fluid global-norm clip."""
     return Settings(batch_size, learning_rate, learning_method,
-                    regularization)
+                    regularization, gradient_clipping_threshold)

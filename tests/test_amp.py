@@ -301,3 +301,37 @@ def test_s2d_stem_matches_direct_conv(monkeypatch):
     monkeypatch.setenv('PADDLE_TPU_CONV_S2D', '1')
     s2d = _stem()
     np.testing.assert_allclose(base, s2d, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_under_bf16_amp_trains():
+    """RNN ops under amp: uniform bf16 inputs (AMP_WHITELIST) and a
+    dtype-pinned scan carry — regression: a fp32 weight against the
+    bf16 pre-projection used to promote h mid-scan and break lax.scan's
+    carry contract."""
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = fluid.layers.data(name='x', shape=[-1, 8], dtype='float32',
+                          lod_level=1)
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    proj = fluid.layers.fc(input=x, size=24, num_flatten_dims=2,
+                           bias_attr=False)
+    h, _ = fluid.layers.dynamic_lstm(input=proj, size=24)
+    g = fluid.layers.dynamic_gru(
+        input=fluid.layers.fc(input=x, size=15, num_flatten_dims=2,
+                              bias_attr=False), size=5)
+    last = fluid.layers.concat([fluid.layers.sequence_last_step(h),
+                                fluid.layers.sequence_last_step(g)],
+                               axis=-1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(
+        fluid.layers.fc(input=last, size=1), y))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(cost)
+    fluid.default_main_program().amp = 'bf16'
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(4, 6, 8).astype('float32'),
+            'y': rng.randn(4, 1).astype('float32')}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[cost])[0]))
+              for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

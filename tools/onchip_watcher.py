@@ -73,6 +73,9 @@ QUEUE = [
     ('transformer_big',
      [sys.executable, 'bench.py', '--workload', 'transformer_big',
       '--backend', 'tpu'], 700),
+    ('rnn_lstm',
+     [sys.executable, 'bench.py', '--workload', 'rnn_lstm',
+      '--backend', 'tpu'], 600),
 ]
 
 
