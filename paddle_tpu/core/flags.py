@@ -14,7 +14,10 @@ _DEFAULTS = {
     # executor
     'benchmark': False,            # sync + time every executor step
     'use_bf16': False,             # default Program.amp for new programs
-    'compile_cache': True,
+    # 'auto' = persistent XLA cache on TPU backends only (XLA:CPU AOT
+    # cache entries can abort on feature-mismatched hosts); explicit
+    # true/1 arms it everywhere, false/0 never
+    'compile_cache': 'auto',
     # data pipeline
     'reader_prefetch': 256,
     # logging

@@ -46,7 +46,18 @@ def arm_compile_cache():
     if _cache_armed:
         return
     from .flags import get_flag
-    if not get_flag('compile_cache'):  # PADDLE_TPU_COMPILE_CACHE=0/false
+    mode = get_flag('compile_cache')  # 'auto' | explicit on | off
+    if mode in (False, '0', 'false', 'no', 'off'):
+        return
+    explicit_on = mode in (True, '1', 'true', 'yes', 'on')
+    # 'auto': TPU backends only. XLA:CPU persists AOT results whose
+    # recorded machine features can mismatch the loader's host
+    # detection (observed on this box: '+prefer-no-scatter ... could
+    # lead to SIGILL', then a mid-suite 'Fatal Python error: Aborted'
+    # materializing an array from a cache-loaded executable). An
+    # explicit PADDLE_TPU_COMPILE_CACHE=1 / set_flag('compile_cache',
+    # True) opts CPU in anyway.
+    if not explicit_on and not is_tpu_backend():
         return
     _cache_armed = True
     import getpass

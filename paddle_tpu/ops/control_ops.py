@@ -54,7 +54,10 @@ def _scan_rnn(ctx, length):
         for (pre, _), mem in zip(memory_names, mems):
             env[pre] = mem
         env = _run_block_ops(block, env, base_key, is_test=ctx.is_test)
-        new_mems = tuple(masked(t, env[cur], mem)
+        # pin each memory's dtype to its boot value: under amp a
+        # whitelisted step op (e.g. gru_unit) returns bf16 against an
+        # fp32 boot memory, which would break lax.scan's carry contract
+        new_mems = tuple(masked(t, env[cur], mem).astype(mem.dtype)
                          for (_, cur), mem in zip(memory_names, mems))
         outs = tuple(masked(t, env[name], None, zero=True)
                      for name in output_names)
@@ -99,7 +102,10 @@ def _while(ctx):
         env = dict(outer_env)
         env.update(dict(zip(state_names, state)))
         env = _run_block_ops(block, env, base_key, is_test=ctx.is_test)
-        return tuple(env[n] for n in state_names)
+        # pin loop-carried dtypes to the init values (see _scan_rnn)
+        return tuple(env[n].astype(s.dtype) if hasattr(env[n], 'astype')
+                     else env[n]
+                     for n, s in zip(state_names, state))
 
     init = tuple(ctx.env[n] for n in state_names)
     final = jax.lax.while_loop(cond_fn, body_fn, init)

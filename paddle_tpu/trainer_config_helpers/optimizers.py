@@ -132,10 +132,14 @@ class Settings(object):
 
     def minimize(self, loss):
         if self.gradient_clipping_threshold:
-            # v1 gradient_clipping_threshold is a global-norm clip
-            from ..clip import GradientClipByGlobalNorm, set_gradient_clip
-            set_gradient_clip(GradientClipByGlobalNorm(
-                float(self.gradient_clipping_threshold)))
+            # v1 semantics are ELEMENT-WISE value clipping: the legacy
+            # OptimizerWithGradientClipping does grad.clip(-t, t)
+            # (reference paddle/parameter/FirstOrderOptimizer.cpp:
+            # 306-326); 'global' there means config-global threshold
+            # vs per-parameter override, NOT global-norm.
+            from ..clip import GradientClipByValue, set_gradient_clip
+            t = float(self.gradient_clipping_threshold)
+            set_gradient_clip(GradientClipByValue(max=t, min=-t))
         return self.optimizer().minimize(loss)
 
 
@@ -145,6 +149,7 @@ def settings(batch_size=256, learning_rate=1e-3, learning_method=None,
     """v1 `settings(...)` configured the global trainer; here it returns
     a Settings handle — call `.minimize(loss)` where a v1 config would
     have relied on the trainer reading the global section.
-    gradient_clipping_threshold maps to the fluid global-norm clip."""
+    gradient_clipping_threshold maps to element-wise value clipping
+    (the legacy semantics; see Settings.minimize)."""
     return Settings(batch_size, learning_rate, learning_method,
                     regularization, gradient_clipping_threshold)

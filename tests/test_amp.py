@@ -331,7 +331,8 @@ def test_lstm_under_bf16_amp_trains():
     rng = np.random.RandomState(0)
     feed = {'x': rng.randn(4, 6, 8).astype('float32'),
             'y': rng.randn(4, 1).astype('float32')}
-    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[cost])[0]))
+    losses = [np.asarray(exe.run(feed=feed,
+                                 fetch_list=[cost])[0]).item()
               for _ in range(10)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]

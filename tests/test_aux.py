@@ -112,7 +112,9 @@ def test_compile_cache_env_override_and_optout(monkeypatch, tmp_path):
     from paddle_tpu.core.flags import FLAGS, get_flag
     prev_dir = jax.config.jax_compilation_cache_dir
     try:
-        # force the flag ON regardless of ambient PADDLE_TPU_* env
+        # explicit flag opt-in: CPU backends only arm when the flag is
+        # explicitly true (the XLA:CPU AOT cache is unsafe on
+        # feature-mismatched hosts — see arm_compile_cache)
         monkeypatch.delenv('PADDLE_TPU_COMPILE_CACHE', raising=False)
         get_flag('compile_cache')  # populate FLAGS before setitem
         monkeypatch.setitem(FLAGS, 'compile_cache', True)

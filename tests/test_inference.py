@@ -62,3 +62,34 @@ def test_predictor_bf16(tmp_path):
     out16 = p16.predict({'x': xs})[0]
     np.testing.assert_allclose(out32, out16, atol=2e-2)
     np.testing.assert_allclose(np.asarray(out16).sum(-1), 1.0, atol=1e-2)
+
+
+def test_rnn_search_decode_inference_roundtrip(tmp_path):
+    """save/load_inference_model around the rnn_search greedy-decode
+    program: the reloaded program reproduces the decode ids exactly
+    (serving parity for the seq2seq decode ops)."""
+    from paddle_tpu.core.program import Program, program_guard
+    from paddle_tpu.models.rnn_search import (make_fake_batch, rnn_search,
+                                              rnn_search_greedy_infer)
+    cost, _ = rnn_search(src_vocab=30, trg_vocab=30, emb_dim=8,
+                         hidden_dim=8)
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = make_fake_batch(4, 5, 4, 30, 30)
+    for _ in range(20):
+        exe.run(feed=feed, fetch_list=[cost])
+    ip = Program()
+    with program_guard(ip, fluid.default_startup_program()):
+        ids, feeds = rnn_search_greedy_infer(30, 30, 8, 8, max_out_len=4)
+    f = {'src_word': feed['src_word'], 'src_len': feed['src_len']}
+    want = np.asarray(exe.run(program=ip, feed=f, fetch_list=[ids])[0])
+    fluid.io.save_inference_model(str(tmp_path), feeds, [ids], exe,
+                                  main_program=ip)
+    fluid.global_scope().clear()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog, _names, fetches = fluid.io.load_inference_model(str(tmp_path),
+                                                          exe2)
+    got = np.asarray(exe2.run(program=prog, feed=f,
+                              fetch_list=fetches)[0])
+    np.testing.assert_array_equal(got, want)
