@@ -44,7 +44,7 @@ from . import parameters  # noqa: F401
 from . import plot  # noqa: F401
 from . import pooling  # noqa: F401
 from . import trainer  # noqa: F401
-from .. import dataset  # noqa: F401
+from . import dataset  # noqa: F401  (v2 alias package)
 from .. import image  # noqa: F401
 from .. import reader  # noqa: F401
 from .inference import infer  # noqa: F401

@@ -1,0 +1,20 @@
+"""`paddle.v2.dataset` import-path alias (reference:
+python/paddle/v2/dataset/__init__.py — the v2 era's home of the dataset
+package before it moved to `paddle.dataset`). Both spellings resolve to
+the same modules here, so `import paddle_tpu.v2.dataset.mnist` and
+`from paddle_tpu.v2.dataset import imdb` work like the reference. The
+alias enumerates the base package's modules at import time, so a
+dataset added there is automatically importable under both paths."""
+
+import sys
+import types
+
+from ... import dataset as _base
+
+__all__ = []
+for _name, _mod in sorted(vars(_base).items()):
+    if isinstance(_mod, types.ModuleType) and \
+            _mod.__name__.startswith('paddle_tpu.dataset.'):
+        sys.modules[__name__ + '.' + _name] = _mod
+        globals()[_name] = _mod
+        __all__.append(_name)

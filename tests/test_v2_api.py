@@ -252,3 +252,14 @@ def test_plot_and_reader_creators(tmp_path, monkeypatch):
     write_recordio(rp, [(1,), (2,)])
     raw = list(creator.recordio(rp)())
     assert len(raw) == 2 and all(isinstance(r, bytes) for r in raw)
+
+
+def test_v2_dataset_import_paths():
+    """Both reference spellings work and resolve to the SAME modules:
+    paddle.v2.dataset.mnist (v2 era) and paddle.dataset.mnist."""
+    import paddle_tpu.dataset.mnist as base_mnist
+    import paddle_tpu.v2.dataset.mnist as v2_mnist
+    from paddle_tpu.v2.dataset import imdb, uci_housing  # noqa: F401
+    assert v2_mnist is base_mnist
+    import paddle_tpu.v2 as v2
+    assert v2.dataset.mnist is base_mnist
