@@ -126,3 +126,19 @@ def _sequence_erase(ctx):
     for tok in tokens:
         mask = mask & (x != tok)
     ctx.set_output('Out', jnp.where(mask, x, jnp.zeros_like(x)))
+
+
+@register('kmax_seq_score')
+def _kmax_seq_score(ctx):
+    """Top-k indices over the time axis of [B, T] scores; positions
+    past each row's Length are masked to -1e9 first (v1
+    kmax_seq_score_layer runs on beam log-probs — negative — so an
+    unmasked pad zero would win every top-k)."""
+    x = ctx.input('X').astype(jnp.float32)
+    k = ctx.attr('beam_size', 1)
+    if ctx.has_input('Length'):
+        length = ctx.input('Length').reshape(-1, 1).astype(jnp.int32)
+        alive = jnp.arange(x.shape[1])[None, :] < length
+        x = jnp.where(alive, x, -1e9)
+    _scores, idx = jax.lax.top_k(x, k)
+    ctx.set_output('Out', idx.astype(jnp.int64))

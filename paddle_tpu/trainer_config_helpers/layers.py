@@ -46,6 +46,10 @@ __all__ = [
     'tensor_layer', 'dot_prod_layer', 'out_prod_layer', 'row_conv_layer',
     'crop_layer', 'conv_shift_layer', 'gated_unit_layer',
     'linear_comb_layer', 'convex_comb_layer',
+    'block_expand_layer', 'priorbox_layer', 'cross_channel_norm_layer',
+    'detection_output_layer', 'multibox_loss_layer',
+    'kmax_seq_score_layer', 'seq_slice_layer', 'sub_seq_layer',
+    'switch_order_layer', 'scale_shift_layer', 'resize_layer',
     'square_error_cost', 'regression_cost', 'classification_cost',
     'cross_entropy', 'multi_binary_label_cross_entropy', 'sum_cost',
     'rank_cost', 'huber_regression_cost', 'huber_classification_cost',
@@ -655,6 +659,181 @@ def linear_comb_layer(weights, vectors, size=None, name=None,
 convex_comb_layer = linear_comb_layer
 
 
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=1,
+                       stride_y=1, padding_x=0, padding_y=0,
+                       num_channels=None, name=None, layer_attr=None):
+    """v1 block_expand -> fluid im2sequence (same im2col semantics)."""
+    return _fl.im2sequence(
+        input=_maybe_image(input, num_channels),
+        filter_size=[block_y, block_x], stride=[stride_y, stride_x],
+        padding=[padding_y, padding_x])
+
+
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=None, name=None):
+    box, var = _fl.prior_box(
+        input=input, image=image, min_sizes=list(min_size),
+        max_sizes=list(max_size) if max_size else None,
+        aspect_ratios=list(aspect_ratio), variance=list(variance))
+    # flatten [H, W, P, 4] -> [N, 4]: the box_coder/iou consumers index
+    # priors per row (multi_box_head does the same reshape)
+    return _fl.reshape(box, [-1, 4]), _fl.reshape(var, [-1, 4])
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None):
+    """L2 norm across channels with a learned per-channel scale (the
+    SSD conv4_3 norm; gserver CrossChannelNormLayer)."""
+    normed = _fl.l2_normalize(input, axis=1)
+    c = int(input.shape[1])
+    scale = _fl.create_parameter(shape=[c], dtype='float32',
+                                 attr=_pa(param_attr))
+    return _fl.elementwise_mul(normed, _fl.reshape(scale, [1, c, 1, 1]))
+
+
+def _cat_heads(x):
+    """v1 passes one loc/conf layer per feature map as a list; concat
+    along the prior axis (entries must already be [B, P_i, ...], the
+    shape the fluid detection stack consumes)."""
+    if isinstance(x, (list, tuple)):
+        return _fl.concat(list(x), axis=1)
+    return x
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None):
+    from ..layers import detection as _det
+    input_loc = _cat_heads(input_loc)
+    input_conf = _cat_heads(input_conf)
+    return _det.detection_output(
+        loc=input_loc, scores=input_conf, prior_box=priorbox[0]
+        if isinstance(priorbox, (list, tuple)) else priorbox,
+        prior_box_var=priorbox[1]
+        if isinstance(priorbox, (list, tuple)) else None,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, score_threshold=confidence_threshold,
+        background_label=background_id)
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None,
+                        gt_box=None):
+    """v1 multibox loss -> fluid ssd_loss. Divergences: the v1
+    DataProvider packed (label, box) together — pass gt_box explicitly;
+    neg_overlap is accepted for config compatibility but fluid's
+    per-prediction matching has no separate negative-overlap knob (a
+    warning is emitted when a non-default value would be dropped)."""
+    import warnings
+
+    from ..layers import detection as _det
+    if gt_box is None:
+        raise ValueError(
+            'multibox_loss_layer: pass gt_box= (ground-truth boxes '
+            '[B, G, 4]). The v1 DataProvider packed boxes with the '
+            'label slot; this framework feeds them as a separate '
+            'data layer (see models/ssd.py).')
+    if neg_overlap != 0.5:
+        warnings.warn('multibox_loss_layer: neg_overlap=%r has no fluid '
+                      'equivalent and is ignored (hard-negative mining '
+                      'uses neg_pos_ratio only)' % (neg_overlap,))
+    input_loc = _cat_heads(input_loc)
+    input_conf = _cat_heads(input_conf)
+    pb = priorbox[0] if isinstance(priorbox, (list, tuple)) else priorbox
+    pbv = priorbox[1] if isinstance(priorbox, (list, tuple)) else None
+    return _det.ssd_loss(
+        location=input_loc, confidence=input_conf, gt_box=gt_box,
+        gt_label=label, prior_box=pb, prior_box_var=pbv,
+        overlap_threshold=overlap_threshold,
+        neg_pos_ratio=neg_pos_ratio, background_label=background_id)
+
+
+def kmax_seq_score_layer(input, name=None, beam_size=1):
+    """Top-k scores over the time axis -> indices; padded positions
+    are masked to -inf through the data layer's length var (v1 uses
+    this on beam log-probs, which are negative — an unmasked pad zero
+    would win every top-k)."""
+    x = input
+    if x.shape and len(x.shape) == 3 and x.shape[-1] == 1:
+        x = _fl.squeeze(x, axes=[2])
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper('kmax_seq_score')
+    idx = helper.create_variable_for_type_inference('int64')
+    if x.shape is not None:
+        idx.shape = (x.shape[0], beam_size)
+    inputs = {'X': [x]}
+    lv = _len_of(input)
+    if lv is not None:
+        inputs['Length'] = [lv]
+    helper.append_op(type='kmax_seq_score', inputs=inputs,
+                     outputs={'Out': [idx]},
+                     attrs={'beam_size': beam_size})
+    return idx
+
+
+def seq_slice_layer(input, starts, ends, name=None):
+    """v1 slice by START/END indices, END INCLUSIVE (gserver
+    SequenceSliceLayer.cpp:151-156: seqLen = end - beg + 1)."""
+    if starts is None:
+        starts = 0
+    if ends is None:
+        raise NotImplementedError(
+            'seq_slice_layer(ends=None) (slice-to-end) needs the per-'
+            'row length; use layers.sequence_slice with an explicit '
+            'length computed from the data layer\'s <name>_len var')
+    if not isinstance(starts, int) or not isinstance(ends, int):
+        raise NotImplementedError(
+            'seq_slice_layer: v1 accepted per-row index LAYERS for '
+            'starts/ends; the shim supports static ints only — gather '
+            'with layers.sequence_slice / layers.gather for dynamic '
+            'positions')
+    return _fl.sequence_slice(input=input, offset=starts,
+                              length=ends - starts + 1)
+
+
+def sub_seq_layer(input, offsets, sizes, name=None):
+    if not isinstance(offsets, int) or not isinstance(sizes, int):
+        raise NotImplementedError(
+            'sub_seq_layer: v1 accepted per-row offset/size LAYERS; '
+            'the shim supports static ints only — use '
+            'layers.sequence_slice / layers.gather for dynamic forms')
+    return _fl.sequence_slice(input=input, offset=offsets, length=sizes)
+
+
+def switch_order_layer(input, reshape_axis=None, name=None):
+    """v1 switch_order: NCHW -> NHWC (channels to last). Only the
+    default axis grouping is shimmed; other reshape_axis values raise
+    rather than silently diverge — compose layers.transpose +
+    layers.reshape for custom groupings."""
+    if reshape_axis not in (None, 1):
+        raise NotImplementedError(
+            'switch_order_layer(reshape_axis=%r): only the default '
+            'channels-last grouping is shimmed; use layers.transpose '
+            '+ layers.reshape' % (reshape_axis,))
+    n = len(input.shape)
+    perm = [0] + list(range(2, n)) + [1]
+    return _fl.transpose(input, perm)
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None):
+    """y = w * x + b with scalar learned w (and optional b)."""
+    w = _fl.create_parameter(shape=[1], dtype='float32',
+                             attr=_pa(param_attr))
+    out = _fl.elementwise_mul(input, w)
+    if bias_attr is not False:
+        b = _fl.create_parameter(shape=[1], dtype='float32',
+                                 attr=_pa(bias_attr)
+                                 if bias_attr is not None else None,
+                                 is_bias=True)
+        out = _fl.elementwise_add(out, b)
+    return out
+
+
+def resize_layer(input, size, name=None):
+    return _fl.reshape(input, [-1, size])
+
+
 # ---------------------------------------------------------------- costs
 
 def square_error_cost(input, label, name=None, weight=None,
@@ -812,22 +991,11 @@ _FLUID_EQUIV = {
     'beam_search': 'layers.beam_search (decode ops)',
     'get_output_layer': 'the tuple returns of fluid layers',
     'selective_fc_layer': 'layers.fc + masking',
-    'block_expand_layer': 'layers.im2sequence',
-    'kmax_seq_score_layer': 'layers.topk',
     'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
-    'sub_seq_layer': 'layers.sequence_slice',
-    'seq_slice_layer': 'layers.sequence_slice',
     'factorization_machine': 'wide_deep model (models/wide_deep.py)',
-    'priorbox_layer': 'layers.prior_box',
-    'multibox_loss_layer': 'layers.ssd_loss',
-    'detection_output_layer': 'layers.detection_output',
-    'cross_channel_norm_layer': 'layers.l2_normalize(axis=1)',
     'img_conv3d_layer': 'layers.conv3d lowering (ops/conv_ops.py)',
     'img_pool3d_layer': 'layers.pool2d pattern over 3d',
-    'scale_shift_layer': 'layers.scale',
     'scale_sub_region_layer': 'layers.crop + scale + paste',
-    'resize_layer': 'layers.reshape',
-    'switch_order_layer': 'layers.transpose',
     'gru_step_layer': 'layers.gru_unit',
     'gru_step_naive_layer': 'layers.gru_unit',
     'lstm_step_layer': 'layers.lstm_unit',

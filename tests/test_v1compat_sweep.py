@@ -214,3 +214,82 @@ def test_v1_classification_error_evaluator():
     o, = _run([err], {'p': np.eye(5,dtype='f')[:3], 'l': np.array([[0],[1],[3]],'i8')})
     np.testing.assert_allclose(float(o), 1/3, rtol=1e-4)
 
+
+
+def test_v1_block_expand_layer():
+    img = v1.data_layer(name='im', size=1 * 6 * 6)
+    be = v1.block_expand_layer(img, block_x=2, block_y=2, stride_x=2,
+                               stride_y=2, num_channels=1)
+    o, = _run([be], {'im': np.arange(36, dtype='f').reshape(1, 36)})
+    assert o.shape == (9, 4)
+
+
+def test_v1_channel_and_order_layers():
+    img = v1.data_layer(name='im', size=4 * 3 * 3)
+    x = v1.img_conv_layer(img, 3, 4, num_channels=4, padding=1)
+    cn = v1.cross_channel_norm_layer(x)
+    so = v1.switch_order_layer(x)
+    ss = v1.scale_shift_layer(v1.data_layer(name='z', size=5))
+    rz = v1.resize_layer(x, 12)
+    o1, o2, o3, o4 = _run([cn, so, ss, rz],
+                          {'im': np.random.rand(2, 36).astype('f'),
+                           'z': np.ones((2, 5), 'f')})
+    assert o1.shape == (2, 4, 3, 3)
+    # per-pixel channel vectors are unit-norm before the learned scale
+    assert o2.shape == (2, 3, 3, 4) and o3.shape == (2, 5)
+    assert o4.shape == (6, 12)
+
+
+def test_v1_seq_slice_and_kmax():
+    sq = v1.data_layer(name='s', size=3, seq_type=1)
+    sl = v1.seq_slice_layer(sq, starts=1, ends=2)
+    km = v1.kmax_seq_score_layer(
+        v1.data_layer(name='sc', size=1, seq_type=1), beam_size=2)
+    # row 0 has only 2 real (negative, beam-log-prob-like) scores and a
+    # zero pad slot: masking must keep the pad slot OUT of the top-k
+    o, k = _run([sl, km],
+                {'s': np.arange(24, dtype='f').reshape(2, 4, 3),
+                 's_len': np.array([4, 4], 'i4'),
+                 'sc': np.array([[-0.5, -0.2, 0.0],
+                                 [-0.8, -0.2, -0.3]], 'f')[..., None],
+                 'sc_len': np.array([2, 3], 'i4')})
+    assert o.shape == (2, 2, 3)
+    np.testing.assert_array_equal(k, [[1, 0], [1, 2]])
+
+
+def test_v1_ssd_detection_shims():
+    """priorbox/multibox_loss/detection_output through the v1 shim:
+    priors flattened to [N, 4], heads accepted as lists, nonzero loss
+    on a prior-scaled gt box, and the gt_box divergence raises a clear
+    error instead of dying inside iou_similarity."""
+    import pytest
+    img = v1.data_layer(name='im', size=3 * 32 * 32)
+    image4 = v1.img_conv_layer(img, 3, 8, num_channels=3, padding=1)
+    feat = v1.img_pool_layer(image4, pool_size=2, stride=2)
+    pb = v1.priorbox_layer(feat, image4, aspect_ratio=[2.0],
+                           variance=[0.1, 0.1, 0.2, 0.2], min_size=[10],
+                           max_size=[20])
+    ppc, n_priors = 2, 16 * 16 * 2
+    loc = fluid.layers.reshape(
+        fluid.layers.transpose(
+            v1.img_conv_layer(feat, 3, ppc * 4, padding=1),
+            [0, 2, 3, 1]), [-1, n_priors, 4])
+    conf = fluid.layers.reshape(
+        fluid.layers.transpose(
+            v1.img_conv_layer(feat, 3, ppc * 5, padding=1),
+            [0, 2, 3, 1]), [-1, n_priors, 5])
+    gt_box = fluid.layers.data(name='gt', shape=[1, 4], dtype='float32')
+    gt_lbl = fluid.layers.data(name='gl', shape=[1], dtype='int64')
+    # list-of-heads form (one per feature map in real v1 configs)
+    loss = v1.multibox_loss_layer([loc], [conf], pb, gt_lbl,
+                                  num_classes=5, gt_box=gt_box)
+    out = v1.detection_output_layer([loc], [conf], pb, num_classes=5)
+    cost = fluid.layers.reduce_mean(loss)
+    rng = np.random.RandomState(0)
+    feed = {'im': rng.rand(1, 3 * 32 * 32).astype('f'),
+            'gt': np.array([[[0.35, 0.35, 0.65, 0.65]]], 'f'),
+            'gl': np.array([[2]], 'int64')}
+    l, o = _run([cost, out], feed)
+    assert np.isfinite(l).all() and float(l) > 0
+    with pytest.raises(ValueError, match='gt_box'):
+        v1.multibox_loss_layer(loc, conf, pb, gt_lbl, num_classes=5)
