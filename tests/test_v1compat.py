@@ -277,3 +277,34 @@ def test_simple_attention_shapes_and_sharing():
                         'st': st})
     assert np.asarray(o1).shape == (3, 8)
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-5)
+
+
+def test_v1_layers_under_v2_trainer():
+    """The reference's own composition: v2's trainer drives a cost
+    built from trainer_config_helpers layers (v2.layer was a re-export
+    shell over them). Here both surfaces share the fluid IR, so the v1
+    config trains through paddle.trainer.SGD unchanged."""
+    import paddle_tpu.v2 as paddle
+    x = data_layer(name='x', size=13)
+    y = data_layer(name='y', size=1)
+    pred = fc_layer(input=x, size=1, act=LinearActivation())
+    cost = regression_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    w_true = np.random.RandomState(0).randn(13, 1).astype('f')
+
+    def reader():
+        rng = np.random.RandomState(1)
+        for _ in range(40):
+            xs = rng.randn(13).astype('f')
+            yield xs, (xs @ w_true + 0.5).astype('f')
+
+    events = []
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.01),
+        place=fluid.CPUPlace())
+    trainer.train(reader=paddle.batch(reader, 20), num_passes=30,
+                  event_handler=events.append, feeding={'x': 0, 'y': 1})
+    ends = [e for e in events if isinstance(e, paddle.event.EndIteration)]
+    assert ends[-1].cost < ends[0].cost * 0.1
