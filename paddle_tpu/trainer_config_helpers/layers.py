@@ -34,7 +34,9 @@ __all__ = [
     'dotmul_operator',
     'pooling_layer', 'last_seq', 'first_seq', 'expand_layer',
     'repeat_layer', 'seq_reshape_layer', 'seq_concat_layer',
-    'lstmemory', 'grumemory', 'recurrent_layer',
+    'lstmemory', 'grumemory', 'recurrent_layer', 'gru_step_layer',
+    'gru_step_naive_layer', 'lstm_step_layer', 'get_output_layer',
+    'slice_projection',
     'img_conv_layer', 'img_pool_layer', 'batch_norm_layer',
     'img_cmrnorm_layer', 'maxout_layer', 'spp_layer', 'pad_layer',
     'roi_pool_layer', 'bilinear_interp_layer',
@@ -102,6 +104,15 @@ def _apply_act(x, act):
 
 def _pa(attr):
     return to_fluid_param_attr(attr)
+
+
+def _act_or(act, default):
+    """Activation name with a default for UNSPECIFIED only: an explicit
+    LinearActivation()/IdentityActivation() (whose v1 name is None)
+    maps to 'identity', not to the default nonlinearity."""
+    if act is None:
+        return default
+    return _act_name(act) or 'identity'
 
 
 def _propagate_len(src, out):
@@ -219,6 +230,11 @@ class _Projection(object):
         if self.kind == 'context':
             return _context_concat(x, self.kw['context_start'],
                                    self.kw['context_len'])
+        if self.kind == 'slices':
+            ax = len(x.shape) - 1
+            parts = [_fl.slice(x, axes=[ax], starts=[b], ends=[e])
+                     for b, e in self.kw['slices']]
+            return _fl.concat(parts, axis=-1)
         raise NotImplementedError(self.kind)
 
 
@@ -370,9 +386,9 @@ def lstmemory(input, size=None, name=None, reverse=False, act=None,
     in_dim = int(input.shape[-1])
     hidden, _ = _fl.dynamic_lstm(
         input=input, size=in_dim, is_reverse=reverse,
-        gate_activation=_act_name(gate_act) or 'sigmoid',
-        cell_activation=_act_name(state_act) or 'tanh',
-        candidate_activation=_act_name(act) or 'tanh',
+        gate_activation=_act_or(gate_act, 'sigmoid'),
+        cell_activation=_act_or(state_act, 'tanh'),
+        candidate_activation=_act_or(act, 'tanh'),
         param_attr=_pa(param_attr), bias_attr=_pa(bias_attr),
         length=_len_of(input))
     return _propagate_len(input, hidden)
@@ -385,8 +401,8 @@ def grumemory(input, size=None, name=None, reverse=False, act=None,
     in_dim = int(input.shape[-1])
     out = _fl.dynamic_gru(
         input=input, size=in_dim // 3, is_reverse=reverse,
-        gate_activation=_act_name(gate_act) or 'sigmoid',
-        candidate_activation=_act_name(act) or 'tanh',
+        gate_activation=_act_or(gate_act, 'sigmoid'),
+        candidate_activation=_act_or(act, 'tanh'),
         param_attr=_pa(param_attr), bias_attr=_pa(bias_attr),
         length=_len_of(input))
     return _propagate_len(input, out)
@@ -398,11 +414,60 @@ def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
     time axis (reference recurrent_layer; fluid has no direct analog so
     it is built from the rnn scan op)."""
     from ..layers.rnn import simple_rnn
-    out = simple_rnn(input, act=_act_name(act) or 'tanh',
+    out = simple_rnn(input, act=_act_or(act, 'tanh'),
                      is_reverse=reverse, param_attr=_pa(param_attr),
                      bias_attr=_pa(bias_attr) if bias_attr is not None
                      else None, length=_len_of(input))
     return _propagate_len(input, out)
+
+
+def gru_step_layer(input, output_mem, size=None, act=None,
+                   name=None, gate_act=None, param_attr=None,
+                   bias_attr=None, layer_attr=None):
+    """One GRU step (inside a user-managed recurrence): input is the
+    3*size pre-projection, output_mem the previous hidden state."""
+    new_h, _, _ = _fl.gru_unit(
+        input, output_mem, size=3 * int(output_mem.shape[-1]),
+        activation=_act_or(act, 'tanh'),
+        gate_activation=_act_or(gate_act, 'sigmoid'),
+        param_attr=_pa(param_attr), bias_attr=_pa(bias_attr))
+    return new_h
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+def lstm_step_layer(input, state, size=None, act=None, name=None,
+                    gate_act=None, state_act=None, bias_attr=None,
+                    layer_attr=None):
+    """One LSTM step: `state` is the previous cell state, `input` the
+    4*size gate pre-projection CONCATENATED with the previous hidden
+    in v1; here pass (hidden, cell) via fluid lstm_unit instead —
+    divergence: returns (new_hidden, new_cell)."""
+    raise NotImplementedError(
+        'lstm_step_layer: use layers.lstm_unit(x_t, hidden_prev, '
+        'cell_prev) — the fluid step form carries hidden AND cell '
+        'explicitly instead of v1\'s state-pair aggregation')
+
+
+def get_output_layer(input, arg_name, name=None, layer_attr=None):
+    """v1 selected a named secondary output of a layer. Fluid layers
+    return their outputs directly, and the shimmed lstmemory returns
+    only the hidden sequence — so selecting the cell ('state') here
+    cannot be the identity; it raises with the fluid route instead."""
+    if arg_name in ('state', 'cell'):
+        raise NotImplementedError(
+            "get_output_layer(arg_name=%r): use layers.dynamic_lstm "
+            "directly — it returns (hidden, cell) as a tuple" % arg_name)
+    return input
+
+
+def slice_projection(input, slices):
+    """(begin, end) feature-axis slices CONCATENATED (v1 semantics) —
+    one projection, so mixed_layer treats the concat as a single term
+    rather than summing the slices."""
+    return _Projection('slices', input, sum(e - b for b, e in slices),
+                       slices=list(slices))
 
 
 # ---------------------------------------------------------------- image
@@ -989,17 +1054,12 @@ _FLUID_EQUIV = {
     'recurrent_group': 'fluid DynamicRNN / layers.rnn',
     'memory': 'DynamicRNN.memory',
     'beam_search': 'layers.beam_search (decode ops)',
-    'get_output_layer': 'the tuple returns of fluid layers',
     'selective_fc_layer': 'layers.fc + masking',
     'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
     'factorization_machine': 'wide_deep model (models/wide_deep.py)',
     'img_conv3d_layer': 'layers.conv3d lowering (ops/conv_ops.py)',
     'img_pool3d_layer': 'layers.pool2d pattern over 3d',
     'scale_sub_region_layer': 'layers.crop + scale + paste',
-    'gru_step_layer': 'layers.gru_unit',
-    'gru_step_naive_layer': 'layers.gru_unit',
-    'lstm_step_layer': 'layers.lstm_unit',
-    'slice_projection': 'identity_projection(offset=..., size=...)',
     'conv_projection': 'img_conv_layer',
     'conv_operator': 'img_conv_layer',
     'StaticInput': 'DynamicRNN.static_input',

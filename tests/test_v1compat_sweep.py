@@ -293,3 +293,25 @@ def test_v1_ssd_detection_shims():
     assert np.isfinite(l).all() and float(l) > 0
     with pytest.raises(ValueError, match='gt_box'):
         v1.multibox_loss_layer(loc, conf, pb, gt_lbl, num_classes=5)
+
+
+def test_v1_gru_step_and_slice_projection():
+    x = v1.data_layer(name='x', size=12)   # 3*4 pre-projection
+    h0 = v1.data_layer(name='h', size=4)
+    h1 = v1.gru_step_layer(x, h0)
+    z = v1.data_layer(name='z', size=6)
+    mix = v1.mixed_layer(input=[v1.slice_projection(z, [(0, 2), (4, 6)])],
+                         size=4, bias_attr=False)
+    o1, o2 = _run([h1, mix],
+                  {'x': np.ones((2, 12), 'f'),
+                   'h': np.zeros((2, 4), 'f'),
+                   'z': np.arange(6, dtype='f')[None].repeat(2, 0)})
+    assert o1.shape == (2, 4)
+    # v1 semantics: slices CONCATENATE -> [z0, z1, z4, z5]
+    np.testing.assert_allclose(o2, [[0, 1, 4, 5]] * 2, rtol=1e-5)
+    # get_output_layer passes primary outputs through but refuses the
+    # cell-state selection the shimmed lstmemory cannot serve
+    assert v1.get_output_layer(h1, 'hidden') is h1
+    import pytest
+    with pytest.raises(NotImplementedError, match='dynamic_lstm'):
+        v1.get_output_layer(h1, 'state')
