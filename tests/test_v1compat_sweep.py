@@ -315,3 +315,19 @@ def test_v1_gru_step_and_slice_projection():
     import pytest
     with pytest.raises(NotImplementedError, match='dynamic_lstm'):
         v1.get_output_layer(h1, 'state')
+
+
+def test_v1_linear_activation_is_identity_in_rnn():
+    """An EXPLICIT LinearActivation (v1 name None) must map to
+    'identity', not fall through to the tanh/sigmoid defaults
+    (regression: `_act_name(act) or 'tanh'` conflated the two)."""
+    x = v1.data_layer(name='x', size=3, seq_type=1)
+    h = v1.recurrent_layer(
+        input=x, act=v1.LinearActivation(),
+        param_attr=v1.ParameterAttribute(
+            initializer=fluid.initializer.Constant(0.0)),
+        bias_attr=False)
+    xs = np.array([[[1., -2., 3.], [0.5, 0.5, -4.]]], 'f')
+    o, = _run([h], {'x': xs, 'x_len': np.array([2], 'i4')})
+    # W == 0 -> h_t = act(x_t); identity keeps negatives/magnitudes
+    np.testing.assert_allclose(o, xs, rtol=1e-6)
