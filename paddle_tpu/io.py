@@ -343,14 +343,28 @@ def load_checkpoint(executor, dirname, main_program=None, reader=None):
         for key, fname in (('params_sha1', _PARAMS_FILE),
                            ('manifest_sha1', _MANIFEST_FILE)):
             want = recorded.get(key)
-            if want is not None and \
-                    _sha1_of(os.path.join(dirname, fname)) != want:
+            fpath = os.path.join(dirname, fname)
+            # a recorded-but-missing file is the same torn state as a
+            # sha mismatch (partial delete/copy) — diagnose it here
+            # instead of letting _sha1_of raise a bare FileNotFoundError
+            # (caught too: the file can vanish between exists and read)
+            if want is None:
+                continue
+            try:
+                missing = not os.path.exists(fpath)
+                mismatch = (not missing) and _sha1_of(fpath) != want
+            except FileNotFoundError:
+                missing, mismatch = True, False
+            if missing or mismatch:
+                reason = 'is missing' if missing else \
+                    'does not match the sha1 recorded in checkpoint.json'
                 raise ValueError(
-                    'load_checkpoint: %r is torn — %s does not match '
-                    'the sha1 recorded in checkpoint.json (a save was '
-                    'interrupted between renames). Restore from an '
-                    'older checkpoint; resuming here would pair weights '
-                    'with the wrong step/reader state.' % (dirname, fname))
+                    'load_checkpoint: %r is a torn/incomplete checkpoint '
+                    '— %s %s (a save was interrupted between renames, or '
+                    'the directory was partially copied). Restore from '
+                    'an older checkpoint; resuming here would pair '
+                    'weights with the wrong step/reader state.'
+                    % (dirname, fname, reason))
     load_persistables(executor, dirname, main_program)
     if not os.path.exists(path):
         if reader is not None:

@@ -150,8 +150,26 @@ def data_layer(name, size, depth=None, height=None, width=None,
 
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None):
+    # Seq-ness must be read off the ORIGINAL inputs: the concat below
+    # produces a fresh Variable with no _v2_len_var, so deciding
+    # num_flatten_dims from it would treat a [B,T,D] concat of
+    # sequences as a flat [B,D] matrix (negative fan-in in Xavier).
     if isinstance(input, (list, tuple)):
-        input = _fl.concat([_flatten2(v) for v in input], axis=-1)
+        seq_src = next((v for v in input if _is_seq(v)), None)
+        if seq_src is not None and not all(_is_seq(v) for v in input):
+            raise ValueError(
+                'fc_layer: mixed sequence and non-sequence inputs — the '
+                'v1 contract is that all inputs to one layer share a '
+                'sequence layout. expand_layer the flat input over time '
+                '(or pool the sequence) first.')
+        # v1 contract: all sequence inputs to one layer share the SAME
+        # layout; the first input's length var stands for all of them
+        # (feeding mismatched per-row lengths is a config error the
+        # reference also only caught at runtime).
+        input = _fl.concat(
+            [v if _is_seq(v) else _flatten2(v) for v in input], axis=-1)
+        if seq_src is not None:
+            _propagate_len(seq_src, input)
     out = _fl.fc(input=input, size=size, act=_act_name(act),
                  param_attr=_pa(param_attr), bias_attr=_pa(bias_attr)
                  if bias_attr is not None else None, name=name,

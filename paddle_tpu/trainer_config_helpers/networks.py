@@ -78,8 +78,45 @@ def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
 
 
 simple_gru2 = simple_gru
-gru_unit = simple_gru
-gru_group = simple_gru
+
+
+def gru_group(input, memory_boot=None, size=None, name=None, reverse=False,
+              gru_bias_attr=None, gru_param_attr=None, act=None,
+              gate_act=None, gru_layer_attr=None, naive=False):
+    """Reference networks.py:1002 gru_group: a GRU over an ALREADY
+    3*size-projected input (it asserts input.size % 3 == 0 and defaults
+    size to input.size/3) — unlike simple_gru, which adds the projection
+    itself. grumemory consumes exactly that pre-projected form."""
+    if memory_boot is not None:
+        raise NotImplementedError(
+            'gru_group(memory_boot=...): custom boot state needs the '
+            'recurrent_group machinery; use fluid DynamicRNN with '
+            'memory(init=...) instead')
+    in_dim = int(input.shape[-1])
+    if in_dim % 3 != 0:
+        raise ValueError(
+            'gru_group input width %d is not divisible by 3 — the input '
+            'must already carry the 3*size gate projection (use '
+            'simple_gru to have the projection added for you)' % in_dim)
+    if size is not None and size * 3 != in_dim:
+        raise ValueError(
+            'gru_group: size=%d but input width %d != 3*size' % (size,
+                                                                 in_dim))
+    return grumemory(input, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr,
+                     bias_attr=gru_bias_attr)
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None,
+             gru_bias_attr=None, gru_param_attr=None, act=None,
+             gate_act=None, gru_layer_attr=None, naive=False):
+    """Reference networks.py:940 gru_unit — the single-step form used
+    inside recurrent_group; over a whole sequence it computes what
+    gru_group does, so the shim shares that path."""
+    return gru_group(input, memory_boot=memory_boot, size=size, name=name,
+                     gru_bias_attr=gru_bias_attr,
+                     gru_param_attr=gru_param_attr, act=act,
+                     gate_act=gate_act, naive=naive)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False, **kwargs):

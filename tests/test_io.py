@@ -175,3 +175,17 @@ def test_torn_checkpoint_detected(tmp_path):
     fluid.io.save_persistables(exe, str(tmp_path))
     with pytest.raises(ValueError, match='torn'):
         fluid.io.load_checkpoint(exe, str(tmp_path))
+
+
+def test_missing_recorded_file_is_torn_not_filenotfound(tmp_path):
+    """ADVICE r4 #3: checkpoint.json present but a recorded file missing
+    (partial delete/copy) must produce the torn-checkpoint diagnostic,
+    not a raw FileNotFoundError from the sha1 pass."""
+    import os
+    import pytest
+    exe = fluid.Executor(fluid.CPUPlace())
+    _build_and_train(exe, steps=2)
+    fluid.io.save_checkpoint(exe, str(tmp_path), step=2)
+    os.remove(os.path.join(str(tmp_path), 'params.npz'))
+    with pytest.raises(ValueError, match='torn|incomplete'):
+        fluid.io.load_checkpoint(exe, str(tmp_path))

@@ -308,3 +308,48 @@ def test_v1_layers_under_v2_trainer():
                   event_handler=events.append, feeding={'x': 0, 'y': 1})
     ends = [e for e in events if isinstance(e, paddle.event.EndIteration)]
     assert ends[-1].cost < ends[0].cost * 0.1
+
+
+def test_fc_layer_multi_input_sequences():
+    """ADVICE r4 #1: fc_layer over a LIST of sequence inputs must stay a
+    sequence op — num_flatten_dims from the original inputs (the concat
+    Variable has no len var), and the output keeps the length var so a
+    downstream last_seq still masks correctly."""
+    from paddle_tpu.trainer_config_helpers.layers import _len_of
+    a = data_layer(name='seq_a', size=6, seq_type=1)
+    b = data_layer(name='seq_b', size=4, seq_type=1)
+    emb_a = fc_layer(input=a, size=6, act=TanhActivation())
+    emb_b = fc_layer(input=b, size=4, act=TanhActivation())
+    out = fc_layer(input=[emb_a, emb_b], size=5)  # crashed pre-fix
+    assert _len_of(out) is not None
+    pooled = last_seq(input=out)
+    xs_a = np.random.RandomState(0).randn(3, 7, 6).astype('float32')
+    xs_b = np.random.RandomState(1).randn(3, 7, 4).astype('float32')
+    lens = np.array([7, 4, 6], 'int32')
+    _, (o_seq, o_last) = _run(
+        [out, pooled],
+        {'seq_a': xs_a, 'seq_a_len': lens,
+         'seq_b': xs_b, 'seq_b_len': lens})
+    assert np.asarray(o_seq).shape == (3, 7, 5)
+    # last_seq honors the per-row length, proving the len var survived
+    np.testing.assert_allclose(np.asarray(o_last)[1],
+                               np.asarray(o_seq)[1, 3], rtol=1e-5)
+
+
+def test_gru_unit_consumes_preprojected_input():
+    """ADVICE r4 #2: reference networks.py gru_unit/gru_group consume an
+    already-projected 3*size input (size defaults to width//3) — they
+    must NOT add another fc projection like simple_gru does."""
+    from paddle_tpu.trainer_config_helpers import gru_group, gru_unit
+    x = data_layer(name='xg', size=12, seq_type=1)
+    out = gru_unit(input=x)  # size inferred = 4; crashed pre-fix (None*3)
+    g = fluid.default_main_program().global_block()
+    # exactly one GRU recurrence and NO fc/mul projection op before it
+    ops = [op.type for op in g.ops]
+    assert 'gru' in ops
+    assert not any(t in ('fc', 'mul', 'matmul') for t in ops)
+    xs = np.random.RandomState(0).randn(2, 5, 12).astype('float32')
+    _, (o,) = _run([out], {'xg': xs, 'xg_len': np.array([5, 3], 'int32')})
+    assert np.asarray(o).shape == (2, 5, 4)
+    with pytest.raises(ValueError, match='3'):
+        gru_group(input=data_layer(name='xg2', size=10, seq_type=1))
