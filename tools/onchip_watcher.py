@@ -1,5 +1,5 @@
-"""Relay watcher: probe the tunneled TPU, drain a workload queue on
-recovery.
+"""Relay watcher: probe the tunneled TPU, drain the bench queue on
+recovery into the shared results store.
 
 The axon relay is intermittent (SURVEY §5.0/§7.14: up ~35 min one
 session, down 10 h the next, and it can answer a probe then hang
@@ -8,12 +8,15 @@ numbers without a human in the loop: every --interval seconds it
 launches a subprocess that jits a trivial matmul (timeout --probe-s;
 np.asarray sync — block_until_ready returns at enqueue on the relay);
 when the probe passes it runs the next pending workload from QUEUE,
-each in its own watchdogged subprocess, and appends one JSON line per
-attempt to --out (ONCHIP_r04.jsonl at the repo root by default).
-A workload that times out or errors is retried on a later recovery,
-up to --retries attempts; between workloads the probe re-runs so a
-mid-drain relay death stops the queue instead of burning every
-workload's timeout against a dead chip.
+each in its own watchdogged subprocess via bench._run_workload, and
+appends one record per attempt to the SHARED store (ONCHIP_r05.jsonl —
+the same resumable queue file bench.py's driver run reads and writes,
+provenance-tagged 'watcher'). A workload that already has an ok record
+in the store is skipped, so watcher restarts and driver runs compose
+instead of re-measuring. Failures retry on a later recovery, up to
+--retries attempts; between workloads the probe re-runs so a mid-drain
+relay death stops the queue instead of burning every workload's
+timeout against a dead chip.
 
 Run: nohup python tools/onchip_watcher.py &   (stdout is the ledger)
 """
@@ -26,89 +29,70 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-PROBE_SRC = ("import jax, jax.numpy as jnp, numpy as np;"
-             "x = jnp.ones((256, 256), jnp.bfloat16);"
-             "y = jax.jit(lambda a: a @ a)(x);"
-             "np.asarray(y.astype(jnp.float32));"
-             "print('PROBE_OK', flush=True)")
+import bench  # noqa: E402  (repo-root bench.py: _run_workload + store)
 
-# (name, argv, timeout_s) — argv runs from the repo root
+# (key, workload, extra_env, timeout_s) — VERDICT r4 next-#1 priority:
+# headline pair, fused-CE A/B, s2d A/B, anatomy, MoE sweep, the fixed
+# attention microbench; then the rest of the ablation table.
 QUEUE = [
+    ('transformer', 'transformer', None, 500),
+    ('resnet50', 'resnet50', None, 500),
+    ('transformer_seq512_masked', 'transformer_seq512_masked', None, 600),
+    ('transformer_seq512_masked_pallas', 'transformer_seq512_masked',
+     {'PADDLE_TPU_USE_PALLAS': '1'}, 600),
+    ('transformer_naive_ce', 'transformer',
+     {'PADDLE_TPU_FUSED_CE': '0'}, 500),
+    ('resnet50_s2d_stem', 'resnet50', {'PADDLE_TPU_CONV_S2D': '1'}, 500),
+    ('resnet50_bn_pallas', 'resnet50', {'PADDLE_TPU_BN_PALLAS': '1'}, 500),
+    ('resnet50_anatomy', 'resnet50_anatomy', None, 900),
+    ('moe_cap1.0', 'moe_cap1.0', None, 600),
+    ('moe_cap1.25', 'moe_cap1.25', None, 600),
+    ('moe_cap2.0', 'moe_cap2.0', None, 600),
+    ('attention_microbench', 'attention_microbench', None, 900),
+    ('transformer_seq1024', 'transformer_seq1024', None, 600),
+    ('transformer_seq1024_pallas', 'transformer_seq1024',
+     {'PADDLE_TPU_USE_PALLAS': '1'}, 600),
+    ('resnet50_nchw_ir', 'resnet50',
+     {'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'}, 500),
+    ('resnet50_bn_fp32', 'resnet50',
+     {'PADDLE_TPU_BN_COMPUTE': 'fp32'}, 500),
+    ('transformer_seq4096', 'transformer_seq4096', None, 700),
+    ('transformer_seq4096_pallas', 'transformer_seq4096',
+     {'PADDLE_TPU_USE_PALLAS': '1'}, 700),
+    ('transformer_seq256', 'transformer_seq256', None, 600),
+    ('transformer_big', 'transformer_big', None, 700),
+    ('rnn_lstm', 'rnn_lstm', None, 600),
+    ('pallas_parity', 'pallas_parity', None, 300),
+]
+
+# non-bench tools: (key, argv, timeout) — raw stdout lines stored
+TOOL_QUEUE = [
     ('conv_bwd_microbench',
      [sys.executable, 'tools/conv_bwd_microbench.py', '--inner', '8'], 1500),
-    ('resnet50_anatomy',
-     [sys.executable, 'bench.py', '--workload', 'resnet50_anatomy',
-      '--backend', 'tpu'], 900),
-    ('attention_microbench',
-     [sys.executable, 'bench.py', '--workload', 'attention_microbench',
-      '--backend', 'tpu'], 900),
-    ('transformer_seq256',
-     [sys.executable, 'bench.py', '--workload', 'transformer_seq256',
-      '--backend', 'tpu'], 600),
-    ('moe_cap1.25',
-     [sys.executable, 'bench.py', '--workload', 'moe_cap1.25',
-      '--backend', 'tpu'], 600),
-    ('resnet50_bn_fp32',
-     [sys.executable, 'bench.py', '--workload', 'resnet50',
-      '--backend', 'tpu'], 600, {'PADDLE_TPU_BN_COMPUTE': 'fp32'}),
-    ('resnet50_nchw_ir',
-     [sys.executable, 'bench.py', '--workload', 'resnet50',
-      '--backend', 'tpu'], 600, {'PADDLE_TPU_RESNET_LAYOUT': 'NCHW'}),
-    ('resnet50_s2d_stem',
-     [sys.executable, 'bench.py', '--workload', 'resnet50',
-      '--backend', 'tpu'], 600, {'PADDLE_TPU_CONV_S2D': '1'}),
-    ('transformer_naive_ce',
-     [sys.executable, 'bench.py', '--workload', 'transformer',
-      '--backend', 'tpu'], 600, {'PADDLE_TPU_FUSED_CE': '0'}),
-    ('transformer_fused_ce',
-     [sys.executable, 'bench.py', '--workload', 'transformer',
-      '--backend', 'tpu'], 600),
-    ('transformer_seq4096',
-     [sys.executable, 'bench.py', '--workload', 'transformer_seq4096',
-      '--backend', 'tpu'], 700),
-    ('transformer_seq4096_pallas',
-     [sys.executable, 'bench.py', '--workload', 'transformer_seq4096',
-      '--backend', 'tpu'], 700, {'PADDLE_TPU_USE_PALLAS': '1'}),
-    ('transformer_big',
-     [sys.executable, 'bench.py', '--workload', 'transformer_big',
-      '--backend', 'tpu'], 700),
-    ('rnn_lstm',
-     [sys.executable, 'bench.py', '--workload', 'rnn_lstm',
-      '--backend', 'tpu'], 600),
 ]
 
 
 def probe(timeout):
-    try:
-        r = subprocess.run([sys.executable, '-c', PROBE_SRC],
-                           capture_output=True, text=True, timeout=timeout,
-                           cwd=REPO)
-        return 'PROBE_OK' in (r.stdout or '')
-    except subprocess.TimeoutExpired:
-        return False
+    # one definition of "relay alive" shared with the driver bench run
+    return bench._probe_quick(timeout)
 
 
-def run_one(name, argv, timeout, extra_env=None):
-    env = dict(os.environ)
-    env.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/xla_cache')
-    env.update(extra_env or {})
+def run_tool(name, argv, timeout):
     t0 = time.time()
     try:
         r = subprocess.run(argv, capture_output=True, text=True,
-                           timeout=timeout, cwd=REPO, env=env)
+                           timeout=timeout, cwd=REPO)
         ok = r.returncode == 0
         out = r.stdout or ''
     except subprocess.TimeoutExpired as e:
         ok = False
         out = (e.stdout.decode() if isinstance(e.stdout, bytes)
                else (e.stdout or ''))
-    # keep every RESULT / RESULT_JSON / json line the child printed
-    results = [ln for ln in out.splitlines()
-               if ln.startswith(('RESULT', '{'))]
-    return {'workload': name, 'ok': ok, 'wall_s': round(time.time() - t0, 1),
-            'results': results[-40:],
-            'env': {k: v for k, v in (extra_env or {}).items()}}
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith(('RESULT', '{'))][-40:]
+    return ok, lines, round(time.time() - t0, 1)
 
 
 def main():
@@ -116,46 +100,64 @@ def main():
     p.add_argument('--interval', type=float, default=180)
     p.add_argument('--probe-s', type=float, default=75)
     p.add_argument('--retries', type=int, default=3)
-    p.add_argument('--out', default=os.path.join(REPO, 'ONCHIP_r04.jsonl'))
     args = p.parse_args()
-    attempts = {name: 0 for name, *_ in QUEUE}
-    done = set()
+    # one shared compile cache with bench.py: a workload the watcher got
+    # halfway through compiling finishes instantly on the driver's run
+    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                          '/tmp/paddle_tpu_jax_cache')
+    attempts = {k: 0 for k, *_ in QUEUE + TOOL_QUEUE}
+    done = set(bench.store_load())  # resumable: ok records are final
 
-    def emit(rec):
+    def log(rec):
         rec['ts'] = round(time.time(), 1)
-        with open(args.out, 'a') as f:
-            f.write(json.dumps(rec) + '\n')
         print(json.dumps(rec), flush=True)
 
     def exhausted():
-        return all(item[0] in done or attempts[item[0]] >= args.retries
-                   for item in QUEUE)
+        return all(k in done or attempts[k] >= args.retries
+                   for k, *_ in QUEUE + TOOL_QUEUE)
 
     while not exhausted():
         if not probe(args.probe_s):
             time.sleep(args.interval)
             continue
-        emit({'event': 'relay_up'})
-        for item in QUEUE:
-            name, argv, timeout = item[0], item[1], item[2]
-            extra_env = item[3] if len(item) > 3 else None
-            if name in done or attempts[name] >= args.retries:
+        log({'event': 'relay_up'})
+        for key, workload, extra_env, timeout in QUEUE:
+            if key in done or attempts[key] >= args.retries:
                 continue
-            attempts[name] += 1
-            rec = run_one(name, argv, timeout, extra_env)
-            rec['attempt'] = attempts[name]
-            emit(rec)
-            if rec['ok']:
-                done.add(name)
+            attempts[key] += 1
+            t0 = time.time()
+            val, err = bench._run_workload(workload, 'tpu', False, timeout,
+                                           env=extra_env)
+            bench.store_put(key, workload, 'tpu', value=val,
+                            ok=err is None, env=extra_env,
+                            provenance='watcher', error=err)
+            log({'workload': key, 'ok': err is None,
+                 'wall_s': round(time.time() - t0, 1),
+                 'attempt': attempts[key], 'error': err})
+            if err is None:
+                done.add(key)
             elif not probe(args.probe_s):
-                emit({'event': 'relay_down_mid_drain'})
+                log({'event': 'relay_down_mid_drain'})
                 break
-        # failed-but-retryable workloads go around again; the probe at
-        # the top of the loop rate-limits re-drains while the relay
-        # flaps, and exhausted() is the only terminal condition
+        else:
+            for key, argv, timeout in TOOL_QUEUE:
+                if key in done or attempts[key] >= args.retries:
+                    continue
+                attempts[key] += 1
+                ok, lines, wall = run_tool(key, argv, timeout)
+                bench.store_put(key, key, 'tpu', value=lines, ok=ok,
+                                provenance='watcher',
+                                error=None if ok else 'tool failed')
+                log({'workload': key, 'ok': ok, 'wall_s': wall,
+                     'attempt': attempts[key]})
+                if ok:
+                    done.add(key)
+                elif not probe(args.probe_s):
+                    log({'event': 'relay_down_mid_drain'})
+                    break
         if not exhausted():
             time.sleep(args.interval)
-    emit({'event': 'watcher_exit', 'done': sorted(done)})
+    log({'event': 'watcher_exit', 'done': sorted(done)})
 
 
 if __name__ == '__main__':
