@@ -434,3 +434,80 @@ def _gru_unit(ctx):
     ctx.set_output('Gate', jnp.concatenate([u, r, c], axis=-1))
     ctx.set_output('ResetHiddenPrev', r * h_prev)
     ctx.set_output('Hidden', h)
+
+
+@register('generation_decode')
+def _generation_decode(ctx):
+    """Generic step-function generation for the v1 recurrent_group/
+    beam_search shim (reference trainer_config_helpers/layers.py:4406):
+    the step SUB-BLOCK (an arbitrary v1 step function traced into fluid
+    IR) runs inside ONE lax.scan with beam feedback — beams fold into
+    the batch axis, candidate pruning is the shared beam_search_step,
+    backtrack the shared beam_backtrack. beam_size=1 is greedy (top-1
+    of the same machinery). The reference re-ran the step net per
+    emitted token under its GeneratedInput protocol; here the whole
+    generation compiles into the surrounding XLA program.
+
+    Batch-shaped closure vars the step consumes (StaticInput + their
+    length vars) are declared in attr batch_var_names and beam-expanded
+    once before the scan; parameters broadcast untouched."""
+    from .control_ops import _run_block_ops
+    from .decode_ops import beam_search_step, beam_backtrack
+
+    block = ctx.block.program.block(ctx.attr('sub_block'))
+    memory_names = ctx.attr('memory_names')      # [(pre, cur), ...]
+    id_pre_name = ctx.attr('id_pre_name')
+    prob_name = ctx.attr('prob_name')
+    batch_names = ctx.attr('batch_var_names')
+    t_max = ctx.attr('max_out_len')
+    beam = ctx.attr('beam_size', 1)
+    bos_id = ctx.attr('bos_id', 0)
+    eos_id = ctx.attr('eos_id', 1)
+    n_results = ctx.attr('num_results', beam)
+    boots = ctx.input_list('BootMemories')
+    base_key = ctx.rng_key()
+
+    outer_env = dict(ctx.env)
+    for name in batch_names:
+        if name in outer_env:
+            outer_env[name] = jnp.repeat(outer_env[name], beam, axis=0)
+    b = ctx.input('BatchRef').shape[0]
+
+    mems0 = tuple(jnp.repeat(m, beam, axis=0) for m in boots)
+    last0 = jnp.full((b * beam,), bos_id, jnp.int32)
+    pre_ids0 = jnp.full((b, beam), bos_id, jnp.int32)
+    # only beam slot 0 live at t=0 so the first expansion is unbiased
+    pre_scores0 = jnp.where(jnp.arange(beam)[None, :] == 0, 0.0, -1e9) * \
+        jnp.ones((b, 1), jnp.float32)
+
+    def tick(carry, _):
+        last, pre_ids, pre_scores, mems = carry
+        env = dict(outer_env)
+        env[id_pre_name] = last  # int32 in-graph; x64 is off under jit
+        for (pre, _), mem in zip(memory_names, mems):
+            env[pre] = mem
+        env = _run_block_ops(block, env, base_key, is_test=True)
+        prob = env[prob_name].astype(jnp.float32)        # [B*K, V]
+        logp = jnp.log(jnp.maximum(prob, 1e-20))
+        k = min(beam, prob.shape[-1])
+        top_scores, top_ids = jax.lax.top_k(logp, k)
+        sel_ids, sel_scores, parent = beam_search_step(
+            pre_ids, pre_scores, top_ids.reshape(b, beam, k),
+            top_scores.reshape(b, beam, k), beam, eos_id)
+        new_mems = tuple(
+            jnp.take_along_axis(
+                env[cur].astype(mem.dtype).reshape(
+                    (b, beam) + env[cur].shape[1:]),
+                parent.reshape((b, beam) + (1,) * (env[cur].ndim - 1)),
+                axis=1).reshape((b * beam,) + env[cur].shape[1:])
+            for (_, cur), mem in zip(memory_names, mems))
+        carry = (sel_ids.reshape(-1).astype(jnp.int32), sel_ids,
+                 sel_scores, new_mems)
+        return carry, (sel_ids, parent)
+
+    (_, _, final_scores, _), (step_ids, step_parents) = jax.lax.scan(
+        tick, (last0, pre_ids0, pre_scores0, mems0), None, length=t_max)
+    seq = beam_backtrack(step_ids, step_parents, eos_id)   # [B, K, T]
+    ctx.set_output('SentenceIds', seq[:, :n_results, :].astype(
+        ctx.out_dtype('SentenceIds', 'int64')))
+    ctx.set_output('SentenceScores', final_scores[:, :n_results])

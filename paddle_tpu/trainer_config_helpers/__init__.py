@@ -14,6 +14,7 @@ from .activations import *      # noqa: F401,F403
 from .attrs import *            # noqa: F401,F403
 from .layers import *           # noqa: F401,F403
 from .networks import *         # noqa: F401,F403
+from .recurrent import *        # noqa: F401,F403
 from .optimizers import *       # noqa: F401,F403
 from .poolings import *         # noqa: F401,F403
 from . import evaluators        # noqa: F401

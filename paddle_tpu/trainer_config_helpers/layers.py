@@ -122,6 +122,19 @@ def _propagate_len(src, out):
     return out
 
 
+# recurrent_group support: v1 memories link to the step layer whose
+# name matches the memory's (reference layers.py memory/recurrent_group
+# contract). Named layers built inside an active recurrent context
+# register themselves here; recurrent.py resolves the links.
+_RG_ACTIVE = []
+
+
+def _rg_note(name, var):
+    if name and _RG_ACTIVE:
+        _RG_ACTIVE[-1].names[name] = var
+    return var
+
+
 def _len_of(x):
     return getattr(x, '_v2_len_var', None)
 
@@ -174,7 +187,8 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
                  param_attr=_pa(param_attr), bias_attr=_pa(bias_attr)
                  if bias_attr is not None else None, name=name,
                  num_flatten_dims=2 if _is_seq(input) else 1)
-    return apply_extra_attr(_propagate_len(input, out), layer_attr)
+    return _rg_note(name, apply_extra_attr(_propagate_len(input, out),
+                                           layer_attr))
 
 
 def _is_seq(v):
@@ -342,7 +356,7 @@ def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=None,
     out = _apply_act(out, act)
     if src_seq is not None:
         out = _propagate_len(src_seq, out)
-    return apply_extra_attr(out, layer_attr)
+    return _rg_note(name, apply_extra_attr(out, layer_attr))
 
 
 # ------------------------------------------------------------- sequence
@@ -449,7 +463,7 @@ def gru_step_layer(input, output_mem, size=None, act=None,
         activation=_act_or(act, 'tanh'),
         gate_activation=_act_or(gate_act, 'sigmoid'),
         param_attr=_pa(param_attr), bias_attr=_pa(bias_attr))
-    return new_h
+    return _rg_note(name, new_h)
 
 
 gru_step_naive_layer = gru_step_layer
@@ -587,7 +601,7 @@ def addto_layer(input, act=None, name=None, bias_attr=None,
     out = inputs[0]
     for t in inputs[1:]:
         out = _fl.elementwise_add(out, t)
-    return _propagate_len(inputs[0], _apply_act(out, act))
+    return _rg_note(name, _propagate_len(inputs[0], _apply_act(out, act)))
 
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
@@ -1069,9 +1083,8 @@ def eos_layer(input, eos_id, name=None, layer_attr=None):
 
 
 _FLUID_EQUIV = {
-    'recurrent_group': 'fluid DynamicRNN / layers.rnn',
-    'memory': 'DynamicRNN.memory',
-    'beam_search': 'layers.beam_search (decode ops)',
+    # recurrent_group / memory / beam_search / StaticInput /
+    # GeneratedInput are REAL since round 5: see recurrent.py
     'selective_fc_layer': 'layers.fc + masking',
     'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
     'factorization_machine': 'wide_deep model (models/wide_deep.py)',
@@ -1080,8 +1093,6 @@ _FLUID_EQUIV = {
     'scale_sub_region_layer': 'layers.crop + scale + paste',
     'conv_projection': 'img_conv_layer',
     'conv_operator': 'img_conv_layer',
-    'StaticInput': 'DynamicRNN.static_input',
-    'GeneratedInput': 'transformer_greedy_decode / beam decode ops',
     'SubsequenceInput': 'SURVEY §6 LoD stance: depth>1 descoped',
     'BeamInput': 'layers.beam_search',
     'cross_entropy_over_beam': 'layers.beam_search + softmax_with_cross_entropy',

@@ -1,0 +1,269 @@
+"""v1 recurrent_group / memory / beam_search generation shim
+(reference trainer_config_helpers/layers.py:4082/:4215/:4406 — the
+seqToseq-era step-function API, VERDICT r4 next-#5). The step function
+traces into a fluid DynamicRNN (training) or the generation_decode op
+(beam generation); parity checks run against whole-sequence fluid
+builds and a manual single-step rollout of the identical step math."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.trainer_config_helpers import (
+    GeneratedInput, ParameterAttribute, SoftmaxActivation, StaticInput,
+    TanhActivation, addto_layer, beam_search, classification_cost,
+    data_layer, embedding_layer, fc_layer, gru_step_layer, last_seq,
+    memory, recurrent_group, simple_attention, simple_gru)
+
+
+def _run(fetches, feed, program=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, exe.run(program=program, feed=feed, fetch_list=fetches)
+
+
+def test_recurrent_group_stateless_step_matches_whole_sequence():
+    """A step that just projects each timestep must equal the fc applied
+    to the whole sequence with the SAME weights (shared by name)."""
+    x = data_layer(name='xs', size=6, seq_type=1)
+    pa = ParameterAttribute(name='rg_fc.w')
+
+    def step(x_t):
+        return fc_layer(input=x_t, size=4, act=TanhActivation(),
+                        param_attr=ParameterAttribute(name='rg_fc.w'),
+                        bias_attr=False)
+
+    seq_out = recurrent_group(step=step, input=x)
+    whole = fc_layer(input=x, size=4, act=TanhActivation(),
+                     param_attr=pa, bias_attr=False)
+    xs = np.random.RandomState(0).randn(3, 5, 6).astype('f')
+    lens = np.array([5, 3, 4], 'int32')
+    _, (a, b) = _run([seq_out, whole], {'xs': xs, 'xs_len': lens})
+    a, b = np.asarray(a), np.asarray(b)
+    # masked region: recurrent_group zeroes past each row's length
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(a[i, :l], b[i, :l], rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(a[i, l:], 0.0, atol=1e-6)
+
+
+def test_recurrent_group_memory_accumulates():
+    """The named-memory protocol: memory(name='acc') reads the previous
+    value of the step layer NAMED 'acc' — an addto accumulator becomes
+    a cumulative sum over time."""
+    x = data_layer(name='xa', size=4, seq_type=1)
+
+    def step(x_t):
+        acc = memory(name='acc', size=4)
+        return addto_layer(input=[x_t, acc], name='acc')
+
+    out = recurrent_group(step=step, input=x)
+    xs = np.random.RandomState(1).randn(2, 6, 4).astype('f')
+    lens = np.array([6, 4], 'int32')
+    _, (o,) = _run([out], {'xa': xs, 'xa_len': lens})
+    o = np.asarray(o)
+    want = np.cumsum(xs, axis=1)
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(o[i, :l], want[i, :l], rtol=1e-5,
+                                   atol=1e-5)
+
+
+def _seq2seq_step(emb, state, vocab, hidden, encoded=None,
+                  encoded_proj=None):
+    """One home for the decoder step math, shared by the training
+    recurrent_group, the beam_search generation, and the manual
+    single-step rollout program — so the parity test compares the same
+    computation through three different harnesses."""
+    parts = [emb]
+    if encoded is not None:
+        ctx = simple_attention(
+            encoded_sequence=encoded, encoded_proj=encoded_proj,
+            decoder_state=state,
+            transform_param_attr=ParameterAttribute(name='att_trans.w'),
+            softmax_param_attr=ParameterAttribute(name='att_score.w'))
+        parts.append(ctx)
+    x = fc_layer(input=parts if len(parts) > 1 else parts[0],
+                 size=hidden * 3, bias_attr=False,
+                 param_attr=ParameterAttribute(name='dec_proj.w'))
+    new_state = gru_step_layer(
+        input=x, output_mem=state, name='dec_state',
+        param_attr=ParameterAttribute(name='dec_gru.w'),
+        bias_attr=ParameterAttribute(name='dec_gru.b'))
+    prob = fc_layer(input=new_state, size=vocab,
+                    act=SoftmaxActivation(),
+                    param_attr=ParameterAttribute(name='dec_out.w'),
+                    bias_attr=ParameterAttribute(name='dec_out.b'))
+    return prob, new_state
+
+
+def _build_encoder(vocab, emb_dim, hidden, src_name='src'):
+    src = data_layer(name=src_name, size=vocab, dtype='int64', seq_type=1)
+    emb = embedding_layer(input=src, size=emb_dim,
+                          param_attr=ParameterAttribute(name='src_emb'))
+    enc = simple_gru(input=emb, size=hidden,
+                     mixed_param_attr=ParameterAttribute(name='enc_mix.w'),
+                     gru_param_attr=ParameterAttribute(name='enc_gru.w'),
+                     gru_bias_attr=ParameterAttribute(name='enc_gru.b'))
+    boot = fc_layer(input=last_seq(input=enc), size=hidden,
+                    act=TanhActivation(),
+                    param_attr=ParameterAttribute(name='boot.w'),
+                    bias_attr=ParameterAttribute(name='boot.b'))
+    enc_proj = fc_layer(input=enc, size=hidden, bias_attr=False,
+                        param_attr=ParameterAttribute(name='enc_proj.w'))
+    return enc, enc_proj, boot
+
+
+def test_seq2seq_recurrent_group_trains_and_beam_generates():
+    """The seqToseq shape end-to-end by changing only the import line:
+    bi-directionless GRU encoder, attention decoder as a
+    recurrent_group over the target sequence, trained on a copy task;
+    then beam_search generation with GeneratedInput feedback + the
+    SAME parameter names reproduces the copy mapping."""
+    V, E, H, T = 20, 12, 16, 5
+    enc, enc_proj, boot = _build_encoder(V, E, H)
+    trg = data_layer(name='trg', size=V, dtype='int64', seq_type=1)
+    trg_emb = embedding_layer(
+        input=trg, size=E, param_attr=ParameterAttribute(name='trg_emb'))
+    lbl = data_layer(name='lbl', size=1, dtype='int64', seq_type=1)
+
+    def train_step(emb_t):
+        state = memory(name='dec_state', size=H, boot_layer=boot)
+        return _seq2seq_step(emb_t, state, V, H, encoded=enc,
+                             encoded_proj=enc_proj)[0]
+
+    probs = recurrent_group(step=train_step, input=trg_emb)
+    cost = classification_cost(input=probs, label=lbl)
+    fluid.optimizer.Adam(learning_rate=8e-3).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    b = 8
+    src = rng.randint(2, V, (b, T)).astype('int64')
+    lbl_ids = src.copy()                        # copy task
+    trg_in = np.concatenate([np.ones((b, 1), 'int64'),
+                             lbl_ids[:, :-1]], axis=1)
+    feed = {'src': src, 'src_len': np.full((b,), T, 'int32'),
+            'trg': trg_in, 'trg_len': np.full((b,), T, 'int32'),
+            'lbl': lbl_ids[..., None], 'lbl_len': np.full((b,), T,
+                                                          'int32')}
+    losses = []
+    for _ in range(150):
+        loss, = exe.run(feed=feed, fetch_list=[cost])
+        losses.append(float(np.asarray(loss).reshape(())))
+    assert losses[-1] < losses[0] * 0.5
+
+    # ---- beam generation in a fresh program, params shared by name
+    gp = Program()
+    with program_guard(gp, fluid.default_startup_program()):
+        enc_g, proj_g, boot_g = _build_encoder(V, E, H, src_name='src')
+
+        def gen_step(enc_s, proj_s, boot_s, emb):
+            state = memory(name='dec_state', size=H, boot_layer=boot_s)
+            return _seq2seq_step(emb, state, V, H, encoded=enc_s,
+                                 encoded_proj=proj_s)[0]
+
+        ids = beam_search(
+            step=gen_step,
+            input=[StaticInput(enc_g, is_seq=True), StaticInput(proj_g),
+                   StaticInput(boot_g), GeneratedInput(
+                       size=V, embedding_name='trg_emb',
+                       embedding_size=E)],
+            bos_id=1, eos_id=0, beam_size=4, max_length=T)
+        scores = ids._beam_scores
+    f = {'src': src, 'src_len': np.full((b,), T, 'int32')}
+    bi, bs = (np.asarray(v) for v in exe.run(
+        program=gp, feed=f, fetch_list=[ids, scores]))
+    assert bi.shape == (b, 4, T)
+    assert np.all(np.diff(bs, axis=1) <= 1e-5)   # sorted best-first
+    # the trained copy task: the top beam reproduces the source
+    assert (bi[:, 0, :] == lbl_ids).mean() > 0.8
+
+
+def test_beam_size1_matches_manual_single_step_rollout():
+    """Numeric parity vs the fluid build: beam_size=1 generation must
+    equal a manual python rollout of a SINGLE-STEP program built from
+    the identical step function (the per-token re-run the reference's
+    generator performed)."""
+    V, E, H, T = 12, 8, 8, 4
+    b = 4
+    # params + a few random training steps so weights are non-trivial
+    enc, enc_proj, boot = _build_encoder(V, E, H)
+    trg = data_layer(name='trg', size=V, dtype='int64', seq_type=1)
+    trg_emb = embedding_layer(
+        input=trg, size=E, param_attr=ParameterAttribute(name='trg_emb'))
+    lbl = data_layer(name='lbl', size=1, dtype='int64', seq_type=1)
+
+    def train_step(emb_t):
+        state = memory(name='dec_state', size=H, boot_layer=boot)
+        return _seq2seq_step(emb_t, state, V, H, encoded=enc,
+                             encoded_proj=enc_proj)[0]
+
+    probs = recurrent_group(step=train_step, input=trg_emb)
+    cost = classification_cost(input=probs, label=lbl)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    src = rng.randint(2, V, (b, T)).astype('int64')
+    feed = {'src': src, 'src_len': np.full((b,), T, 'int32'),
+            'trg': src, 'trg_len': np.full((b,), T, 'int32'),
+            'lbl': src[..., None], 'lbl_len': np.full((b,), T, 'int32')}
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[cost])
+
+    gp = Program()
+    with program_guard(gp, fluid.default_startup_program()):
+        enc_g, proj_g, boot_g = _build_encoder(V, E, H, src_name='src')
+
+        def gen_step(enc_s, proj_s, boot_s, emb):
+            state = memory(name='dec_state', size=H, boot_layer=boot_s)
+            return _seq2seq_step(emb, state, V, H, encoded=enc_s,
+                                 encoded_proj=proj_s)[0]
+
+        ids = beam_search(
+            step=gen_step,
+            input=[StaticInput(enc_g, is_seq=True), StaticInput(proj_g),
+                   StaticInput(boot_g), GeneratedInput(
+                       size=V, embedding_name='trg_emb',
+                       embedding_size=E)],
+            bos_id=1, eos_id=0, beam_size=1, max_length=T)
+    f = {'src': src, 'src_len': np.full((b,), T, 'int32')}
+    got = np.asarray(exe.run(program=gp, feed=f, fetch_list=[ids])[0])
+
+    # single-step program: same step fn, state/ids fed from python
+    sp = Program()
+    with program_guard(sp, fluid.default_startup_program()):
+        enc_s, proj_s, boot_s = _build_encoder(V, E, H, src_name='src')
+        import paddle_tpu.layers as L
+        prev = L.data(name='prev_id', shape=[], dtype='int64')
+        st = L.data(name='state_in', shape=[H], dtype='float32')
+        emb_s = L.embedding(
+            input=prev, size=[V, E],
+            param_attr=fluid.ParamAttr(name='trg_emb'))
+        # note the named layer writes: gru_step_layer(name='dec_state')
+        # just produces the var here — no active recurrent context
+        prob_s, new_state_var = _seq2seq_step(
+            emb_s, st, V, H, encoded=enc_s, encoded_proj=proj_s)
+    state = None
+    ids_np = np.full((b,), 1, 'int64')
+    out_steps = []
+    # boot state: fetch boot_g value via the single-step program's boot
+    boot_val = np.asarray(exe.run(program=sp, feed=dict(
+        f, prev_id=ids_np, state_in=np.zeros((b, H), 'f')),
+        fetch_list=[boot_s])[0])
+    state = boot_val
+    for _ in range(T):
+        prob_v, ns = (np.asarray(v) for v in exe.run(
+            program=sp,
+            feed=dict(f, prev_id=ids_np, state_in=state.astype('f')),
+            fetch_list=[prob_s, new_state_var]))
+        ids_np = prob_v.argmax(axis=-1).astype('int64')
+        state = ns
+        out_steps.append(ids_np.copy())
+    want = np.stack(out_steps, axis=1)
+    # freeze after eos like the decode op
+    seen = np.cumsum(want == 0, axis=1)
+    want = np.where((seen >= 1) & (want != 0), 0, want)
+    np.testing.assert_array_equal(got[:, 0, :], want)
