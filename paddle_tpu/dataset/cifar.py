@@ -1,4 +1,17 @@
-"""CIFAR-10/100 (reference: python/paddle/v2/dataset/cifar.py)."""
+"""CIFAR-10/100 (reference: python/paddle/v2/dataset/cifar.py:46-64).
+
+Real-data path (round 5): drop `cifar-10-python.tar.gz` /
+`cifar-100-python.tar.gz` (the standard pickled batches) under
+$PADDLE_TPU_DATA/cifar/ and the readers parse them with the reference
+semantics: every tar member whose name contains the sub-name
+('data_batch' / 'test_batch' for 10, 'train' / 'test' for 100) is
+unpickled, `data` rows scale to [0, 1] float32 (flat [3072]), labels
+come from `labels` or `fine_labels`. Synthetic fallback otherwise
+(per-class templates + noise, learnable)."""
+
+import os
+import pickle
+import tarfile
 
 import numpy as np
 
@@ -6,6 +19,39 @@ from . import common
 
 _TRAIN_N = 4096
 _TEST_N = 1024
+
+CIFAR10_ARCHIVE = 'cifar-10-python.tar.gz'
+CIFAR100_ARCHIVE = 'cifar-100-python.tar.gz'
+
+
+def _cached(archive):
+    p = common.cached_path('cifar', archive)
+    return p if os.path.exists(p) else None
+
+
+def reader_creator(filename, sub_name):
+    """Reference cifar.py:46 semantics over a local archive."""
+    def read_batch(batch):
+        data = batch[b'data'] if b'data' in batch else batch['data']
+        labels = None
+        for key in (b'labels', 'labels', b'fine_labels', 'fine_labels'):
+            if key in batch:
+                labels = batch[key]
+                break
+        assert labels is not None, 'batch has neither labels nor fine_labels'
+        for sample, label in zip(data, labels):
+            yield (np.asarray(sample) / 255.0).astype(np.float32), int(label)
+
+    def reader():
+        with tarfile.open(filename, mode='r') as f:
+            names = [m.name for m in f
+                     if sub_name in m.name and m.isfile()]
+            for name in sorted(names):
+                batch = pickle.load(f.extractfile(name), encoding='bytes')
+                for item in read_batch(batch):
+                    yield item
+
+    return reader
 
 
 def _synthetic(name, split, n, num_classes):
@@ -27,16 +73,28 @@ def _reader(name, split, n, num_classes):
 
 
 def train10():
+    tar = _cached(CIFAR10_ARCHIVE)
+    if tar:
+        return reader_creator(tar, 'data_batch')
     return _reader('cifar10', 'train', _TRAIN_N, 10)
 
 
 def test10():
+    tar = _cached(CIFAR10_ARCHIVE)
+    if tar:
+        return reader_creator(tar, 'test_batch')
     return _reader('cifar10', 'test', _TEST_N, 10)
 
 
 def train100():
+    tar = _cached(CIFAR100_ARCHIVE)
+    if tar:
+        return reader_creator(tar, 'train')
     return _reader('cifar100', 'train', _TRAIN_N, 100)
 
 
 def test100():
+    tar = _cached(CIFAR100_ARCHIVE)
+    if tar:
+        return reader_creator(tar, 'test')
     return _reader('cifar100', 'test', _TEST_N, 100)

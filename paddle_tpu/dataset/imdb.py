@@ -1,6 +1,20 @@
-"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py).
-Synthetic fallback: two token distributions (positive/negative vocab bias)
-so sentiment models separate the classes."""
+"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py:40-126).
+
+Real-data path (round 5): drop `aclImdb_v1.tar.gz` under
+$PADDLE_TPU_DATA/imdb/ and the readers parse with the reference
+semantics: member files matching aclImdb/{train,test}/{pos,neg}/*.txt
+are read sequentially (tarfile.next — the reference's
+don't-thrash-the-disk note), lowercased, punctuation-stripped,
+whitespace-tokenized; word_dict() builds the frequency-sorted
+vocabulary with the reference's cutoff of 150 and a trailing <unk>.
+Synthetic fallback otherwise (class-biased token distributions so
+sentiment models separate the classes)."""
+
+import collections
+import os
+import re
+import string
+import tarfile
 
 import numpy as np
 
@@ -11,8 +25,69 @@ _TRAIN_N = 2048
 _TEST_N = 512
 _MAX_LEN = 100
 
+ARCHIVE = 'aclImdb_v1.tar.gz'
+
+_PUNCT_TABLE = str.maketrans('', '', string.punctuation)
+
+
+def _cached_tar():
+    p = common.cached_path('imdb', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def tokenize(pattern, tar_path=None):
+    """Yield one token list per member file matching `pattern`
+    (reference imdb.py:40 — sequential access, lowercase, punctuation
+    removed)."""
+    tar_path = tar_path or _cached_tar()
+    if tar_path is None:
+        raise RuntimeError('imdb.tokenize needs the cached archive; see '
+                           'module docstring')
+    with tarfile.open(tar_path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if tf.isfile() and pattern.match(tf.name):
+                text = tarf.extractfile(tf).read().decode(
+                    'utf-8', errors='replace')
+                yield text.rstrip('\n\r').translate(
+                    _PUNCT_TABLE).lower().split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff, tar_path=None):
+    """Frequency-sorted token -> id over files matching `pattern`,
+    keeping tokens with count > cutoff, <unk> appended last
+    (reference imdb.py:55-74)."""
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize(pattern, tar_path):
+        for word in doc:
+            word_freq[word] += 1
+    kept = [(w, c) for w, c in word_freq.items() if c > cutoff]
+    kept.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(kept)}
+    word_idx['<unk>'] = len(kept)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx, tar_path=None):
+    unk = word_idx['<unk>']
+    items = []
+    for pattern, label in ((pos_pattern, 0), (neg_pattern, 1)):
+        for doc in tokenize(pattern, tar_path):
+            items.append(([word_idx.get(w, unk) for w in doc], label))
+
+    def reader():
+        for doc, label in items:
+            yield doc, label
+    return reader
+
 
 def word_dict():
+    tar = _cached_tar()
+    if tar:
+        return build_dict(
+            re.compile(r'aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$'),
+            150, tar)
     return {('w%d' % i): i for i in range(_VOCAB)}
 
 
@@ -42,8 +117,20 @@ def _reader(split, n):
 
 
 def train(word_idx=None):
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(
+            re.compile(r'aclImdb/train/pos/.*\.txt$'),
+            re.compile(r'aclImdb/train/neg/.*\.txt$'),
+            word_idx or word_dict(), tar)
     return _reader('train', _TRAIN_N)
 
 
 def test(word_idx=None):
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(
+            re.compile(r'aclImdb/test/pos/.*\.txt$'),
+            re.compile(r'aclImdb/test/neg/.*\.txt$'),
+            word_idx or word_dict(), tar)
     return _reader('test', _TEST_N)

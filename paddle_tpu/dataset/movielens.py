@@ -1,4 +1,24 @@
-"""MovieLens ratings (reference: v2/dataset/movielens.py)."""
+"""MovieLens ml-1m (reference: python/paddle/v2/dataset/movielens.py
+:43-170).
+
+Real-data path (round 5): drop `ml-1m.zip` under
+$PADDLE_TPU_DATA/movielens/ and the readers parse with the reference
+semantics: movies.dat / users.dat / ratings.dat ('::'-separated),
+movie titles split `Title (Year)`, category and title-word
+dictionaries built over the whole catalog, a seeded 10% holdout split
+on ratings, and each real sample yields the reference record
+`user.value() + movie.value() + [[rating]]` with rating rescaled to
+[-5, 5] (rating*2-5).
+
+Synthetic fallback (no cached archive) keeps the compact
+(uid, movie_id, score-in-[1,5]) triple the recommender model/tests
+consume — a deliberate divergence documented here: the real path's
+record layout is the reference's richer schema."""
+
+import os
+import random
+import re
+import zipfile
 
 import numpy as np
 
@@ -9,22 +29,149 @@ _MOVIES = 1683
 _TRAIN_N = 8192
 _TEST_N = 1024
 
+ARCHIVE = 'ml-1m.zip'
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+def _cached_zip():
+    p = common.cached_path('movielens', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+class MovieInfo(object):
+    """Movie id, title words, categories (reference :43-68)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, title_dict):
+        return [self.index,
+                [categories_dict[c] for c in self.categories],
+                [title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo(object):
+    """User id, gender, age bucket, job (reference :70-92)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+
+class _Meta(object):
+    """Parsed catalog of a real ml-1m.zip (reference
+    __initialize_meta_info__ :102-140)."""
+
+    def __init__(self, zip_path):
+        pattern = re.compile(r'^(.*)\((\d+)\)$')
+        self.movies = {}
+        self.users = {}
+        title_words = set()
+        categories = set()
+        with zipfile.ZipFile(zip_path) as package:
+            with package.open('ml-1m/movies.dat') as f:
+                for line in f:
+                    line = line.decode('latin1').strip()
+                    movie_id, title, cats = line.split('::')
+                    cats = cats.split('|')
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1).strip() if m else title
+                    self.movies[int(movie_id)] = MovieInfo(
+                        index=movie_id, categories=cats, title=title)
+                    for w in title.split():
+                        title_words.add(w.lower())
+            with package.open('ml-1m/users.dat') as f:
+                for line in f:
+                    uid, gender, age, job, _zip = \
+                        line.decode('latin1').strip().split('::')
+                    self.users[int(uid)] = UserInfo(
+                        index=uid, gender=gender, age=age, job_id=job)
+        self.categories_dict = {c: i for i, c in enumerate(sorted(
+            categories))}
+        self.title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+
+
+_META = {}
+
+
+def _meta(zip_path):
+    if zip_path not in _META:
+        _META[zip_path] = _Meta(zip_path)
+    return _META[zip_path]
+
+
+def _zip_reader(zip_path, is_test, rand_seed=0, test_ratio=0.1):
+    def reader():
+        meta = _meta(zip_path)
+        rand = random.Random(x=rand_seed)
+        with zipfile.ZipFile(zip_path) as package:
+            with package.open('ml-1m/ratings.dat') as f:
+                for line in f:
+                    if (rand.random() < test_ratio) != is_test:
+                        continue
+                    uid, mov_id, rating, _ts = \
+                        line.decode('latin1').strip().split('::')
+                    usr = meta.users[int(uid)]
+                    mov = meta.movies[int(mov_id)]
+                    yield (usr.value() +
+                           mov.value(meta.categories_dict,
+                                     meta.title_dict) +
+                           [[float(rating) * 2 - 5.0]])
+    return reader
+
+
+# ------------------------------------------------------------ metadata
 
 def max_user_id():
+    z = _cached_zip()
+    if z:
+        return max(_meta(z).users)
     return _USERS - 1
 
 
 def max_movie_id():
+    z = _cached_zip()
+    if z:
+        return max(_meta(z).movies)
     return _MOVIES - 1
 
 
 def max_job_id():
+    z = _cached_zip()
+    if z:
+        return max(u.job_id for u in _meta(z).users.values())
     return 20
 
 
 def age_table():
-    return [1, 18, 25, 35, 45, 50, 56]
+    return list(_AGE_TABLE)
 
+
+def movie_categories():
+    z = _cached_zip()
+    if z:
+        return _meta(z).categories_dict
+    return {('cat%d' % i): i for i in range(19)}
+
+
+def get_movie_title_dict():
+    z = _cached_zip()
+    if z:
+        return _meta(z).title_dict
+    return {('t%d' % i): i for i in range(256)}
+
+
+# ------------------------------------------------------------ synthetic
 
 def _synthetic(split, n):
     r = common.rng('movielens', split)
@@ -47,8 +194,14 @@ def _reader(split, n):
 
 
 def train():
+    z = _cached_zip()
+    if z:
+        return _zip_reader(z, is_test=False)
     return _reader('train', _TRAIN_N)
 
 
 def test():
+    z = _cached_zip()
+    if z:
+        return _zip_reader(z, is_test=True)
     return _reader('test', _TEST_N)

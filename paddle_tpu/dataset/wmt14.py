@@ -1,7 +1,25 @@
-"""WMT14 en-de NMT pairs (reference: v2/dataset/wmt14.py).
-Synthetic fallback: target = deterministic per-token mapping of source
-(+BOS/EOS), so seq2seq/Transformer models can drive loss to ~0 — a real
-learnability check, like copy-task benchmarks."""
+"""WMT14 en-de NMT pairs (reference: python/paddle/v2/dataset/wmt14.py
+:53-100 tar parsing, :114-167 readers/get_dict).
+
+Real-data path (round 5): drop the reference's `wmt14.tgz` — or any
+archive with the same layout: exactly one `*src.dict` and one
+`*trg.dict` (one token per line, line number = id), and TSV sentence
+files whose names end in `train/train` / `test/test` with
+`src-sentence \\t trg-sentence` token lines — under
+$PADDLE_TPU_DATA/wmt14/. The readers then parse with the reference
+semantics: dicts truncate to the first `dict_size` lines, sentences
+tokenize on whitespace, unknown tokens map to <unk>=2, sources are
+framed <s> ... <e>, pairs with a side longer than 80 tokens drop, and
+targets yield as (<s>+ids, ids+<e>). The zero-egress stance refuses
+*downloading* (common.download), not *parsing*.
+
+Synthetic fallback (no cached archive): target = deterministic
+per-token mapping of source (+BOS/EOS), so seq2seq/Transformer models
+can drive loss to ~0 — a real learnability check, like copy-task
+benchmarks."""
+
+import os
+import tarfile
 
 import numpy as np
 
@@ -12,9 +30,65 @@ _TRAIN_N = 4096
 _TEST_N = 512
 _MAX_LEN = 50
 
+START = '<s>'
+END = '<e>'
+UNK = '<unk>'
+UNK_IDX = 2
+
+# synthetic framing ids (the synthetic vocab puts <s>/<e>/<unk> at 0/1/2)
 BOS = 0
 EOS = 1
-UNK = 2
+
+TRAIN_ARCHIVE = 'wmt14.tgz'
+
+
+def _cached_tar():
+    p = common.cached_path('wmt14', TRAIN_ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def _read_to_dict(tar_path, dict_size):
+    """(src_dict, trg_dict): first `dict_size` lines of the archive's
+    *src.dict / *trg.dict, token -> line number."""
+    def to_dict(fd, size):
+        d = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            d[line.decode('utf-8').strip()] = i
+        return d
+
+    with tarfile.open(tar_path, mode='r') as f:
+        def one(suffix):
+            names = [m.name for m in f if m.name.endswith(suffix)]
+            if len(names) != 1:
+                raise ValueError(
+                    'wmt14 archive %r: expected exactly one *%s, found %d'
+                    % (tar_path, suffix, len(names)))
+            return to_dict(f.extractfile(names[0]), dict_size)
+
+        return one('src.dict'), one('trg.dict')
+
+
+def _tar_reader(tar_path, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = _read_to_dict(tar_path, dict_size)
+        with tarfile.open(tar_path, mode='r') as f:
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for raw in f.extractfile(name):
+                    parts = raw.decode('utf-8').strip().split('\t')
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK_IDX)
+                               for w in [START] + parts[0].split() + [END]]
+                    trg_ids = [trg_dict.get(w, UNK_IDX)
+                               for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    yield (src_ids, [trg_dict[START]] + trg_ids,
+                           trg_ids + [trg_dict[END]])
+    return reader
 
 
 def _map_token(tok):
@@ -41,15 +115,32 @@ def _reader(split, n):
 
 
 def train(dict_size=_VOCAB):
+    tar = _cached_tar()
+    if tar:
+        return _tar_reader(tar, 'train/train', dict_size)
     return _reader('train', _TRAIN_N)
 
 
 def test(dict_size=_VOCAB):
+    tar = _cached_tar()
+    if tar:
+        return _tar_reader(tar, 'test/test', dict_size)
     return _reader('test', _TEST_N)
 
 
 def get_dict(dict_size=_VOCAB, reverse=False):
-    word_dict = {('w%d' % i): i for i in range(dict_size)}
+    """(src_dict, trg_dict) — real vocabularies when the archive is
+    cached (reference :159-167), the synthetic id vocabulary otherwise.
+    reverse=True flips both to id -> token."""
+    tar = _cached_tar()
+    if tar:
+        src_dict, trg_dict = _read_to_dict(tar, dict_size)
+    else:
+        words = [START, END, UNK] + \
+            ['w%d' % i for i in range(3, dict_size)]
+        src_dict = {w: i for i, w in enumerate(words[:dict_size])}
+        trg_dict = dict(src_dict)
     if reverse:
-        return {v: k for k, v in word_dict.items()}
-    return word_dict
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
