@@ -64,3 +64,14 @@ def to_jnp_dtype(dtype):
 
 def is_float_dtype(dtype):
     return canonical_dtype(dtype) in ('float16', 'bfloat16', 'float32', 'float64')
+
+
+def canonical_int():
+    """Platform int for in-graph index/count outputs: int64 when x64 is
+    enabled, int32 otherwise. jnp.int64 under the default x64-off
+    config fires a truncation UserWarning on every trace; this
+    canonicalizes silently (reference ops declare int64, the TPU jit
+    reality is int32)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.dtypes.canonicalize_dtype(jnp.int64)

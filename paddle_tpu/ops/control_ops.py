@@ -8,6 +8,7 @@ once into the scan/while body, so the loop compiles to a single XLA While.
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import LoweringContext, get_lowering, register
 
 
@@ -144,7 +145,7 @@ def _array_read(ctx):
 @register('array_length')
 def _array_length(ctx):
     arr = ctx.input('X')
-    ctx.set_output('Out', jnp.asarray([arr.shape[0]], dtype=jnp.int64))
+    ctx.set_output('Out', jnp.asarray([arr.shape[0]], dtype=canonical_int()))
 
 
 @register('if_else')

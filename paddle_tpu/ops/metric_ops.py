@@ -9,6 +9,7 @@ step instead of forcing a host round-trip.
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import register
 
 
@@ -139,4 +140,4 @@ def _edit_distance(ctx):
     if normalized:
         dist = dist / jnp.maximum(ref_len[:, None], 1).astype(dist.dtype)
     ctx.set_output('Out', dist.astype(jnp.float32))
-    ctx.set_output('SequenceNum', jnp.asarray([b], jnp.int64))
+    ctx.set_output('SequenceNum', jnp.asarray([b], canonical_int()))

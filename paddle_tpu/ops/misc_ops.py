@@ -7,6 +7,7 @@ bilinear_interp_op,...}.cc
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import register
 
 
@@ -14,14 +15,14 @@ from ..core.registry import register
 def _argmax(ctx):
     x = ctx.input('X')
     ctx.set_output('Out', jnp.argmax(x, axis=ctx.attr('axis', -1))
-                   .astype(jnp.int64))
+                   .astype(canonical_int()))
 
 
 @register('argmin')
 def _argmin(ctx):
     x = ctx.input('X')
     ctx.set_output('Out', jnp.argmin(x, axis=ctx.attr('axis', -1))
-                   .astype(jnp.int64))
+                   .astype(canonical_int()))
 
 
 @register('argsort')
@@ -29,11 +30,11 @@ def _argsort(ctx):
     x = ctx.input('X')
     axis = ctx.attr('axis', -1)
     idx = jnp.argsort(x, axis=axis)
-    ctx.set_output('Indices', idx.astype(jnp.int64))
+    ctx.set_output('Indices', idx.astype(canonical_int()))
     ctx.set_output('Out', jnp.sort(x, axis=axis))
 
 
-    ctx.set_output('SequenceNum', jnp.asarray([b], jnp.int64))
+    ctx.set_output('SequenceNum', jnp.asarray([b], canonical_int()))
 
 
 @register('bilinear_interp')

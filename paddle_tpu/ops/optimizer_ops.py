@@ -80,6 +80,26 @@ def _momentum(ctx):
     v = ctx.input('Velocity')
     lr = _lr(ctx)
     mu = ctx.attr('mu', 0.9)
+    sparse = _sparse_rows(ctx, g)
+    if sparse is not None:
+        # lazy momentum rows (MomentumOptimizer(lazy_mode=True)): the
+        # velocity decays only on touched rows — documented divergence
+        # from dense momentum, same stance as lazy Adam above.
+        flat, rows = sparse
+        rep, merged, valid = _merge_duplicate_rows(flat, rows)
+        old_v = jnp.take(v, rep, axis=0)
+        new_v = mu * old_v + merged
+        if ctx.attr('use_nesterov', False):
+            step = (merged + mu * new_v) * lr
+        else:
+            step = lr * new_v
+        dv = jnp.where(valid[:, None], new_v - old_v, 0.0)
+        dp = jnp.where(valid[:, None], step, 0.0)
+        ctx.set_output('VelocityOut',
+                       v.at[rep].add(dv.astype(v.dtype), mode='drop'))
+        ctx.set_output('ParamOut',
+                       p.at[rep].add(-dp.astype(p.dtype), mode='drop'))
+        return
     v_out = mu * v + g
     if ctx.attr('use_nesterov', False):
         p_out = p - (g + mu * v_out) * lr
@@ -101,6 +121,34 @@ def _adam(ctx):
     b1 = ctx.attr('beta1', 0.9)
     b2 = ctx.attr('beta2', 0.999)
     eps = ctx.attr('epsilon', 1e-8)
+    sparse = _sparse_rows(ctx, g)
+    if sparse is not None:
+        # LAZY Adam rows (reference lookup_table_op.cc:119-127 sparse
+        # protocol + the lazy-mode Adam the CTR stacks standardized):
+        # moments decay and the param moves ONLY on touched rows this
+        # step; untouched rows keep stale moments. This is a documented
+        # divergence from dense Adam (which decays every row every
+        # step) — it is only reachable via AdamOptimizer(lazy_mode=
+        # True). Nonlinear in g, so duplicate ids merge first.
+        flat, rows = sparse
+        rep, merged, valid = _merge_duplicate_rows(flat, rows)
+        old_m = jnp.take(m, rep, axis=0)
+        old_v = jnp.take(v, rep, axis=0)
+        new_m = b1 * old_m + (1.0 - b1) * merged
+        new_v = b2 * old_v + (1.0 - b2) * jnp.square(merged)
+        lr_t = lr * jnp.sqrt(1.0 - beta2_pow.reshape(())) / \
+            (1.0 - beta1_pow.reshape(()))
+        dp = jnp.where(valid[:, None],
+                       lr_t * new_m / (jnp.sqrt(new_v) + eps), 0.0)
+        dm = jnp.where(valid[:, None], new_m - old_m, 0.0)
+        dv = jnp.where(valid[:, None], new_v - old_v, 0.0)
+        ctx.set_output('Moment1Out',
+                       m.at[rep].add(dm.astype(m.dtype), mode='drop'))
+        ctx.set_output('Moment2Out',
+                       v.at[rep].add(dv.astype(v.dtype), mode='drop'))
+        ctx.set_output('ParamOut',
+                       p.at[rep].add(-dp.astype(p.dtype), mode='drop'))
+        return
     m_out = b1 * m + (1.0 - b1) * g
     v_out = b2 * v + (1.0 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1.0 - beta2_pow.reshape(())) / \

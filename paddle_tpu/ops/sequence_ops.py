@@ -9,6 +9,7 @@ replaces LoD bookkeeping. The 'X_length' auxiliary input carries lengths.
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import register
 
 
@@ -141,4 +142,4 @@ def _kmax_seq_score(ctx):
         alive = jnp.arange(x.shape[1])[None, :] < length
         x = jnp.where(alive, x, -1e9)
     _scores, idx = jax.lax.top_k(x, k)
-    ctx.set_output('Out', idx.astype(jnp.int64))
+    ctx.set_output('Out', idx.astype(canonical_int()))

@@ -7,6 +7,7 @@ assign_op,sum_op,split_op,reshape_op,transpose_op,one_hot_op,...}.cc
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import register
 
 
@@ -152,7 +153,7 @@ def _top_k(ctx):
     k = ctx.attr('k', 1)
     values, indices = jax.lax.top_k(x, k)
     ctx.set_output('Out', values)
-    ctx.set_output('Indices', indices.astype(jnp.int64)
+    ctx.set_output('Indices', indices.astype(canonical_int())
                    if ctx.out_var('Indices') is not None and
                    ctx.out_var('Indices').dtype == 'int64' else indices)
 

@@ -10,6 +10,7 @@ feature map instead of dynamic slices.
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import canonical_int
 from ..core.registry import register
 
 
@@ -81,7 +82,7 @@ def _roi_pool(ctx):
         masked = jnp.where(mask[None], feat[:, None, None], neg)
         flat = masked.reshape(masked.shape[:3] + (H * W,))
         pooled = flat.max(-1)
-        arg = flat.argmax(-1).astype(jnp.int64)
+        arg = flat.argmax(-1).astype(canonical_int())
         empty = ~mask.any((-1, -2))                             # [PH, PW]
         pooled = jnp.where(empty[None], 0.0, pooled)
         arg = jnp.where(empty[None], -1, arg)

@@ -62,9 +62,16 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name='pp',
         return (nxt, aux_acc), y
 
     # mark the carry varying over pp (ppermute outputs are varying; an
-    # unvarying init would make the scan carry types mismatch)
-    buf0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
-    aux0 = jax.lax.pvary(jnp.zeros((), jnp.float32), (axis_name,))
+    # unvarying init would make the scan carry types mismatch).
+    # pcast(to='varying') is the post-0.9 spelling of pvary; fall back
+    # for older jax so the module imports everywhere.
+    def _mark_varying(x):
+        if hasattr(jax.lax, 'pcast'):
+            return jax.lax.pcast(x, (axis_name,), to='varying')
+        return jax.lax.pvary(x, (axis_name,))
+
+    buf0 = _mark_varying(jnp.zeros_like(microbatches[0]))
+    aux0 = _mark_varying(jnp.zeros((), jnp.float32))
     (_, aux_sum), ys = jax.lax.scan(tick, (buf0, aux0),
                                     jnp.arange(total))
     # last stage emits microbatch m at tick m + n_stages - 1

@@ -176,7 +176,7 @@ def test_crf_trains():
     ws = rng.randint(0, 20, (8, T)).astype('int64')
     ys = (ws % C).astype('int64')
     losses = [float(np.asarray(exe.run(feed={'w': ws, 'y': ys},
-                                       fetch_list=[loss])[0]))
+                                       fetch_list=[loss])[0]).reshape(()))
               for _ in range(15)]
     assert losses[-1] < losses[0]
 
