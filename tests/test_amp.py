@@ -293,7 +293,7 @@ def test_s2d_stem_matches_direct_conv(monkeypatch):
         feed = {'image': rng.rand(4, 3, 32, 32).astype('float32'),
                 'label': rng.randint(0, 10, (4, 1)).astype('int64')}
         return [float(np.asarray(exe.run(feed=feed,
-                                         fetch_list=[loss])[0]))
+                                         fetch_list=[loss])[0]).reshape(()))
                 for _ in range(steps)]
 
     monkeypatch.delenv('PADDLE_TPU_CONV_S2D', raising=False)

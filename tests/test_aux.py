@@ -71,7 +71,7 @@ def test_memory_optimize_remat_still_correct():
     xs = rng.rand(16, 8).astype('float32')
     ys = xs.sum(1, keepdims=True).astype('float32')
     losses = [float(np.asarray(exe.run(feed={'x': xs, 'y': ys},
-                                       fetch_list=[loss])[0]))
+                                       fetch_list=[loss])[0]).reshape(()))
               for _ in range(20)]
     assert losses[-1] < losses[0]
 

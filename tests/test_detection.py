@@ -134,7 +134,7 @@ def test_ssd_loss_end_to_end_trains():
     exe.run(fluid.default_startup_program())
     feed = {'feat': rng.randn(B, N, 8).astype('float32'),
             'gtb': gt_box_np, 'gtl': gt_lbl_np, 'pb': priors_np}
-    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]).reshape(()))
               for _ in range(12)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
@@ -171,7 +171,7 @@ def test_ssd_model_trains_and_infers():
     feed = {'image': rng.rand(2, 3, 64, 64).astype('float32'),
             'gt_box': gt,
             'gt_label': rng.randint(1, 4, (2, 3)).astype('int64')}
-    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]).reshape(()))
               for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]

@@ -29,7 +29,7 @@ def test_fit_a_line_converges():
         xs = np.random.randn(32, 13).astype('float32')
         ys = xs @ true_w + 0.5
         out = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[avg_cost])
-        losses.append(float(out[0]))
+        losses.append(float(np.asarray(out[0]).reshape(())))
     assert losses[-1] < 0.05, 'loss did not converge: %s' % losses[-10:]
     assert losses[-1] < losses[0]
 

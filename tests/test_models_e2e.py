@@ -14,7 +14,7 @@ def _train(loss, feeder, steps=12, opt=None):
     losses = []
     for i in range(steps):
         out = exe.run(feed=feeder(i), fetch_list=[loss])
-        losses.append(float(np.asarray(out[0])))
+        losses.append(float(np.asarray(out[0]).reshape(())))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
     return losses

@@ -50,7 +50,7 @@ def _train_k_steps(mesh=None, strategy=None, steps=3, seed=0, opt='sgd'):
     for _ in range(steps):
         final = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
     w1 = np.asarray(fluid.global_scope().find('w1'))
-    return float(np.asarray(final[0])), w1
+    return float(np.asarray(final[0]).reshape(())), w1
 
 
 def test_data_parallel_matches_single_device():
@@ -95,7 +95,7 @@ def _train_wide_deep(mesh=None, strategy=None, steps=3, vocab=64):
     for _ in range(steps):
         final = exe.run(feed=feed, fetch_list=[avg_cost])
     emb = np.asarray(fluid.global_scope().find('emb_slot_0'))
-    return float(np.asarray(final[0])), emb
+    return float(np.asarray(final[0]).reshape(())), emb
 
 
 def test_row_sharded_embedding_matches_unsharded():

@@ -51,6 +51,12 @@ QUEUE = [
     ('moe_cap1.25', 'moe_cap1.25', None, 600),
     ('moe_cap2.0', 'moe_cap2.0', None, 600),
     ('attention_microbench', 'attention_microbench', None, 900),
+    # BLOCK_K sweep (VERDICT r4 next-#3: beyond the pinned 128) — the
+    # Pallas legs of the microbench re-run at wider key tiles
+    ('attention_microbench_bk256', 'attention_microbench',
+     {'PADDLE_TPU_PALLAS_BLOCK_K': '256'}, 900),
+    ('attention_microbench_bk512', 'attention_microbench',
+     {'PADDLE_TPU_PALLAS_BLOCK_K': '512'}, 900),
     ('transformer_seq1024', 'transformer_seq1024', None, 600),
     ('transformer_seq1024_pallas', 'transformer_seq1024',
      {'PADDLE_TPU_USE_PALLAS': '1'}, 600),
