@@ -47,3 +47,8 @@ def test_v1_quickstart_example():
     out = _run_example('train_v1_quickstart.py')
     final = float(out.strip().splitlines()[-1].split()[-1])
     assert final < 0.1
+
+
+def test_v1_seq2seq_generate_example():
+    out = _run_example('train_v1_seq2seq_generate.py')
+    assert 'top-beam copy accuracy' in out
