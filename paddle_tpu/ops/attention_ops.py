@@ -54,7 +54,7 @@ def reference_attention(q, k, v, causal=False, key_length=None,
     return out
 
 
-def _ring_dispatch(q, k, v, mesh, causal):
+def _ring_dispatch(q, k, v, mesh, causal, key_length=None):
     """Sequence-parallel exact attention: shard_map over the mesh's 'sp'
     axis with K/V rotating on ICI (parallel/ring_attention.py). Called
     inside the executor's jit — GSPMD reshards q/k/v to the sp layout if
@@ -70,17 +70,31 @@ def _ring_dispatch(q, k, v, mesh, causal):
     from jax.sharding import PartitionSpec as P
     from ..parallel.ring_attention import ring_attention
     spec = P(None, None, 'sp', None)
-    kwargs = dict(in_specs=(spec, spec, spec), out_specs=spec)
+    if key_length is None:
+        in_specs = (spec, spec, spec)
+        args = (q, k, v)
+
+        def fn(q_, k_, v_):
+            return ring_attention(q_, k_, v_, axis_name='sp',
+                                  causal=causal)
+    else:
+        # lengths are replicated over sp (each shard masks by GLOBAL
+        # key position — ring_attention kv_len semantics, r5)
+        in_specs = (spec, spec, spec, P(None))
+        args = (q, k, v, key_length)
+
+        def fn(q_, k_, v_, l_):
+            return ring_attention(q_, k_, v_, axis_name='sp',
+                                  causal=causal, kv_len=l_)
+
+    kwargs = dict(in_specs=in_specs, out_specs=spec)
     ctx = jax.sharding.get_abstract_mesh()
     manual = getattr(getattr(jax.sharding, 'AxisType', None),
                      'Manual', None)
     if not (manual is not None and any(
             t == manual for t in getattr(ctx, 'axis_types', ()))):
         kwargs['mesh'] = mesh
-    return jax.shard_map(
-        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name='sp',
-                                          causal=causal),
-        **kwargs)(q, k, v)
+    return jax.shard_map(fn, **kwargs)(*args)
 
 
 def _sp_size(mesh):
@@ -105,7 +119,7 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
     v = _split_heads(v3, n_head)
 
     sp = _sp_size(mesh)
-    use_ring = (sp > 1 and key_length is None and query_length is None and
+    use_ring = (sp > 1 and
                 q.shape[-2] % sp == 0 and k.shape[-2] % sp == 0 and
                 os.environ.get('PADDLE_TPU_RING_ATTENTION', '1')
                 not in ('0', 'false'))
@@ -123,7 +137,12 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
         from .pallas import pallas_enabled
         use_pallas = pallas_enabled()
     if use_ring:
-        out = _ring_dispatch(q, k, v, mesh, causal)
+        out = _ring_dispatch(q, k, v, mesh, causal,
+                             key_length=key_length)
+        if query_length is not None:
+            qmask = jnp.arange(out.shape[-2])[None, :] < \
+                query_length.reshape(-1, 1)
+            out = out * qmask[:, None, :, None].astype(out.dtype)
     elif use_pallas:
         from .pallas.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal, kv_len=key_length)

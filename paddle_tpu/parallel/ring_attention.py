@@ -23,11 +23,15 @@ def _block_attn(q, k, v, bias=None):
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
+def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
+                   kv_len=None):
     """Exact attention with K/V rotating over `axis_name`.
 
     q, k, v: [batch, heads, t_local, d] — the per-shard slices.
-    Returns [batch, heads, t_local, d].
+    kv_len: optional [batch] int — GLOBAL valid key count per example
+    (padding masks, r5): key positions ≥ kv_len[b] contribute -1e30
+    bias, so variable-length batches stay exact under sequence
+    parallelism too. Returns [batch, heads, t_local, d].
     """
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -38,15 +42,24 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def causal_bias(kv_idx):
+    def block_bias(kv_idx):
         # global positions: q_pos = my_idx*t + i ; k_pos = kv_idx*t + j
         qi = my_idx * t_local + jnp.arange(t_local)[:, None]
         kj = kv_idx * t_local + jnp.arange(t_local)[None, :]
-        return jnp.where(qi >= kj, 0.0, -1e30)
+        bias = None
+        if causal:
+            bias = jnp.where(qi >= kj, 0.0, -1e30)        # [tq, tk]
+        if kv_len is not None:
+            # [B, 1, tq, tk] — broadcasts over heads; finite -1e30
+            # keeps the m/l recurrence NaN-free on fully-masked blocks
+            key_ok = kj[None, :, :] < kv_len.reshape(-1, 1, 1)
+            kbias = jnp.where(key_ok, 0.0, -1e30)[:, None, :, :]
+            bias = kbias if bias is None else bias[None, None] + kbias
+        return bias
 
     def step(carry, _):
         o_acc, m_acc, l_acc, kv_k, kv_v, kv_idx = carry
-        bias = causal_bias(kv_idx) if causal else None
+        bias = block_bias(kv_idx)
         o_b, m_b, l_b = _block_attn(q, kv_k, kv_v, bias)
         m_new = jnp.maximum(m_acc, m_b)
         alpha = jnp.exp(m_acc - m_new)

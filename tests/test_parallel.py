@@ -753,3 +753,56 @@ def test_fsdp_parameter_sharding_matches_single_device():
     # w1 [6,16]: axis0 % 8 != 0, axis1 16 % 8 == 0 -> P(None, 'dp')
     assert 'dp' in tuple(shardings['w1']), shardings['w1']
     assert tuple(shardings['w1_moment1_acc']) == tuple(shardings['w1'])
+
+
+def test_ring_attention_masked_equals_reference():
+    """r5: per-example kv_len padding masks under sequence parallelism —
+    ring attention over an 8-shard sp axis must equal the unsharded
+    masked reference, including rows whose length falls inside an
+    earlier shard's block."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    from paddle_tpu.ops.attention_ops import reference_attention
+
+    b, h, t, d, n_shards = 3, 2, 32, 8, 8
+    rng = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+               for _ in range(3))
+    lens = jnp.asarray([32, 13, 3], jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:n_shards]).reshape(n_shards),
+                ('sp',))
+    spec = P(None, None, 'sp', None)
+    for causal in (False, True):
+        ring = shard_map(
+            lambda q_, k_, v_, l_: ring_attention(
+                q_, k_, v_, axis_name='sp', causal=causal, kv_len=l_),
+            mesh=mesh, in_specs=(spec, spec, spec, P(None)),
+            out_specs=spec)
+        got = np.asarray(jax.jit(ring)(q, k, v, lens))
+        want = np.asarray(reference_attention(q, k, v, causal=causal,
+                                              key_length=lens))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5,
+                                   err_msg='causal=%s' % causal)
+
+
+def test_masked_attention_dispatch_rides_ring():
+    """The fused_attention sp gate no longer requires key_length=None:
+    a masked batch on an sp mesh takes the ring path and matches the
+    unfused reference."""
+    import paddle_tpu.ops.attention_ops as ao
+    mesh = make_mesh(sp=8)
+    rng = np.random.RandomState(6)
+    b, t, hd, nh = 2, 32, 16, 2
+    q3, k3, v3 = (jnp.asarray(rng.randn(b, t, hd), jnp.float32)
+                  for _ in range(3))
+    lens = jnp.asarray([32, 9], jnp.int32)
+    qlen = jnp.asarray([30, 32], jnp.int32)
+    with mesh:
+        got = jax.jit(lambda a, b_, c, l, ql: ao.fused_attention(
+            a, b_, c, nh, causal=False, key_length=l, query_length=ql,
+            mesh=mesh))(q3, k3, v3, lens, qlen)
+    want = ao.fused_attention(q3, k3, v3, nh, causal=False,
+                              key_length=lens, query_length=qlen)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
