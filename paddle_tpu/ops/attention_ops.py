@@ -139,21 +139,19 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
     if use_ring:
         out = _ring_dispatch(q, k, v, mesh, causal,
                              key_length=key_length)
-        if query_length is not None:
-            qmask = jnp.arange(out.shape[-2])[None, :] < \
-                query_length.reshape(-1, 1)
-            out = out * qmask[:, None, :, None].astype(out.dtype)
     elif use_pallas:
         from .pallas.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal, kv_len=key_length)
-        if query_length is not None:
-            qmask = jnp.arange(out.shape[-2])[None, :] < \
-                query_length.reshape(-1, 1)
-            out = out * qmask[:, None, :, None].astype(out.dtype)
     else:
         out = reference_attention(q, k, v, causal=causal,
                                   key_length=key_length,
                                   query_length=query_length)
+    if query_length is not None and (use_ring or use_pallas):
+        # ring/flash kernels mask keys in-kernel; the query-side zeroing
+        # (reference_attention does it internally) applies here once
+        qmask = jnp.arange(out.shape[-2])[None, :] < \
+            query_length.reshape(-1, 1)
+        out = out * qmask[:, None, :, None].astype(out.dtype)
     if dropout_rate and not is_test:
         # dropout on attention output (weights-dropout would block the
         # flash/ring paths; output-dropout is the TPU-friendly equivalent)

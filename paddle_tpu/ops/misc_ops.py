@@ -34,9 +34,6 @@ def _argsort(ctx):
     ctx.set_output('Out', jnp.sort(x, axis=axis))
 
 
-    ctx.set_output('SequenceNum', jnp.asarray([b], canonical_int()))
-
-
 @register('bilinear_interp')
 def _bilinear_interp(ctx):
     x = ctx.input('X')  # NCHW

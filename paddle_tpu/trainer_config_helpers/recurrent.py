@@ -144,7 +144,17 @@ def recurrent_group(step, input, reverse=False, name=None,
     if not seqs:
         raise ValueError('recurrent_group needs at least one sequence '
                          'input (data_layer(..., seq_type=1))')
-    length = _len_of(seqs[0])
+    # targetInlink (reference :4133): which input link's sequence
+    # layout the output follows; default = the first sequence input
+    len_src = seqs[0]
+    if targetInlink is not None:
+        tgt = targetInlink.input if isinstance(targetInlink,
+                                               StaticInput) else targetInlink
+        if _len_of(tgt) is None:
+            raise ValueError('recurrent_group: targetInlink must be a '
+                             'sequence input')
+        len_src = tgt
+    length = _len_of(len_src)
 
     drnn = DynamicRNN(length=length)
     ctx = _RgCtx(drnn=drnn)
@@ -170,7 +180,7 @@ def recurrent_group(step, input, reverse=False, name=None,
     result = drnn()
     results = result if isinstance(result, list) else [result]
     for r in results:
-        _propagate_len(seqs[0], r)
+        _propagate_len(len_src, r)
     return results[0] if len(results) == 1 else results
 
 

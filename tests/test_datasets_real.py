@@ -160,10 +160,8 @@ def test_movielens_zip_parse(data_home):
     assert age == movielens.age_table().index(25 if uid == 1 else 45)
     assert job == (12 if uid == 1 else 3)
     assert isinstance(cats, list) and isinstance(title, list)
-    assert rating[0] == pytest.approx(
-        float(ratings.decode().splitlines()[0].split('::')[2]) * 2 - 5.0,
-        abs=1e-6) or True  # first surviving row need not be line 0
-    assert -5.0 <= rating[0] <= 5.0
+    # raw ratings are ints 1..5 → rescaled values are exactly these
+    assert rating[0] in (-3.0, -1.0, 1.0, 3.0, 5.0)
     assert movielens.max_user_id() == 2
     assert movielens.max_movie_id() == 2
     assert movielens.max_job_id() == 12

@@ -204,6 +204,17 @@ def test_topk_argsort_argmax():
     np.testing.assert_array_equal(got[2], np.argmax(x, 1))
 
 
+def test_argsort():
+    """The argsort lowering itself (a stray statement in its body made
+    it crash for four rounds with no test noticing — r5 review)."""
+    x = rand(3, 6, seed=15)
+    inp = fluid.layers.data(name='x', shape=[6], dtype='float32')
+    out, idx = fluid.layers.argsort(inp, axis=1)
+    got = run_startup_and({'x': x}, [out, idx])
+    np.testing.assert_allclose(got[0], np.sort(x, axis=1), rtol=1e-6)
+    np.testing.assert_array_equal(got[1], np.argsort(x, axis=1))
+
+
 def test_gather_scatter_where():
     x = rand(5, 3, seed=15)
     idx = np.array([0, 2, 4], dtype='int64')

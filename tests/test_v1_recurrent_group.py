@@ -267,3 +267,20 @@ def test_beam_size1_matches_manual_single_step_rollout():
     seen = np.cumsum(want == 0, axis=1)
     want = np.where((seen >= 1) & (want != 0), 0, want)
     np.testing.assert_array_equal(got[:, 0, :], want)
+
+
+def test_recurrent_group_target_inlink_length():
+    """targetInlink selects which input link's sequence layout the
+    output follows (reference :4133) — the output's length var must be
+    the designated link's, not the first input's."""
+    from paddle_tpu.trainer_config_helpers.layers import _len_of
+    a = data_layer(name='tia', size=4, seq_type=1)
+    b = data_layer(name='tib', size=4, seq_type=1)
+
+    def step(a_t, b_t):
+        return fc_layer(input=[a_t, b_t], size=3,
+                        param_attr=ParameterAttribute(name='ti_fc.w'),
+                        bias_attr=False)
+
+    out = recurrent_group(step=step, input=[a, b], targetInlink=b)
+    assert _len_of(out) is _len_of(b)
