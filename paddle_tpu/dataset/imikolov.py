@@ -1,4 +1,18 @@
-"""PTB-style language-model n-grams (reference: v2/dataset/imikolov.py)."""
+"""PTB-style language-model n-grams (reference:
+python/paddle/v2/dataset/imikolov.py:30-100).
+
+Real-data path (round 5): drop `simple-examples.tgz` under
+$PADDLE_TPU_DATA/imikolov/ and the readers parse with the reference
+semantics: build_dict counts words over ptb.train.txt + ptb.valid.txt
+(each line also counts one <s> and one <e>), drops the corpus's own
+<unk>, keeps words with count > min_word_freq sorted by (-freq, word),
+appends <unk> last; NGRAM mode frames each line <s> ... <e> and yields
+every n-gram window, SEQ mode yields (<s>+ids, ids+<e>) pairs skipping
+lines longer than n. Synthetic Markov-ish n-grams otherwise."""
+
+import collections
+import os
+import tarfile
 
 import numpy as np
 
@@ -8,9 +22,80 @@ _VOCAB = 2048
 _TRAIN_N = 8192
 _TEST_N = 1024
 
+ARCHIVE = 'simple-examples.tgz'
+TRAIN_FILE = './simple-examples/data/ptb.train.txt'
+TEST_FILE = './simple-examples/data/ptb.valid.txt'
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+def _cached_tar():
+    p = common.cached_path('imikolov', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        for w in line.decode('utf-8').strip().split():
+            word_freq[w] += 1
+        word_freq['<s>'] += 1
+        word_freq['<e>'] += 1
+    return word_freq
+
+
+def _member(tf, name):
+    """Find a tar member tolerating a missing leading './' (archives
+    differ in whether members carry it)."""
+    try:
+        return tf.extractfile(name)
+    except KeyError:
+        return tf.extractfile(name.lstrip('./'))
+
 
 def build_dict(min_word_freq=50):
-    return {('w%d' % i): i for i in range(_VOCAB)}
+    tar = _cached_tar()
+    if tar is None:
+        return {('w%d' % i): i for i in range(_VOCAB)}
+    with tarfile.open(tar) as tf:
+        freq = word_count(_member(tf, TEST_FILE),
+                          word_count(_member(tf, TRAIN_FILE)))
+    freq.pop('<unk>', None)       # re-added as the LAST index below
+    kept = [(w, c) for w, c in freq.items() if c > min_word_freq]
+    kept.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(kept)}
+    word_idx['<unk>'] = len(kept)
+    return word_idx
+
+
+def reader_creator(filename, word_idx, n, data_type):
+    def reader():
+        tar = _cached_tar()
+        with tarfile.open(tar) as tf:
+            unk = word_idx['<unk>']
+            for raw in _member(tf, filename):
+                words = raw.decode('utf-8').strip().split()
+                if data_type == DataType.NGRAM:
+                    assert n > -1, 'Invalid gram length'
+                    framed = ['<s>'] + words + ['<e>']
+                    if len(framed) >= n:
+                        ids = [word_idx.get(w, unk) for w in framed]
+                        for i in range(n, len(ids) + 1):
+                            yield tuple(ids[i - n:i])
+                elif data_type == DataType.SEQ:
+                    ids = [word_idx.get(w, unk) for w in words]
+                    src = [word_idx['<s>']] + ids
+                    trg = ids + [word_idx['<e>']]
+                    if n > 0 and len(src) > n:
+                        continue
+                    yield src, trg
+                else:
+                    raise ValueError('Unknown data_type %r' % data_type)
+    return reader
 
 
 def _synthetic(split, n, gram):
@@ -32,9 +117,15 @@ def _reader(split, n, gram):
     return reader
 
 
-def train(word_idx=None, n=5):
+def train(word_idx=None, n=5, data_type=DataType.NGRAM):
+    if _cached_tar():
+        return reader_creator(TRAIN_FILE, word_idx or build_dict(), n,
+                              data_type)
     return _reader('train', _TRAIN_N, n)
 
 
-def test(word_idx=None, n=5):
+def test(word_idx=None, n=5, data_type=DataType.NGRAM):
+    if _cached_tar():
+        return reader_creator(TEST_FILE, word_idx or build_dict(), n,
+                              data_type)
     return _reader('test', _TEST_N, n)

@@ -1,5 +1,19 @@
-"""WMT16 en-de translation (reference: python/paddle/v2/dataset/wmt16.py).
-Schema: (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> = 0/1/2."""
+"""WMT16 en-de multimodal-task translation (reference:
+python/paddle/v2/dataset/wmt16.py:59-311).
+Schema: (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> = 0/1/2.
+
+Real-data path (round 5): drop `wmt16.tar.gz` (members `wmt16/train`,
+`wmt16/test`, `wmt16/val` — TSV `en-sentence \\t de-sentence` lines)
+under $PADDLE_TPU_DATA/wmt16/. Reference semantics: per-language
+dictionaries are BUILT from the train split (frequency-descending,
+capped at dict_size including the three markers) and cached as
+`<lang>_<size>.dict` beside the archive; sources frame <s> ... <e>,
+targets yield as (<s>+ids, ids+<e>); src_lang='de' swaps the columns.
+Synthetic fallback otherwise."""
+
+import collections
+import os
+import tarfile
 
 import numpy as np
 
@@ -11,8 +25,83 @@ _TRAIN_N = 2048
 _TEST_N = 256
 _MAX_LEN = 50
 
+ARCHIVE = 'wmt16.tar.gz'
+START_MARK = '<s>'
+END_MARK = '<e>'
+UNK_MARK = '<unk>'
+
+
+def _cached_tar():
+    p = common.cached_path('wmt16', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def _build_dict(tar_path, dict_size, save_path, lang):
+    word_dict = collections.defaultdict(int)
+    col = 0 if lang == 'en' else 1
+    with tarfile.open(tar_path, mode='r') as f:
+        for line in f.extractfile('wmt16/train'):
+            parts = line.decode('utf-8').strip().split('\t')
+            if len(parts) != 2:
+                continue
+            for w in parts[col].split():
+                word_dict[w] += 1
+    with open(save_path, 'w') as fout:
+        fout.write('%s\n%s\n%s\n' % (START_MARK, END_MARK, UNK_MARK))
+        # frequency-descending, word tie-break for determinism
+        for idx, (word, _c) in enumerate(sorted(
+                word_dict.items(), key=lambda x: (-x[1], x[0]))):
+            if idx + 3 == dict_size:
+                break
+            fout.write('%s\n' % word)
+
+
+def _load_dict(tar_path, dict_size, lang, reverse=False):
+    dict_path = os.path.join(os.path.dirname(tar_path),
+                             '%s_%d.dict' % (lang, dict_size))
+    if not os.path.exists(dict_path) or \
+            len(open(dict_path).readlines()) != dict_size:
+        _build_dict(tar_path, dict_size, dict_path, lang)
+    word_dict = {}
+    with open(dict_path) as fdict:
+        for idx, line in enumerate(fdict):
+            if reverse:
+                word_dict[idx] = line.strip()
+            else:
+                word_dict[line.strip()] = idx
+    return word_dict
+
+
+def reader_creator(tar_path, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    def reader():
+        src_dict = _load_dict(tar_path, src_dict_size, src_lang)
+        trg_dict = _load_dict(tar_path, trg_dict_size,
+                              'de' if src_lang == 'en' else 'en')
+        start_id = src_dict[START_MARK]
+        end_id = src_dict[END_MARK]
+        unk_id = src_dict[UNK_MARK]
+        src_col = 0 if src_lang == 'en' else 1
+        trg_col = 1 - src_col
+        with tarfile.open(tar_path, mode='r') as f:
+            for line in f.extractfile(file_name):
+                parts = line.decode('utf-8').strip().split('\t')
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + [src_dict.get(w, unk_id)
+                                        for w in parts[src_col].split()] \
+                    + [end_id]
+                trg_ids = [trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                yield (src_ids, [start_id] + trg_ids,
+                       trg_ids + [end_id])
+    return reader
+
 
 def get_dict(lang, dict_size, reverse=False):
+    tar = _cached_tar()
+    if tar:
+        return _load_dict(tar, dict_size, lang, reverse)
     d = {('%s_w%d' % (lang, i)): i for i in range(dict_size)}
     return {v: k for k, v in d.items()} if reverse else d
 
@@ -33,14 +122,26 @@ def _reader(split, n, src_dict_size, trg_dict_size):
 
 def train(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
           src_lang='en'):
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'wmt16/train', src_dict_size,
+                              trg_dict_size, src_lang)
     return _reader('train', _TRAIN_N, src_dict_size, trg_dict_size)
 
 
 def test(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
          src_lang='en'):
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'wmt16/test', src_dict_size,
+                              trg_dict_size, src_lang)
     return _reader('test', _TEST_N, src_dict_size, trg_dict_size)
 
 
 def validation(src_dict_size=_SRC_VOCAB, trg_dict_size=_TRG_VOCAB,
                src_lang='en'):
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'wmt16/val', src_dict_size,
+                              trg_dict_size, src_lang)
     return _reader('val', _TEST_N, src_dict_size, trg_dict_size)

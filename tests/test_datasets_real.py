@@ -167,3 +167,58 @@ def test_movielens_zip_parse(data_home):
     assert movielens.max_job_id() == 12
     assert 'Action' in movielens.movie_categories()
     assert 'toy' in movielens.get_movie_title_dict()
+
+
+def test_imikolov_tar_parse(data_home):
+    from paddle_tpu.dataset import imikolov
+    d = data_home / 'imikolov'
+    d.mkdir()
+    train_txt = b'the cat sat\nthe cat ran\n'
+    valid_txt = b'the dog sat\n'
+    with tarfile.open(str(d / imikolov.ARCHIVE), 'w:gz') as t:
+        _add_tar_member(t, './simple-examples/data/ptb.train.txt',
+                        train_txt)
+        _add_tar_member(t, './simple-examples/data/ptb.valid.txt',
+                        valid_txt)
+    wd = imikolov.build_dict(min_word_freq=0)
+    # 'the' (3x) and the per-line <s>/<e> (3x each) dominate; <unk> last
+    assert wd['<unk>'] == max(wd.values())
+    assert wd['the'] < wd['dog']
+    grams = list(imikolov.train(wd, n=3)())
+    framed = ['<s>', 'the', 'cat', 'sat', '<e>']
+    want_first = tuple(wd[w] for w in framed[:3])
+    assert grams[0] == want_first
+    assert len(grams) == 3 + 3 + 0   # two 5-token lines -> 3 trigrams each
+    seqs = list(imikolov.train(wd, n=0,
+                               data_type=imikolov.DataType.SEQ)())
+    assert seqs[0][0][0] == wd['<s>'] and seqs[0][1][-1] == wd['<e>']
+
+
+def test_wmt16_tar_parse(data_home):
+    from paddle_tpu.dataset import wmt16
+    d = data_home / 'wmt16'
+    d.mkdir()
+    train_tsv = (b'a cat\neine katze\n'            # malformed: skipped
+                 b'a cat\teine katze\n'
+                 b'the cat\tdie katze\n')
+    test_tsv = b'a dog\tein hund\n'
+    with tarfile.open(str(d / wmt16.ARCHIVE), 'w:gz') as t:
+        _add_tar_member(t, 'wmt16/train', train_tsv)
+        _add_tar_member(t, 'wmt16/test', test_tsv)
+        _add_tar_member(t, 'wmt16/val', test_tsv)
+    en = wmt16.get_dict('en', 8)
+    de = wmt16.get_dict('de', 8)
+    assert en['<s>'] == 0 and en['<e>'] == 1 and en['<unk>'] == 2
+    assert 'cat' in en and 'katze' in de        # built from train side
+    rows = list(wmt16.train(8, 8)())
+    assert len(rows) == 2
+    src, trg_in, trg_next = rows[0]
+    assert src == [0, en['a'], en['cat'], 1]
+    assert trg_in == [0, de['eine'], de['katze']]
+    assert trg_next == [de['eine'], de['katze'], 1]
+    # de as source swaps columns
+    rows_de = list(wmt16.train(8, 8, src_lang='de')())
+    assert rows_de[0][0] == [0, de['eine'], de['katze'], 1]
+    # unknown words in test map to <unk>=2
+    t_rows = list(wmt16.test(8, 8)())
+    assert t_rows[0][0] == [0, en.get('a'), 2, 1]
