@@ -1,6 +1,18 @@
-"""MQ2007 learning-to-rank (reference: python/paddle/v2/dataset/mq2007.py).
-Modes: 'pointwise' (feature, relevance), 'pairwise' (better, worse),
-'listwise' (per-query feature list, label list)."""
+"""MQ2007 learning-to-rank (reference: python/paddle/v2/dataset/
+mq2007.py:48-240 — Query parse, QueryList grouping, the
+pointwise/pairwise/listwise generators).
+
+Real-data path (round 5): the reference shipped a .rar (rarfile is not
+in this environment), so drop the EXTRACTED LETOR fold files
+`Fold1/train.txt` / `Fold1/test.txt` under $PADDLE_TPU_DATA/mq2007/
+and the readers parse with the reference semantics: each line is
+`rel qid:N 1:v ... 46:v # docid ...` (48 space-split parts before the
+comment), lines group into per-query lists in file order, and the
+three formats yield (feature, score), (better, worse) full-order
+pairs, or per-query (features, labels). Malformed lines are skipped
+like the reference's None-parse path. Synthetic fallback otherwise."""
+
+import os
 
 import numpy as np
 
@@ -9,6 +21,69 @@ from . import common
 FEATURE_DIM = 46
 _QUERIES = 128
 _DOCS_PER_QUERY = 8
+
+TRAIN_FILE = os.path.join('Fold1', 'train.txt')
+TEST_FILE = os.path.join('Fold1', 'test.txt')
+
+
+def _cached_file(name):
+    p = common.cached_path('mq2007', name)
+    return p if os.path.exists(p) else None
+
+
+def _parse_line(text):
+    """(relevance, query_id, [46 floats]) or None (reference Query
+    ._parse_ :83-101)."""
+    comment = text.find('#')
+    line = (text[:comment] if comment >= 0 else text).strip()
+    parts = line.split()
+    if len(parts) != 48:
+        return None
+    try:
+        rel = int(parts[0])
+        qid = int(parts[1].split(':')[1])
+        feats = [float(p.split(':')[1]) for p in parts[2:]]
+    except (IndexError, ValueError):
+        return None
+    return rel, qid, feats
+
+
+def _load_queries(path):
+    """[(qid, feats [n,46], rels [n])] grouped in file order."""
+    order = []
+    by_qid = {}
+    with open(path) as f:
+        for text in f:
+            parsed = _parse_line(text)
+            if parsed is None:
+                continue
+            rel, qid, feats = parsed
+            if qid not in by_qid:
+                by_qid[qid] = ([], [])
+                order.append(qid)
+            by_qid[qid][0].append(feats)
+            by_qid[qid][1].append(rel)
+    return [(qid,
+             np.asarray(by_qid[qid][0], 'float32'),
+             np.asarray(by_qid[qid][1], 'int64')) for qid in order]
+
+
+def _file_reader(path, format):
+    def reader():
+        for _qid, feats, rels in _load_queries(path):
+            if format == 'pointwise':
+                for f, y in zip(feats, rels):
+                    yield f, int(y)
+            elif format == 'pairwise':
+                for i in range(len(rels)):
+                    for j in range(len(rels)):
+                        if rels[i] > rels[j]:
+                            yield feats[i], feats[j]
+            elif format == 'listwise':
+                yield feats, rels
+            else:
+                raise ValueError('unknown format %r' % format)
+    return reader
 
 
 def _make_query(r):
@@ -42,8 +117,14 @@ def _reader(split, format):
 
 
 def train(format='pairwise'):
+    f = _cached_file(TRAIN_FILE)
+    if f:
+        return _file_reader(f, format)
     return _reader('train', format)
 
 
 def test(format='pairwise'):
+    f = _cached_file(TEST_FILE)
+    if f:
+        return _file_reader(f, format)
     return _reader('test', format)

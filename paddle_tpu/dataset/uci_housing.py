@@ -1,6 +1,14 @@
-"""UCI housing (reference: python/paddle/v2/dataset/uci_housing.py).
-13 features -> house price; synthetic fallback keeps the linear structure
-so fit_a_line converges the same way."""
+"""UCI housing (reference: python/paddle/v2/dataset/uci_housing.py
+:59-75 load_data).
+
+Real-data path (round 5): drop `housing.data` (the 506×14 whitespace
+float table) under $PADDLE_TPU_DATA/uci_housing/ and the readers parse
+with the reference semantics: per-feature normalization
+(x - mean) / (max - min) computed over the WHOLE file, then an 80/20
+train/test split in file order. Synthetic linear fallback otherwise
+(fit_a_line converges the same way)."""
+
+import os
 
 import numpy as np
 
@@ -11,6 +19,34 @@ feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
 
 _TRAIN_N = 404
 _TEST_N = 102
+
+DATA_FILE = 'housing.data'
+
+
+def _cached_file():
+    p = common.cached_path('uci_housing', DATA_FILE)
+    return p if os.path.exists(p) else None
+
+
+def load_data(filename, feature_num=14, ratio=0.8):
+    """(train_rows, test_rows) with the reference normalization."""
+    data = np.fromfile(filename, sep=' ')
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
+
+def _file_reader(rows):
+    def reader():
+        for row in rows:
+            yield row[:-1].astype('float32'), \
+                row[-1:].astype('float32')
+    return reader
 
 
 def _synthetic(split, n):
@@ -30,8 +66,14 @@ def _reader(split, n):
 
 
 def train():
+    f = _cached_file()
+    if f:
+        return _file_reader(load_data(f)[0])
     return _reader('train', _TRAIN_N)
 
 
 def test():
+    f = _cached_file()
+    if f:
+        return _file_reader(load_data(f)[1])
     return _reader('test', _TEST_N)

@@ -222,3 +222,51 @@ def test_wmt16_tar_parse(data_home):
     # unknown words in test map to <unk>=2
     t_rows = list(wmt16.test(8, 8)())
     assert t_rows[0][0] == [0, en.get('a'), 2, 1]
+
+
+def test_uci_housing_file_parse(data_home):
+    from paddle_tpu.dataset import uci_housing
+    d = data_home / 'uci_housing'
+    d.mkdir()
+    rng = np.random.RandomState(7)
+    table = rng.rand(10, 14) * 10
+    with open(str(d / uci_housing.DATA_FILE), 'w') as f:
+        for row in table:
+            f.write(' '.join('%.6f' % v for v in row) + '\n')
+    rows = list(uci_housing.train()())
+    test_rows = list(uci_housing.test()())
+    assert len(rows) == 8 and len(test_rows) == 2     # 80/20 in order
+    x0, y0 = rows[0]
+    assert x0.shape == (13,) and y0.shape == (1,)
+    # reference normalization: (x - mean) / (max - min), target raw
+    want = (table[0, 0] - table[:, 0].mean()) / \
+        (table[:, 0].max() - table[:, 0].min())
+    np.testing.assert_allclose(x0[0], want, rtol=1e-5)
+    np.testing.assert_allclose(y0[0], table[0, 13], rtol=1e-5)
+
+
+def test_mq2007_letor_parse(data_home):
+    from paddle_tpu.dataset import mq2007
+    d = data_home / 'mq2007' / 'Fold1'
+    d.mkdir(parents=True)
+    def line(rel, qid, base):
+        feats = ' '.join('%d:%.3f' % (i + 1, base + i * 0.01)
+                         for i in range(46))
+        return '%d qid:%d %s #docid = GX%03d\n' % (rel, qid, feats, qid)
+    with open(str(d / 'train.txt'), 'w') as f:
+        f.write(line(2, 10, 0.5))
+        f.write(line(0, 10, 0.1))
+        f.write('garbage line\n')                     # skipped
+        f.write(line(1, 11, 0.3))
+    pt = list(mq2007.train('pointwise')())
+    assert [y for _, y in pt] == [2, 0, 1]
+    assert pt[0][0].shape == (46,)
+    np.testing.assert_allclose(pt[0][0][0], 0.5, rtol=1e-5)
+    pairs = list(mq2007.train('pairwise')())
+    assert len(pairs) == 1                            # only 2>0 in qid 10
+    np.testing.assert_allclose(pairs[0][0][0], 0.5, rtol=1e-5)
+    np.testing.assert_allclose(pairs[0][1][0], 0.1, rtol=1e-5)
+    lists = list(mq2007.train('listwise')())
+    assert len(lists) == 2                            # two queries
+    assert lists[0][0].shape == (2, 46)
+    assert lists[1][1].tolist() == [1]
