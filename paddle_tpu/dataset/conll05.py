@@ -1,7 +1,23 @@
 """CoNLL-2005 semantic role labeling (reference:
-python/paddle/v2/dataset/conll05.py). Schema: (word_ids, ctx_n2, ctx_n1,
-ctx_0, ctx_p1, ctx_p2, verb_id, mark, label_ids) per sentence.
+python/paddle/v2/dataset/conll05.py:41-230). Schema: (word_ids, ctx_n2,
+ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_id, mark, label_ids) per
+(sentence, predicate) pair.
+
+Real-data path (round 5): drop the reference's test split archive
+`conll05st-tests.tar.gz` (members conll05st-release/test.wsj/words/
+test.wsj.words.gz and .../props/test.wsj.props.gz) plus the three dict
+files `wordDict.txt` / `verbDict.txt` / `targetDict.txt` under
+$PADDLE_TPU_DATA/conll05st/. Parsing follows the reference: words and
+props files zip line-by-line (blank line = sentence end), the props
+lemma column names the predicates, per-predicate bracket tags convert
+to BIO ('*'→O, '(X*'→B-X opening, '*)'→I-close, '(X*)'→single B-X),
+and each (sentence, predicate) pair featurizes into the 9-slot record
+with the five predicate-context windows and the ±2 mark vector.
 Synthetic fallback keeps the 9-slot schema and label cardinality."""
+
+import gzip
+import os
+import tarfile
 
 import numpy as np
 
@@ -14,8 +30,126 @@ _TRAIN_N = 1024
 _TEST_N = 256
 _MAX_LEN = 30
 
+UNK_IDX = 0
+
+ARCHIVE = 'conll05st-tests.tar.gz'
+WORDS_NAME = 'conll05st-release/test.wsj/words/test.wsj.words.gz'
+PROPS_NAME = 'conll05st-release/test.wsj/props/test.wsj.props.gz'
+WORD_DICT_FILE = 'wordDict.txt'
+VERB_DICT_FILE = 'verbDict.txt'
+LABEL_DICT_FILE = 'targetDict.txt'
+
+
+def _cached(name):
+    p = common.cached_path('conll05st', name)
+    return p if os.path.exists(p) else None
+
+
+def load_dict(filename):
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _bracket_to_bio(tags):
+    """One predicate's bracket column -> BIO sequence (reference
+    :85-107)."""
+    out = []
+    cur = 'O'
+    in_bracket = False
+    for l in tags:
+        if l == '*' and not in_bracket:
+            out.append('O')
+        elif l == '*' and in_bracket:
+            out.append('I-' + cur)
+        elif l == '*)':
+            out.append('I-' + cur)
+            in_bracket = False
+        elif '(' in l and ')' in l:
+            cur = l[1:l.find('*')]
+            out.append('B-' + cur)
+            in_bracket = False
+        elif '(' in l and ')' not in l:
+            cur = l[1:l.find('*')]
+            out.append('B-' + cur)
+            in_bracket = True
+        else:
+            raise RuntimeError('Unexpected label: %s' % l)
+    return out
+
+
+def corpus_reader(data_path, words_name=WORDS_NAME, props_name=PROPS_NAME):
+    """Yields (sentence_words, predicate, bio_labels) per
+    (sentence, predicate) pair."""
+    def reader():
+        with tarfile.open(data_path) as tf:
+            wf = tf.extractfile(words_name)
+            pf = tf.extractfile(props_name)
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentence = []
+                columns = []       # per-token [lemma, tag1, tag2, ...]
+                for word, label in zip(words_file, props_file):
+                    word = word.decode('utf-8').strip()
+                    label = label.decode('utf-8').strip().split()
+                    if not label:  # blank line: end of sentence
+                        if columns:
+                            # transpose: column 0 = lemmas, 1.. = tags
+                            cols = [[tok[i] for tok in columns]
+                                    for i in range(len(columns[0]))]
+                            verbs = [x for x in cols[0] if x != '-']
+                            for i, tags in enumerate(cols[1:]):
+                                yield (sentence, verbs[i],
+                                       _bracket_to_bio(tags))
+                        sentence = []
+                        columns = []
+                    else:
+                        sentence.append(word)
+                        columns.append(label)
+    return reader
+
+
+def reader_creator(corpus, word_dict, predicate_dict, label_dict):
+    """The 9-slot featurization (reference :128-178)."""
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index('B-V')
+            mark = [0] * len(labels)
+
+            def ctx(offset, default):
+                i = verb_index + offset
+                if 0 <= i < sen_len:
+                    mark[i] = 1
+                    return sentence[i]
+                return default
+
+            ctx_n2 = ctx(-2, 'bos')
+            ctx_n1 = ctx(-1, 'bos')
+            ctx_0 = ctx(0, None)
+            ctx_p1 = ctx(1, 'eos')
+            ctx_p2 = ctx(2, 'eos')
+
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+
+            def rep(w):
+                return [word_dict.get(w, UNK_IDX)] * sen_len
+
+            yield (word_idx, rep(ctx_n2), rep(ctx_n1), rep(ctx_0),
+                   rep(ctx_p1), rep(ctx_p2),
+                   [predicate_dict.get(predicate)] * sen_len, mark,
+                   [label_dict.get(w) for w in labels])
+    return reader
+
 
 def get_dict():
+    """(word_dict, verb_dict, label_dict) — real files when cached."""
+    w, v, l = (_cached(WORD_DICT_FILE), _cached(VERB_DICT_FILE),
+               _cached(LABEL_DICT_FILE))
+    if w and v and l:
+        return load_dict(w), load_dict(v), load_dict(l)
     word_dict = {('w%d' % i): i for i in range(WORD_DICT_LEN)}
     verb_dict = {('v%d' % i): i for i in range(PRED_DICT_LEN)}
     label_dict = {('l%d' % i): i for i in range(LABEL_DICT_LEN)}
@@ -40,8 +174,15 @@ def _reader(split, n):
 
 
 def train():
-    return _reader('train', _TRAIN_N)
+    # the reference's public release only ships the test.wsj split; a
+    # cached archive therefore serves both creators, like its demo did
+    return test() if _cached(ARCHIVE) else _reader('train', _TRAIN_N)
 
 
 def test():
+    tar = _cached(ARCHIVE)
+    if tar:
+        word_dict, verb_dict, label_dict = get_dict()
+        return reader_creator(corpus_reader(tar), word_dict, verb_dict,
+                              label_dict)
     return _reader('test', _TEST_N)

@@ -1,5 +1,20 @@
 """Movie review sentiment, NLTK-style (reference:
-python/paddle/v2/dataset/sentiment.py). Schema: (word_id_list, label)."""
+python/paddle/v2/dataset/sentiment.py:52-120). Schema:
+(word_id_list, label) with label 0=neg, 1=pos.
+
+Real-data path (round 5): drop the NLTK corpus archive
+`movie_reviews.zip` (members movie_reviews/{neg,pos}/*.txt — the
+pre-tokenized corpus) under $PADDLE_TPU_DATA/sentiment/. Reference
+semantics: the word dictionary is frequency-sorted over the whole
+corpus (no cutoff; ties broken by word here for determinism — the
+reference's cmp-sort left them at insertion order), files interleave
+neg/pos in sorted order (sort_files), the first 1600 interleaved
+samples are train and the rest test. Synthetic class-biased token
+distributions otherwise."""
+
+import collections
+import os
+import zipfile
 
 import numpy as np
 
@@ -10,9 +25,63 @@ NUM_TOTAL_INSTANCES = 2000
 _VOCAB = 8000
 _MAX_LEN = 60
 
+ARCHIVE = 'movie_reviews.zip'
+
+
+def _cached_zip():
+    p = common.cached_path('sentiment', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def _doc_words(z, name):
+    text = z.read(name).decode('utf-8', errors='replace')
+    return [w.lower() for w in text.split()]
+
+
+def _sorted_files(z):
+    """Interleaved neg/pos file list (reference sort_files :73-83)."""
+    neg = sorted(n for n in z.namelist()
+                 if '/neg/' in n and n.endswith('.txt'))
+    pos = sorted(n for n in z.namelist()
+                 if '/pos/' in n and n.endswith('.txt'))
+    out = []
+    for a, b in zip(neg, pos):
+        out.extend((a, b))
+    return out
+
 
 def get_word_dict():
-    return [('w%d' % i, i) for i in range(_VOCAB)]
+    """[(word, id)] frequency-sorted over the whole corpus (reference
+    :52-70); synthetic ids otherwise."""
+    zp = _cached_zip()
+    if zp is None:
+        return [('w%d' % i, i) for i in range(_VOCAB)]
+    freq = collections.defaultdict(int)
+    with zipfile.ZipFile(zp) as z:
+        for name in _sorted_files(z):
+            for w in _doc_words(z, name):
+                freq[w] += 1
+    ordered = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+    return [(w, i) for i, (w, _c) in enumerate(ordered)]
+
+
+def _load_corpus():
+    zp = _cached_zip()
+    ids = dict(get_word_dict())
+    samples = []
+    with zipfile.ZipFile(zp) as z:
+        for name in _sorted_files(z):
+            label = 0 if '/neg/' in name else 1
+            samples.append(
+                ([ids[w] for w in _doc_words(z, name)], label))
+    return samples
+
+
+def _corpus_reader(lo, hi):
+    def reader():
+        for doc, label in _load_corpus()[lo:hi]:
+            yield doc, label
+    return reader
 
 
 def _reader(split, n):
@@ -33,8 +102,12 @@ def _reader(split, n):
 
 
 def train():
+    if _cached_zip():
+        return _corpus_reader(0, NUM_TRAINING_INSTANCES)
     return _reader('train', NUM_TRAINING_INSTANCES)
 
 
 def test():
+    if _cached_zip():
+        return _corpus_reader(NUM_TRAINING_INSTANCES, None)
     return _reader('test', NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -270,3 +270,77 @@ def test_mq2007_letor_parse(data_home):
     assert len(lists) == 2                            # two queries
     assert lists[0][0].shape == (2, 46)
     assert lists[1][1].tolist() == [1]
+
+
+def test_conll05_tar_parse(data_home):
+    import gzip
+    from paddle_tpu.dataset import conll05
+    d = data_home / 'conll05st'
+    d.mkdir()
+    # one 5-token sentence with TWO predicates (columns), then EOS line
+    words = 'The cat chased the mouse\n'.replace(' ', '\n') + '\n'
+    props_rows = [
+        # lemma  pred1-tags  pred2-tags
+        ('-', '(A0*', '*'),
+        ('-', '*)', '(A0*)'),
+        ('chase', '(V*)', '*'),
+        ('-', '(A1*', '(V*)'),
+        ('see', '*)', '(A1*)'),
+    ]
+    props = ''.join('\t'.join(r) + '\n' for r in props_rows) + '\n'
+    with tarfile.open(str(d / conll05.ARCHIVE), 'w:gz') as t:
+        _add_tar_member(t, conll05.WORDS_NAME,
+                        gzip.compress(words.encode()))
+        _add_tar_member(t, conll05.PROPS_NAME,
+                        gzip.compress(props.encode()))
+    for fname, items in ((conll05.WORD_DICT_FILE,
+                          ['<unk>', 'The', 'cat', 'chased', 'the',
+                           'mouse', 'bos', 'eos']),
+                         (conll05.VERB_DICT_FILE, ['chase', 'see']),
+                         (conll05.LABEL_DICT_FILE,
+                          ['O', 'B-A0', 'I-A0', 'B-V', 'I-V', 'B-A1',
+                           'I-A1'])):
+        with open(str(d / fname), 'w') as f:
+            f.write('\n'.join(items) + '\n')
+
+    rows = list(conll05.test()())
+    assert len(rows) == 2                     # one per predicate
+    (w, n2, n1, c0, p1, p2, pred, mark, lab) = rows[0]
+    wd, vd, ld = conll05.get_dict()
+    assert w == [wd[t] for t in ['The', 'cat', 'chased', 'the', 'mouse']]
+    # predicate 1: verb at index 2 → ctx windows around it
+    assert c0 == [wd['chased']] * 5 and n1 == [wd['cat']] * 5
+    assert p2 == [wd['mouse']] * 5
+    assert mark == [1, 1, 1, 1, 1]            # ±2 covers all 5 tokens
+    assert lab == [ld[t] for t in ['B-A0', 'I-A0', 'B-V', 'B-A1',
+                                   'I-A1']]
+    assert pred == [vd['chase']] * 5
+    # predicate 2: verb at index 3, B-A0 single-token at index 1
+    lab2 = rows[1][8]
+    assert lab2 == [ld[t] for t in ['O', 'B-A0', 'O', 'B-V', 'B-A1']]
+    assert rows[1][6] == [vd['see']] * 5
+
+
+def test_sentiment_zip_parse(data_home):
+    from paddle_tpu.dataset import sentiment
+    d = data_home / 'sentiment'
+    d.mkdir()
+    docs = {
+        'movie_reviews/neg/cv000.txt': b'bad awful bad',
+        'movie_reviews/neg/cv001.txt': b'bad plot',
+        'movie_reviews/pos/cv000.txt': b'good great GOOD',
+        'movie_reviews/pos/cv001.txt': b'good fun',
+    }
+    with zipfile.ZipFile(str(d / sentiment.ARCHIVE), 'w') as z:
+        for name, data in docs.items():
+            z.writestr(name, data)
+    wd = dict(sentiment.get_word_dict())
+    # frequency-sorted: 'bad' and 'good' (3x each) take ids 0/1
+    assert {wd['bad'], wd['good']} == {0, 1}
+    rows = list(sentiment.train()())
+    assert len(rows) == 4
+    # interleaved neg/pos: labels alternate 0,1,0,1
+    assert [l for _, l in rows] == [0, 1, 0, 1]
+    assert rows[0][0] == [wd['bad'], wd['awful'], wd['bad']]
+    assert rows[1][0] == [wd['good'], wd['great'], wd['good']]
+    assert list(sentiment.test()()) == []     # tiny corpus: all in train
