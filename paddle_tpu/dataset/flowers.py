@@ -1,16 +1,23 @@
-"""Oxford 102 flowers (reference: python/paddle/v2/dataset/flowers.py).
-Schema: (image_chw_float32, label).
+"""Oxford 102 flowers (reference: python/paddle/v2/dataset/flowers.py
+:44-120). Schema: (image_float32_flat, label).
 
-Like the reference, raw HWC images go through the default
-image.simple_transform mapper (reference flowers.py wires
-v2/image.py:291 simple_transform as default_mapper: resize-short then
-train random-crop+flip / test center-crop, then CHW float). Synthetic
-class-colored noise stands in for the tarball (zero egress); sizes are
-scaled down (resize 40, crop 32 vs the reference's 256/224) to keep
-tests fast — the pipeline shape is identical.
+Real-data path (round 5): drop `102flowers.tgz`, `imagelabels.mat`,
+and `setid.mat` under $PADDLE_TPU_DATA/flowers/. Reference semantics:
+setid.mat's index lists pick members `jpg/image_%05d.jpg`, labels come
+from imagelabels.mat (1-based → label-1 yielded), the train/test flags
+are deliberately SWAPPED ('tstid' is train — the reference's own
+readme note, test data outnumbers train), and every image runs the
+default mapper: decode → simple_transform resize 256 / crop 224 (train
+random-crop+flip, test center-crop) with the reference BGR mean →
+flattened float32.
+
+Synthetic fallback: class-colored noise with the same pipeline at
+scaled-down sizes (resize 40, crop 32) to keep tests fast.
 """
 
 import functools
+import os
+import tarfile
 
 import numpy as np
 
@@ -23,6 +30,51 @@ _TEST_N = 256
 _RAW_HW = (48, 56)     # synthetic source images (HWC uint8, non-square)
 RESIZE_SIZE = 40
 CROP_SIZE = 32
+
+DATA_ARCHIVE = '102flowers.tgz'
+LABEL_FILE = 'imagelabels.mat'
+SETID_FILE = 'setid.mat'
+# the reference swaps the official flags: 'tstid' is the TRAIN list
+TRAIN_FLAG = 'tstid'
+TEST_FLAG = 'trnid'
+VALID_FLAG = 'valid'
+_REAL_MEAN = [103.94, 116.78, 123.68]
+
+
+def _cached(name):
+    p = common.cached_path('flowers', name)
+    return p if os.path.exists(p) else None
+
+
+def _have_real():
+    return all(_cached(n) for n in (DATA_ARCHIVE, LABEL_FILE, SETID_FILE))
+
+
+def _real_mapper(is_train, sample):
+    """Reference default_mapper: jpeg bytes -> 256/224 transform ->
+    flat float32 (flowers.py:58-66)."""
+    img_bytes, label = sample
+    img = image.load_image_bytes(img_bytes)
+    img = image.simple_transform(img, 256, 224, is_train,
+                                 mean=_REAL_MEAN)
+    return img.flatten().astype('float32'), label
+
+
+def _tar_reader(dataset_name, mapper):
+    import scipy.io as scio
+    labels = scio.loadmat(_cached(LABEL_FILE))['labels'][0]
+    indexes = scio.loadmat(_cached(SETID_FILE))[dataset_name][0]
+    img2label = {'jpg/image_%05d.jpg' % i: int(labels[i - 1])
+                 for i in indexes}
+
+    def reader():
+        with tarfile.open(_cached(DATA_ARCHIVE)) as tf:
+            for name, label in sorted(img2label.items()):
+                f = tf.extractfile(name)
+                if f is None:
+                    continue
+                yield mapper((f.read(), label - 1))
+    return reader
 
 
 def default_mapper(is_train, sample):
@@ -52,12 +104,23 @@ def _reader(split, n, mapper, buffered_size=1024):
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _tar_reader(TRAIN_FLAG,
+                           mapper or functools.partial(_real_mapper, True))
     return _reader('train', _TRAIN_N, mapper, buffered_size)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _tar_reader(TEST_FLAG,
+                           mapper or functools.partial(_real_mapper,
+                                                       False))
     return _reader('test', _TEST_N, mapper, buffered_size)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _tar_reader(VALID_FLAG,
+                           mapper or functools.partial(_real_mapper,
+                                                       False))
     return _reader('valid', _TEST_N, mapper, buffered_size)

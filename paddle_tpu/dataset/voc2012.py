@@ -1,7 +1,19 @@
 """PASCAL VOC2012 segmentation (reference:
-python/paddle/v2/dataset/voc2012.py). Schema: (image_chw, seg_label_hw).
-Raw HWC frames go through image.to_chw like the reference's PIL decode
-path (v2/image.py:189)."""
+python/paddle/v2/dataset/voc2012.py:28-80). Schema:
+(image_hwc_array, seg_label_hw_array) — raw PIL-decoded numpy arrays,
+like the reference.
+
+Real-data path (round 5): drop `VOCtrainval_11-May-2012.tar` under
+$PADDLE_TPU_DATA/voc2012/ and the readers parse with the reference
+semantics: the ImageSets/Segmentation/{trainval,train,val}.txt lists
+select frames, JPEGImages/<id>.jpg and SegmentationClass/<id>.png
+decode via PIL (the palette PNG yields the class-index map directly).
+Reference quirk preserved: train() reads the 'trainval' list and
+test() the 'train' list. Synthetic blocky masks otherwise."""
+
+import io
+import os
+import tarfile
 
 import numpy as np
 
@@ -12,6 +24,34 @@ CLASS_NUM = 21  # 20 classes + background
 _TRAIN_N = 256
 _TEST_N = 64
 _SHAPE = (3, 32, 32)
+
+ARCHIVE = 'VOCtrainval_11-May-2012.tar'
+SET_FILE = 'VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt'
+DATA_FILE = 'VOCdevkit/VOC2012/JPEGImages/{}.jpg'
+LABEL_FILE = 'VOCdevkit/VOC2012/SegmentationClass/{}.png'
+
+
+def _cached_tar():
+    p = common.cached_path('voc2012', ARCHIVE)
+    return p if os.path.exists(p) else None
+
+
+def reader_creator(filename, sub_name):
+    def reader():
+        from PIL import Image
+        with tarfile.open(filename) as tarobject:
+            sets = tarobject.extractfile(SET_FILE.format(sub_name))
+            ids = [ln.decode('utf-8').strip() for ln in sets]
+            for frame in ids:
+                if not frame:
+                    continue
+                data = tarobject.extractfile(
+                    DATA_FILE.format(frame)).read()
+                label = tarobject.extractfile(
+                    LABEL_FILE.format(frame)).read()
+                yield (np.array(Image.open(io.BytesIO(data))),
+                       np.array(Image.open(io.BytesIO(label))))
+    return reader
 
 
 def _reader(split, n):
@@ -32,12 +72,21 @@ def _reader(split, n):
 
 
 def train():
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'trainval')
     return _reader('train', _TRAIN_N)
 
 
 def test():
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'train')
     return _reader('test', _TEST_N)
 
 
 def val():
+    tar = _cached_tar()
+    if tar:
+        return reader_creator(tar, 'val')
     return _reader('val', _TEST_N)

@@ -344,3 +344,78 @@ def test_sentiment_zip_parse(data_home):
     assert rows[0][0] == [wd['bad'], wd['awful'], wd['bad']]
     assert rows[1][0] == [wd['good'], wd['great'], wd['good']]
     assert list(sentiment.test()()) == []     # tiny corpus: all in train
+
+
+def test_voc2012_tar_parse(data_home):
+    from PIL import Image
+    from paddle_tpu.dataset import voc2012
+    d = data_home / 'voc2012'
+    d.mkdir()
+
+    def jpg_bytes(seed):
+        rng = np.random.RandomState(seed)
+        im = Image.fromarray(rng.randint(0, 255, (8, 10, 3), 'uint8'))
+        buf = io.BytesIO()
+        im.save(buf, format='JPEG')
+        return buf.getvalue()
+
+    def png_label(cls):
+        # grayscale PNG: exact index roundtrip (real VOC uses 'P' with
+        # the fixed 256-entry palette; np.array decodes both to the
+        # class-index map through the same parser path)
+        arr = np.full((8, 10), cls, 'uint8')
+        im = Image.fromarray(arr, mode='L')
+        buf = io.BytesIO()
+        im.save(buf, format='PNG')
+        return buf.getvalue()
+
+    with tarfile.open(str(d / voc2012.ARCHIVE), 'w') as t:
+        _add_tar_member(t, voc2012.SET_FILE.format('trainval'),
+                        b'f0\nf1\n')
+        _add_tar_member(t, voc2012.SET_FILE.format('train'), b'f0\n')
+        _add_tar_member(t, voc2012.SET_FILE.format('val'), b'f1\n')
+        for i in range(2):
+            _add_tar_member(t, voc2012.DATA_FILE.format('f%d' % i),
+                            jpg_bytes(i))
+            _add_tar_member(t, voc2012.LABEL_FILE.format('f%d' % i),
+                            png_label(i + 3))
+    rows = list(voc2012.train()())
+    assert len(rows) == 2                      # trainval list
+    img, seg = rows[0]
+    assert img.shape == (8, 10, 3) and seg.shape == (8, 10)
+    assert (seg == 3).all()                    # palette index preserved
+    assert len(list(voc2012.test()())) == 1    # reference quirk: 'train'
+    assert (list(voc2012.val()())[0][1] == 4).all()
+
+
+def test_flowers_tar_parse(data_home):
+    import scipy.io as scio
+    from PIL import Image
+    from paddle_tpu.dataset import flowers
+    d = data_home / 'flowers'
+    d.mkdir()
+
+    def jpg_bytes(seed):
+        rng = np.random.RandomState(seed)
+        im = Image.fromarray(rng.randint(0, 255, (300, 280, 3), 'uint8'))
+        buf = io.BytesIO()
+        im.save(buf, format='JPEG')
+        return buf.getvalue()
+
+    with tarfile.open(str(d / flowers.DATA_ARCHIVE), 'w:gz') as t:
+        for i in (1, 2, 3, 4):
+            _add_tar_member(t, 'jpg/image_%05d.jpg' % i, jpg_bytes(i))
+    scio.savemat(str(d / flowers.LABEL_FILE),
+                 {'labels': np.array([[5, 6, 7, 8]])})
+    scio.savemat(str(d / flowers.SETID_FILE),
+                 {'tstid': np.array([[1, 2]]),      # train (swapped)
+                  'trnid': np.array([[3]]),
+                  'valid': np.array([[4]])})
+    rows = list(flowers.train()())
+    assert len(rows) == 2
+    x, y = rows[0]
+    assert x.dtype == np.float32 and x.shape == (3 * 224 * 224,)
+    assert y == 4                              # 1-based 5 -> label-1
+    t_rows = list(flowers.test()())
+    assert len(t_rows) == 1 and t_rows[0][1] == 6
+    assert [r[1] for r in flowers.valid()()] == [7]
