@@ -287,3 +287,25 @@ def test_rnn_search_decodes_reproduce_training():
     assert (g == feed['lbl_word']).mean() > 0.8
     assert (bi[:, 0, :] == g).mean() > 0.9
     assert np.all(np.diff(bs, axis=1) <= 1e-5)  # sorted best-first
+
+
+def test_wide_deep_ctr_lazy_adam():
+    """The flagship CTR config under AdamOptimizer(lazy_mode=True) (r5):
+    the is_sparse tables take the lazy row path — loss decreases and the
+    compiled step never materializes a vocab-sized Adam update (the
+    structural proof lives in tests/test_sparse_grad.py; this is the
+    whole-model integration)."""
+    from paddle_tpu.models.wide_deep import build
+    _predict, loss, _acc, feeds = build(num_slots=4, vocab_size=100,
+                                        dense_dim=8, embed_size=8)
+    rng = np.random.RandomState(4)
+    feed = {}
+    for n in feeds:
+        if n == 'dense':
+            feed[n] = rng.rand(16, 8).astype('float32')
+        elif n == 'label':
+            feed[n] = rng.randint(0, 2, (16, 1)).astype('int64')
+        else:
+            feed[n] = rng.randint(0, 100, (16, 1)).astype('int64')
+    _train(loss, lambda i: feed,
+           opt=fluid.optimizer.Adam(learning_rate=1e-3, lazy_mode=True))
