@@ -284,3 +284,70 @@ def test_recurrent_group_target_inlink_length():
 
     out = recurrent_group(step=step, input=[a, b], targetInlink=b)
     assert _len_of(out) is _len_of(b)
+
+
+def test_beam_generation_on_dp_mesh_matches_unsharded():
+    """generation_decode under a dp mesh (batch sharded over 8 devices,
+    memories/statics follow via GSPMD propagation) emits exactly the
+    unsharded beams — the new op composes with the transpiler like the
+    transformer decode ops do."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import (ParallelStrategy,
+                                                transpile)
+    V, E, H, T = 12, 8, 8, 4
+    b = 8
+    enc, enc_proj, boot = _build_encoder(V, E, H)
+    trg = data_layer(name='trg', size=V, dtype='int64', seq_type=1)
+    trg_emb = embedding_layer(
+        input=trg, size=E, param_attr=ParameterAttribute(name='trg_emb'))
+    lbl = data_layer(name='lbl', size=1, dtype='int64', seq_type=1)
+
+    def train_step(emb_t):
+        state = memory(name='dec_state', size=H, boot_layer=boot)
+        return _seq2seq_step(emb_t, state, V, H, encoded=enc,
+                             encoded_proj=enc_proj)[0]
+
+    probs = recurrent_group(step=train_step, input=trg_emb)
+    cost = classification_cost(input=probs, label=lbl)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(9)
+    src = rng.randint(2, V, (b, T)).astype('int64')
+    feed = {'src': src, 'src_len': np.full((b,), T, 'int32'),
+            'trg': src, 'trg_len': np.full((b,), T, 'int32'),
+            'lbl': src[..., None], 'lbl_len': np.full((b,), T, 'int32')}
+    for _ in range(5):
+        exe.run(feed=feed, fetch_list=[cost])
+
+    def build(mesh):
+        gp = Program()
+        with program_guard(gp, fluid.default_startup_program()):
+            enc_g, proj_g, boot_g = _build_encoder(V, E, H,
+                                                   src_name='src')
+
+            def gen_step(enc_s, proj_s, boot_s, emb):
+                state = memory(name='dec_state', size=H,
+                               boot_layer=boot_s)
+                return _seq2seq_step(emb, state, V, H, encoded=enc_s,
+                                     encoded_proj=proj_s)[0]
+
+            ids = beam_search(
+                step=gen_step,
+                input=[StaticInput(enc_g, is_seq=True),
+                       StaticInput(proj_g), StaticInput(boot_g),
+                       GeneratedInput(size=V, embedding_name='trg_emb',
+                                      embedding_size=E)],
+                bos_id=1, eos_id=0, beam_size=4, max_length=T)
+        if mesh is not None:
+            transpile(gp, mesh, ParallelStrategy(data_parallel=True))
+        return gp, ids
+
+    f = {'src': src, 'src_len': np.full((b,), T, 'int32')}
+    gp_u, ids_u = build(None)
+    got_u = np.asarray(exe.run(program=gp_u, feed=f,
+                               fetch_list=[ids_u])[0])
+    gp_s, ids_s = build(make_mesh(dp=8))
+    got_s = np.asarray(exe.run(program=gp_s, feed=f,
+                               fetch_list=[ids_s])[0])
+    np.testing.assert_array_equal(got_s, got_u)
