@@ -441,10 +441,18 @@ def attention_microbench(batch_tokens=4096, d=64, heads=8, inner=8,
         shape = (batch, heads, seq, d)
         q0, k0, v0 = (jnp.asarray(rng.randn(*shape) * 0.1, jnp.bfloat16)
                       for _ in range(3))
+        # masked legs (r5): per-example lengths at 75% of seq — the
+        # variable-length NMT case; the Pallas kernel skips masked key
+        # BLOCKS, so its masked leg should beat its dense one
+        lens = jnp.full((batch,), max(1, (3 * seq) // 4), jnp.int32)
         legs = {'xla': lambda q, k, v: reference_attention(
                     q, k, v, causal=True),
                 'pallas': lambda q, k, v: flash_attention(
-                    q, k, v, causal=True)}
+                    q, k, v, causal=True),
+                'xla_masked': lambda q, k, v: reference_attention(
+                    q, k, v, causal=True, key_length=lens),
+                'pallas_masked': lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, kv_len=lens)}
         for name, fn in legs.items():
             def loss(q, k, v, fn=fn):
                 return fn(q, k, v).astype(jnp.float32).sum()
@@ -479,6 +487,10 @@ def attention_microbench(batch_tokens=4096, d=64, heads=8, inner=8,
         xla = out['seq%d_xla_fwdbwd_ms' % seq]
         pal = out['seq%d_pallas_fwdbwd_ms' % seq]
         out['seq%d_winner' % seq] = 'pallas' if pal < xla * 0.98 else 'xla'
+        xm = out['seq%d_xla_masked_fwdbwd_ms' % seq]
+        pm = out['seq%d_pallas_masked_fwdbwd_ms' % seq]
+        out['seq%d_masked_winner' % seq] = \
+            'pallas' if pm < xm * 0.98 else 'xla'
     return out
 
 
@@ -961,6 +973,14 @@ def main():
                            'transformer': BASE_TRANSFORMER_TOK_S}}
     if tok_s is not None:
         detail['transformer_tok_per_sec'] = round(tok_s, 1)
+        if not reduced:
+            # headline MFU estimate from analytic matmul FLOPs at the
+            # headline shapes (batch 64, seq 64, vocab 32k) vs bf16 peak
+            flops_per_tok = _transformer_train_flops(64, 64, 64, 32000) \
+                / (64 * 64)
+            peak = float(os.environ.get('BENCH_PEAK_TFLOPS', '197')) * 1e12
+            detail['transformer_mfu_est'] = round(
+                tok_s * flops_per_tok / peak, 4)
     if img_s is not None:
         detail['resnet50_img_per_sec'] = round(img_s, 1)
     if masked_head is not None:
