@@ -25,8 +25,7 @@ CIFAR100_ARCHIVE = 'cifar-100-python.tar.gz'
 
 
 def _cached(archive):
-    p = common.cached_path('cifar', archive)
-    return p if os.path.exists(p) else None
+    return common.cached('cifar', archive)
 
 
 def reader_creator(filename, sub_name):

@@ -38,3 +38,10 @@ def download(url, category, md5sum=None):
         'Network access is unavailable in this environment. Place the file '
         'for %r under %s, or use the synthetic fallback (automatic).' %
         (category, os.path.join(DATA_HOME, category)))
+
+
+def cached(category, filename):
+    """Path of a user-dropped archive, or None when absent — the gate
+    every dataset's real-data path shares."""
+    p = cached_path(category, filename)
+    return p if os.path.exists(p) else None

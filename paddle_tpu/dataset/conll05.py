@@ -41,8 +41,7 @@ LABEL_DICT_FILE = 'targetDict.txt'
 
 
 def _cached(name):
-    p = common.cached_path('conll05st', name)
-    return p if os.path.exists(p) else None
+    return common.cached('conll05st', name)
 
 
 def load_dict(filename):

@@ -42,8 +42,7 @@ _REAL_MEAN = [103.94, 116.78, 123.68]
 
 
 def _cached(name):
-    p = common.cached_path('flowers', name)
-    return p if os.path.exists(p) else None
+    return common.cached('flowers', name)
 
 
 def _have_real():
@@ -68,12 +67,16 @@ def _tar_reader(dataset_name, mapper):
                  for i in indexes}
 
     def reader():
+        # iterate members SEQUENTIALLY: random extractfile access on a
+        # gzip tar re-decompresses from the stream start per member
+        # (O(n²) over 8k images); sequential next() is one pass
         with tarfile.open(_cached(DATA_ARCHIVE)) as tf:
-            for name, label in sorted(img2label.items()):
-                f = tf.extractfile(name)
-                if f is None:
-                    continue
-                yield mapper((f.read(), label - 1))
+            m = tf.next()
+            while m is not None:
+                label = img2label.get(m.name)
+                if label is not None and m.isfile():
+                    yield mapper((tf.extractfile(m).read(), label - 1))
+                m = tf.next()
     return reader
 
 

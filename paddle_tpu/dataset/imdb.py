@@ -31,8 +31,7 @@ _PUNCT_TABLE = str.maketrans('', '', string.punctuation)
 
 
 def _cached_tar():
-    p = common.cached_path('imdb', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('imdb', ARCHIVE)
 
 
 def tokenize(pattern, tar_path=None):

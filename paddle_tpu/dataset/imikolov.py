@@ -33,8 +33,7 @@ class DataType(object):
 
 
 def _cached_tar():
-    p = common.cached_path('imikolov', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('imikolov', ARCHIVE)
 
 
 def word_count(f, word_freq=None):

@@ -35,8 +35,7 @@ _AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
 
 
 def _cached_zip():
-    p = common.cached_path('movielens', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('movielens', ARCHIVE)
 
 
 class MovieInfo(object):

@@ -27,8 +27,7 @@ TEST_FILE = os.path.join('Fold1', 'test.txt')
 
 
 def _cached_file(name):
-    p = common.cached_path('mq2007', name)
-    return p if os.path.exists(p) else None
+    return common.cached('mq2007', name)
 
 
 def _parse_line(text):
@@ -68,21 +67,28 @@ def _load_queries(path):
              np.asarray(by_qid[qid][1], 'int64')) for qid in order]
 
 
+def _emit(feats, rels, format):
+    """One query's docs in the requested format — shared by the real
+    and synthetic readers so the two cannot drift."""
+    if format == 'pointwise':
+        for f, y in zip(feats, rels):
+            yield f, int(y)
+    elif format == 'pairwise':
+        for i in range(len(rels)):
+            for j in range(len(rels)):
+                if rels[i] > rels[j]:
+                    yield feats[i], feats[j]
+    elif format == 'listwise':
+        yield feats, rels
+    else:
+        raise ValueError('unknown format %r' % format)
+
+
 def _file_reader(path, format):
     def reader():
         for _qid, feats, rels in _load_queries(path):
-            if format == 'pointwise':
-                for f, y in zip(feats, rels):
-                    yield f, int(y)
-            elif format == 'pairwise':
-                for i in range(len(rels)):
-                    for j in range(len(rels)):
-                        if rels[i] > rels[j]:
-                            yield feats[i], feats[j]
-            elif format == 'listwise':
-                yield feats, rels
-            else:
-                raise ValueError('unknown format %r' % format)
+            for item in _emit(feats, rels, format):
+                yield item
     return reader
 
 
@@ -101,18 +107,8 @@ def _reader(split, format):
         r = common.rng('mq2007', split)
         for _ in range(_QUERIES):
             feats, rel = _make_query(r)
-            if format == 'pointwise':
-                for f, y in zip(feats, rel):
-                    yield f, int(y)
-            elif format == 'pairwise':
-                for i in range(len(rel)):
-                    for j in range(len(rel)):
-                        if rel[i] > rel[j]:
-                            yield feats[i], feats[j]
-            elif format == 'listwise':
-                yield feats, rel
-            else:
-                raise ValueError('unknown format %r' % format)
+            for item in _emit(feats, rel, format):
+                yield item
     return reader
 
 

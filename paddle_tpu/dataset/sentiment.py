@@ -29,8 +29,7 @@ ARCHIVE = 'movie_reviews.zip'
 
 
 def _cached_zip():
-    p = common.cached_path('sentiment', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('sentiment', ARCHIVE)
 
 
 def _doc_words(z, name):
@@ -65,8 +64,16 @@ def get_word_dict():
     return [(w, i) for i, (w, _c) in enumerate(ordered)]
 
 
+_CORPUS_CACHE = {}
+
+
 def _load_corpus():
+    """Parsed corpus, memoized per archive path — iterating a reader
+    must not re-run the two full zip scans (dict build + docs) every
+    epoch."""
     zp = _cached_zip()
+    if zp in _CORPUS_CACHE:
+        return _CORPUS_CACHE[zp]
     ids = dict(get_word_dict())
     samples = []
     with zipfile.ZipFile(zp) as z:
@@ -74,6 +81,7 @@ def _load_corpus():
             label = 0 if '/neg/' in name else 1
             samples.append(
                 ([ids[w] for w in _doc_words(z, name)], label))
+    _CORPUS_CACHE[zp] = samples
     return samples
 
 

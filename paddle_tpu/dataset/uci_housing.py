@@ -24,8 +24,7 @@ DATA_FILE = 'housing.data'
 
 
 def _cached_file():
-    p = common.cached_path('uci_housing', DATA_FILE)
-    return p if os.path.exists(p) else None
+    return common.cached('uci_housing', DATA_FILE)
 
 
 def load_data(filename, feature_num=14, ratio=0.8):

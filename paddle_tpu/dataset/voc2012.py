@@ -32,8 +32,7 @@ LABEL_FILE = 'VOCdevkit/VOC2012/SegmentationClass/{}.png'
 
 
 def _cached_tar():
-    p = common.cached_path('voc2012', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('voc2012', ARCHIVE)
 
 
 def reader_creator(filename, sub_name):

@@ -43,8 +43,7 @@ TRAIN_ARCHIVE = 'wmt14.tgz'
 
 
 def _cached_tar():
-    p = common.cached_path('wmt14', TRAIN_ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('wmt14', TRAIN_ARCHIVE)
 
 
 def _read_to_dict(tar_path, dict_size):

@@ -32,8 +32,7 @@ UNK_MARK = '<unk>'
 
 
 def _cached_tar():
-    p = common.cached_path('wmt16', ARCHIVE)
-    return p if os.path.exists(p) else None
+    return common.cached('wmt16', ARCHIVE)
 
 
 def _build_dict(tar_path, dict_size, save_path, lang):

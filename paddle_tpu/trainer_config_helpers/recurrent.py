@@ -62,11 +62,11 @@ class _RgCtx(object):
     """Active recurrent context: memory() registers here; named layers
     built during the step register in .names (layers._rg_note)."""
 
-    def __init__(self, drnn=None):
+    def __init__(self, drnn=None, gen_batch_ref=None):
         self.drnn = drnn          # training mode: fluid DynamicRNN
         self.pending = []         # [_Memory]
         self.names = {}           # v1 layer name -> var
-        self.gen_boots = []       # generation mode: parent-block inits
+        self.gen_batch_ref = gen_batch_ref  # generation: [B,...] var
 
 
 def memory(name=None, size=0, memory_name=None, is_seq=False,
@@ -213,8 +213,7 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
     batch_ref = statics[0].input
 
     sub = program.create_block()
-    ctx = _RgCtx(drnn=None)
-    ctx.gen_batch_ref = batch_ref
+    ctx = _RgCtx(gen_batch_ref=batch_ref)
     # the feedback slot: prev ids enter the step as their embedding
     id_pre = helper.create_variable_for_type_inference('int64')
     id_pre.shape = (None,)
