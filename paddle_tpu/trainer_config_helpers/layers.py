@@ -472,22 +472,49 @@ gru_step_naive_layer = gru_step_layer
 def lstm_step_layer(input, state, size=None, act=None, name=None,
                     gate_act=None, state_act=None, bias_attr=None,
                     layer_attr=None):
-    """One LSTM step: `state` is the previous cell state, `input` the
-    4*size gate pre-projection CONCATENATED with the previous hidden
-    in v1; here pass (hidden, cell) via fluid lstm_unit instead —
-    divergence: returns (new_hidden, new_cell)."""
-    raise NotImplementedError(
-        'lstm_step_layer: use layers.lstm_unit(x_t, hidden_prev, '
-        'cell_prev) — the fluid step form carries hidden AND cell '
-        'explicitly instead of v1\'s state-pair aggregation')
+    """One LSTM step (reference layers.py lstm_step_layer, r5): `input`
+    is the 4*size gate pre-projection (the v1 config supplies
+    W_x·x + W_h·h_prev through a mixed_layer), `state` the previous
+    CELL. Returns the new hidden — the layer this `name` registers for
+    memory linkage — and the new cell rides
+    get_output_layer(input=..., arg_name='state') like the reference.
+    Divergences: gate order inside the projection is the lstm_unit
+    op's (i,f,g,o — immaterial for freshly-trained shim params), and
+    activations are pinned to the op's sigmoid/tanh contract."""
+    for a, nm in ((act, 'act'), (state_act, 'state_act')):
+        if a is not None and _act_name(a) not in (None, 'tanh'):
+            raise NotImplementedError(
+                'lstm_step_layer(%s=%s): the TPU lstm_unit op pins '
+                'tanh state / sigmoid gates' % (nm, _act_name(a)))
+    if gate_act is not None and _act_name(gate_act) not in (None,
+                                                            'sigmoid'):
+        raise NotImplementedError(
+            'lstm_step_layer(gate_act=%s): sigmoid gates are pinned'
+            % _act_name(gate_act))
+    from ..layers.helper import LayerHelper
+    helper = LayerHelper('lstm_step')
+    c = helper.create_variable_for_type_inference(input.dtype)
+    h = helper.create_variable_for_type_inference(input.dtype)
+    c.shape = state.shape
+    h.shape = state.shape
+    helper.append_op(type='lstm_unit',
+                     inputs={'X': [input], 'C_prev': [state]},
+                     outputs={'C': [c], 'H': [h]},
+                     attrs={'forget_bias': 0.0})
+    h._v1_cell = c
+    return _rg_note(name, h)
 
 
 def get_output_layer(input, arg_name, name=None, layer_attr=None):
-    """v1 selected a named secondary output of a layer. Fluid layers
-    return their outputs directly, and the shimmed lstmemory returns
-    only the hidden sequence — so selecting the cell ('state') here
-    cannot be the identity; it raises with the fluid route instead."""
+    """v1 selected a named secondary output of a layer. The shimmed
+    lstm_step_layer stashes its cell on the hidden (r5) — selecting
+    'state' returns it (and registers `name` for memory linkage, the
+    lstmemory_unit pattern); whole-sequence lstmemory still routes to
+    dynamic_lstm for the cell."""
     if arg_name in ('state', 'cell'):
+        cell = getattr(input, '_v1_cell', None)
+        if cell is not None:
+            return _rg_note(name, cell)
         raise NotImplementedError(
             "get_output_layer(arg_name=%r): use layers.dynamic_lstm "
             "directly — it returns (hidden, cell) as a tuple" % arg_name)

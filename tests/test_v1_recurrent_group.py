@@ -351,3 +351,75 @@ def test_beam_generation_on_dp_mesh_matches_unsharded():
     got_s = np.asarray(exe.run(program=gp_s, feed=f,
                                fetch_list=[ids_s])[0])
     np.testing.assert_array_equal(got_s, got_u)
+
+
+def test_lstm_recurrent_group_unit_pattern():
+    """The reference lstmemory_unit pattern inside recurrent_group (r5:
+    lstm_step_layer over a pre-projected gate input + cell memory via
+    get_output_layer(arg_name='state')): trains, and the whole-sequence
+    output matches a manual single-step rollout of the same IR."""
+    from paddle_tpu.trainer_config_helpers import (
+        full_matrix_projection, get_output_layer, lstm_step_layer,
+        mixed_layer, regression_cost)
+    V, H, T, b = 10, 6, 4, 3
+    x = data_layer(name='xl', size=V, seq_type=1)
+
+    def step(x_t):
+        out_mem = memory(name='lstm_out', size=H)
+        cell_mem = memory(name='lstm_out_state', size=H)
+        gates = mixed_layer(
+            size=H * 4,
+            input=[full_matrix_projection(
+                       x_t, param_attr=ParameterAttribute(name='lx.w')),
+                   full_matrix_projection(
+                       out_mem,
+                       param_attr=ParameterAttribute(name='lh.w'))],
+            bias_attr=False)
+        h = lstm_step_layer(input=gates, state=cell_mem,
+                            name='lstm_out')
+        get_output_layer(input=h, arg_name='state',
+                         name='lstm_out_state')
+        return h
+
+    seq = recurrent_group(step=step, input=x)
+    pred = fc_layer(input=last_seq(input=seq), size=1,
+                    param_attr=ParameterAttribute(name='lp.w'))
+    y = data_layer(name='yl', size=1)
+    cost = regression_cost(input=pred, label=y)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    xs = rng.randn(b, T, V).astype('f')
+    feed = {'xl': xs, 'xl_len': np.full((b,), T, 'int32'),
+            'yl': rng.randn(b, 1).astype('f')}
+    losses = [float(np.asarray(exe.run(feed=feed,
+                                       fetch_list=[cost])[0]).reshape(()))
+              for _ in range(30)]
+    assert losses[-1] < losses[0]
+
+    # manual rollout FIRST: the training program's fetch run would also
+    # apply one more SGD update after computing its outputs, so the
+    # rollout (update-free program) must read the same param state
+    sp = Program()
+    with program_guard(sp, fluid.default_startup_program()):
+        import paddle_tpu.layers as L
+        xt = L.data(name='xt', shape=[V], dtype='float32')
+        hp = L.data(name='hp', shape=[H], dtype='float32')
+        cp = L.data(name='cp', shape=[H], dtype='float32')
+        g1 = L.fc(input=xt, size=4 * H, bias_attr=False,
+                  param_attr=fluid.ParamAttr(name='lx.w'))
+        g2 = L.fc(input=hp, size=4 * H, bias_attr=False,
+                  param_attr=fluid.ParamAttr(name='lh.w'))
+        gate_sum = L.elementwise_add(g1, g2)
+        hs = lstm_step_layer(input=gate_sum, state=cp)
+        cs = hs._v1_cell
+    hvec = np.zeros((b, H), 'f')
+    cvec = np.zeros((b, H), 'f')
+    for t in range(T):
+        hvec, cvec = (np.asarray(v) for v in exe.run(
+            program=sp, feed={'xt': xs[:, t], 'hp': hvec, 'cp': cvec},
+            fetch_list=[hs, cs]))
+    got = np.asarray(exe.run(feed=feed, fetch_list=[seq])[0])
+    np.testing.assert_allclose(got[:, T - 1], hvec, rtol=1e-4,
+                               atol=1e-5)
