@@ -59,6 +59,7 @@ __all__ = [
     'crf_layer', 'crf_decoding_layer', 'ctc_layer', 'warp_ctc_layer',
     'nce_layer', 'hsigmoid',
     'print_layer', 'printer_layer', 'eos_layer',
+    'factorization_machine', 'selective_fc_layer',
     'AggregateLevel', 'ExpandLevel', 'layer_support',
 ]
 
@@ -1109,12 +1110,47 @@ def eos_layer(input, eos_id, name=None, layer_attr=None):
         shape=[1], dtype=input.dtype, value=eos_id)), 'float32')
 
 
+def factorization_machine(input, factor_size, act=None, name=None,
+                          param_attr=None, layer_attr=None):
+    """2-order FM interactions (reference layers.py
+    factorization_machine): y = Σ_{i<j} <v_i, v_j> x_i x_j via the
+    sum-square identity 0.5·Σ_k[(xV)_k² − (x²)(V²)_k] — one [B,n]×[n,k]
+    matmul instead of the O(n²) pair loop, MXU-shaped."""
+    n = int(input.shape[-1])
+    v = _fl.create_parameter(shape=[n, factor_size], dtype='float32',
+                             attr=_pa(param_attr))
+    xv = _fl.matmul(input, v)                              # [B, k]
+    x2v2 = _fl.matmul(_fl.square(input), _fl.square(v))    # [B, k]
+    out = _fl.scale(_fl.reduce_sum(
+        _fl.elementwise_sub(_fl.square(xv), x2v2), dim=-1,
+        keep_dim=True), scale=0.5)
+    return _rg_note(name, _apply_act(out, act))
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       pass_generation=False, has_selected_colums=True,
+                       mul_ratio=0.02, param_attr=None, bias_attr=None,
+                       layer_attr=None):
+    """Reference selective_fc_layer: fc whose output is masked to the
+    selected columns (select=None behaves exactly like fc_layer).
+    Divergence: the reference computed ONLY the selected columns (a
+    CPU-sparse trick); on the MXU the dense [B,n]×[n,size] matmul IS
+    the fast path, so this computes dense and multiplies by the
+    0/1 `select` mask — same output, TPU-shaped."""
+    if isinstance(input, (list, tuple)):
+        input = _fl.concat([_flatten2(v) for v in input], axis=-1)
+    out = fc_layer(input=input, size=size, act=act, name=name,
+                   param_attr=param_attr, bias_attr=bias_attr)
+    if select is not None:
+        out = _fl.elementwise_mul(out, _fl.cast(select, 'float32'))
+    return out
+
+
 _FLUID_EQUIV = {
     # recurrent_group / memory / beam_search / StaticInput /
     # GeneratedInput are REAL since round 5: see recurrent.py
-    'selective_fc_layer': 'layers.fc + masking',
+    # selective_fc_layer / factorization_machine are REAL since r5
     'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
-    'factorization_machine': 'wide_deep model (models/wide_deep.py)',
     'img_conv3d_layer': 'layers.conv3d lowering (ops/conv_ops.py)',
     'img_pool3d_layer': 'layers.pool2d pattern over 3d',
     'scale_sub_region_layer': 'layers.crop + scale + paste',

@@ -356,3 +356,40 @@ def test_gru_unit_consumes_preprojected_input():
     assert np.asarray(o).shape == (2, 5, 4)
     with pytest.raises(ValueError, match='3'):
         gru_group(input=data_layer(name='xg2', size=10, seq_type=1))
+
+
+def test_factorization_machine_matches_pair_loop():
+    """r5 shim: the sum-square identity must equal the O(n^2) pairwise
+    definition y = sum_{i<j} <v_i,v_j> x_i x_j."""
+    from paddle_tpu.trainer_config_helpers import factorization_machine
+    x = data_layer(name='fmx', size=5)
+    out = factorization_machine(
+        x, factor_size=3,
+        param_attr=ParameterAttribute(name='fm.v'))
+    xs = np.random.RandomState(0).randn(4, 5).astype('f')
+    exe, (o,) = _run([out], {'fmx': xs})
+    v = np.asarray(fluid.global_scope().find('fm.v'))
+    want = np.zeros((4, 1), 'f')
+    for i in range(5):
+        for j in range(i + 1, 5):
+            want[:, 0] += (v[i] @ v[j]) * xs[:, i] * xs[:, j]
+    np.testing.assert_allclose(np.asarray(o), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_selective_fc_masks_columns():
+    from paddle_tpu.trainer_config_helpers import selective_fc_layer
+    x = data_layer(name='sfx', size=4)
+    sel = data_layer(name='sel', size=6)
+    out_all = selective_fc_layer(
+        input=x, size=6, param_attr=ParameterAttribute(name='sf.w'),
+        bias_attr=False)
+    out_sel = selective_fc_layer(
+        input=x, size=6, select=sel,
+        param_attr=ParameterAttribute(name='sf.w'), bias_attr=False)
+    xs = np.random.RandomState(1).randn(3, 4).astype('f')
+    mask = (np.random.RandomState(2).rand(3, 6) > 0.5).astype('f')
+    _, (a, b) = _run([out_all, out_sel],
+                     {'sfx': xs, 'sel': mask})
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a) * mask,
+                               rtol=1e-5, atol=1e-6)
