@@ -1012,13 +1012,19 @@ def main():
         # the most recent full-shape on-chip capture, clearly labeled,
         # so the artifact still records the chip evidence + provenance.
         try:
-            cap_path = os.path.join(os.path.dirname(os.path.abspath(
-                __file__)), 'BENCH_builder_r4_onchip.json')
+            base = os.path.dirname(os.path.abspath(__file__))
+            cap_path = None
+            for name in ('BENCH_builder_r5_onchip.json',
+                         'BENCH_builder_r4_onchip.json'):
+                p = os.path.join(base, name)
+                if os.path.exists(p):
+                    cap_path = p
+                    break
             with open(cap_path) as f:
                 cap = json.load(f)
             detail['last_onchip_capture'] = {
                 'provenance': 'builder-run full bench.py on the real '
-                              'chip earlier this round (relay was up); '
+                              'chip (most recent available capture); '
                               'file ' + os.path.basename(cap_path),
                 'transformer_tok_per_sec':
                     cap['detail'].get('transformer_tok_per_sec'),

@@ -146,3 +146,18 @@ def parse_network(*outputs):
     the sub-model protobuf; here the pruned Program plays that role)."""
     from ..core.program import default_main_program
     return default_main_program().prune(list(outputs))
+
+
+def __getattr__(name):
+    """The reference v2 layer module was a re-export shell over
+    trainer_config_helpers (v2/layer.py:15), stripping the `_layer`
+    suffix from names (v1 `fc_layer` became v2 `layer.fc`). Names not
+    defined above resolve the same way against the r5-complete shim —
+    so v2 configs reach recurrent_group / memory / beam_search /
+    lstmemory / crf and the rest of the v1 vocabulary."""
+    from .. import trainer_config_helpers as _tch
+    for candidate in (name, name + '_layer'):
+        obj = getattr(_tch, candidate, None)
+        if obj is not None:
+            return obj
+    raise AttributeError(name)

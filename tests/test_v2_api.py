@@ -263,3 +263,19 @@ def test_v2_dataset_import_paths():
     assert v2_mnist is base_mnist
     import paddle_tpu.v2 as v2
     assert v2.dataset.mnist is base_mnist
+
+
+def test_v2_layer_forwards_to_v1_shim():
+    """Reference v2.layer was a re-export shell over
+    trainer_config_helpers — unknown names resolve against the shim,
+    with the `_layer` suffix stripped like the reference did."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu import trainer_config_helpers as tch
+    assert paddle.layer.recurrent_group is tch.recurrent_group
+    assert paddle.layer.memory is tch.memory
+    assert paddle.layer.beam_search is tch.beam_search
+    assert paddle.layer.lstmemory is tch.lstmemory
+    assert paddle.layer.addto is tch.addto_layer     # suffix stripped
+    import pytest
+    with pytest.raises(AttributeError):
+        paddle.layer.not_a_real_layer_name
