@@ -9,7 +9,7 @@ from ..initializer import Constant, Normal, Xavier
 from .helper import LayerHelper
 
 __all__ = [
-    'fc', 'embedding', 'conv2d', 'conv2d_transpose', 'pool2d', 'batch_norm',
+    'fc', 'embedding', 'conv2d', 'conv3d', 'conv2d_transpose', 'pool2d', 'batch_norm',
     'layer_norm', 'dropout', 'cross_entropy', 'square_error_cost',
     'accuracy', 'chunk_eval', 'softmax_with_cross_entropy', 'one_hot',
     'matmul', 'topk', 'reduce_sum', 'reduce_mean', 'reduce_max',
@@ -164,6 +164,46 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                'data_format': data_format})
     pre_act = _append_bias(helper, pre_bias, [num_filters],
                            axis=3 if nhwc else 1)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    """3-D convolution over NCDHW input (reference conv3d_op.cc; the
+    v1 img_conv3d_layer's compute). Filter is OIDHW."""
+    def _triple(v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+
+    helper = LayerHelper('conv3d', **locals())
+    dtype = input.dtype
+    groups = groups or 1
+    num_channels = input.shape[1]
+    fd, fh, fw = _triple(filter_size)
+    sd, sh, sw = _triple(stride)
+    pd, ph, pw = _triple(padding)
+    dd, dh, dw = _triple(dilation)
+    filter_shape = [num_filters, num_channels // groups, fd, fh, fw]
+    import math
+    std = (2.0 / (fd * fh * fw * num_channels)) ** 0.5
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype,
+                                default_initializer=Normal(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype)
+
+    def _od(sz, p, d, f, s):
+        return (sz + 2 * p - (d * (f - 1) + 1)) // s + 1 \
+            if sz and sz > 0 else sz
+
+    n, c, dep, h, w_in = input.shape
+    out.shape = (n, num_filters, _od(dep, pd, dd, fd, sd),
+                 _od(h, ph, dh, fh, sh), _od(w_in, pw, dw, fw, sw))
+    helper.append_op(
+        type='conv3d', inputs={'Input': [input], 'Filter': [w]},
+        outputs={'Output': [out]},
+        attrs={'strides': [sd, sh, sw], 'paddings': [pd, ph, pw],
+               'dilations': [dd, dh, dw], 'groups': groups})
+    pre_act = _append_bias(helper, out, [num_filters], axis=1)
     return helper.append_activation(pre_act)
 
 

@@ -59,7 +59,7 @@ __all__ = [
     'crf_layer', 'crf_decoding_layer', 'ctc_layer', 'warp_ctc_layer',
     'nce_layer', 'hsigmoid',
     'print_layer', 'printer_layer', 'eos_layer',
-    'factorization_machine', 'selective_fc_layer',
+    'factorization_machine', 'selective_fc_layer', 'img_conv3d_layer',
     'AggregateLevel', 'ExpandLevel', 'layer_support',
 ]
 
@@ -554,6 +554,29 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
              act=_act_name(act), param_attr=_pa(param_attr),
              bias_attr=_pa(bias_attr) if bias_attr is not None else None)
     return apply_extra_attr(out, layer_attr)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     name=None, bias_attr=None, param_attr=None,
+                     shared_biases=True, layer_attr=None, trans=False,
+                     layer_type=None):
+    """3-D convolution (reference img_conv3d_layer, r5): input must be
+    a 5-D [B, C, D, H, W] var (fluid data with shape [C, D, H, W] — the
+    v1 flat-slot inference has no depth metadata to recover)."""
+    if trans:
+        raise NotImplementedError('img_conv3d_layer(trans=True): no '
+                                  'conv3d_transpose lowering')
+    if input.shape is None or len(input.shape) != 5:
+        raise ValueError('img_conv3d_layer needs a 5-D [B,C,D,H,W] '
+                         'input var')
+    out = _fl.conv3d(input=input, num_filters=num_filters,
+                     filter_size=filter_size, stride=stride,
+                     padding=padding, dilation=dilation, groups=groups,
+                     act=_act_name(act), param_attr=_pa(param_attr),
+                     bias_attr=_pa(bias_attr)
+                     if bias_attr is not None else None)
+    return _rg_note(name, apply_extra_attr(out, layer_attr))
 
 
 def img_pool_layer(input, pool_size, num_channels=None, pool_type=None,
@@ -1151,7 +1174,6 @@ _FLUID_EQUIV = {
     # GeneratedInput are REAL since round 5: see recurrent.py
     # selective_fc_layer / factorization_machine are REAL since r5
     'sub_nested_seq_layer': 'SURVEY §6 LoD stance: depth>1 descoped',
-    'img_conv3d_layer': 'layers.conv3d lowering (ops/conv_ops.py)',
     'img_pool3d_layer': 'layers.pool2d pattern over 3d',
     'scale_sub_region_layer': 'layers.crop + scale + paste',
     'conv_projection': 'img_conv_layer',

@@ -393,3 +393,35 @@ def test_selective_fc_masks_columns():
                      {'sfx': xs, 'sel': mask})
     np.testing.assert_allclose(np.asarray(b), np.asarray(a) * mask,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_conv3d_layer_and_v1_shim():
+    """r5: fluid conv3d wrapper over the existing lowering, and the v1
+    img_conv3d_layer mapped onto it — compared against scipy's direct
+    3-D correlation."""
+    from scipy.ndimage import correlate
+    import paddle_tpu.layers as L
+    x = L.data(name='vol', shape=[1, 4, 5, 6], dtype='float32')
+    out = L.conv3d(x, num_filters=1, filter_size=3, padding=1,
+                   param_attr=fluid.ParamAttr(name='c3.w'),
+                   bias_attr=False)
+    xs = np.random.RandomState(0).randn(2, 1, 4, 5, 6).astype('f')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o = np.asarray(exe.run(feed={'vol': xs}, fetch_list=[out])[0])
+    w = np.asarray(fluid.global_scope().find('c3.w'))[0, 0]
+    for b in range(2):
+        want = correlate(xs[b, 0], w, mode='constant')
+        np.testing.assert_allclose(o[b, 0], want, rtol=1e-4, atol=1e-4)
+
+
+def test_img_conv3d_shim():
+    from paddle_tpu.trainer_config_helpers import img_conv3d_layer
+    import paddle_tpu.layers as L
+    x = L.data(name='v3', shape=[2, 4, 4, 4], dtype='float32')
+    out = img_conv3d_layer(input=x, filter_size=3, num_filters=3,
+                           padding=1, act=ReluActivation())
+    xs = np.random.RandomState(0).randn(2, 2, 4, 4, 4).astype('f')
+    _, (o,) = _run([out], {'v3': xs})
+    assert np.asarray(o).shape == (2, 3, 4, 4, 4)
+    assert (np.asarray(o) >= 0).all()          # relu applied
