@@ -6,10 +6,11 @@ and `setid.mat` under $PADDLE_TPU_DATA/flowers/. Reference semantics:
 setid.mat's index lists pick members `jpg/image_%05d.jpg`, labels come
 from imagelabels.mat (1-based → label-1 yielded), the train/test flags
 are deliberately SWAPPED ('tstid' is train — the reference's own
-readme note, test data outnumbers train), and every image runs the
-default mapper: decode → simple_transform resize 256 / crop 224 (train
-random-crop+flip, test center-crop) with the reference BGR mean →
-flattened float32.
+readme note, test data outnumbers train), and every image is jpeg-
+decoded in the reader (so mappers on BOTH paths receive a decoded HWC
+uint8 array) then run through the default mapper: simple_transform
+resize 256 / crop 224 (train random-crop+flip, test center-crop) with
+the reference BGR mean → flattened float32.
 
 Synthetic fallback: class-colored noise with the same pipeline at
 scaled-down sizes (resize 40, crop 32) to keep tests fast.
@@ -50,10 +51,11 @@ def _have_real():
 
 
 def _real_mapper(is_train, sample):
-    """Reference default_mapper: jpeg bytes -> 256/224 transform ->
-    flat float32 (flowers.py:58-66)."""
-    img_bytes, label = sample
-    img = image.load_image_bytes(img_bytes)
+    """Reference default_mapper over a DECODED (hwc_uint8, label):
+    256/224 transform -> flat float32 (flowers.py:58-66). Decoding
+    happens in _tar_reader so user-supplied mappers see the same
+    decoded-array contract as the synthetic path."""
+    img, label = sample
     img = image.simple_transform(img, 256, 224, is_train,
                                  mean=_REAL_MEAN)
     return img.flatten().astype('float32'), label
@@ -69,13 +71,17 @@ def _tar_reader(dataset_name, mapper):
     def reader():
         # iterate members SEQUENTIALLY: random extractfile access on a
         # gzip tar re-decompresses from the stream start per member
-        # (O(n²) over 8k images); sequential next() is one pass
+        # (O(n²) over 8k images); sequential next() is one pass.
+        # Decode HERE so every mapper — default or user-supplied — gets
+        # the same (decoded HWC uint8, label) contract as the synthetic
+        # path, not raw jpeg bytes.
         with tarfile.open(_cached(DATA_ARCHIVE)) as tf:
             m = tf.next()
             while m is not None:
                 label = img2label.get(m.name)
                 if label is not None and m.isfile():
-                    yield mapper((tf.extractfile(m).read(), label - 1))
+                    img = image.load_image_bytes(tf.extractfile(m).read())
+                    yield mapper((img, label - 1))
                 m = tf.next()
     return reader
 

@@ -1,0 +1,30 @@
+"""Fault tolerance: preemption-tolerant training.
+
+Reference analog: the reference survived worker death through the
+etcd-backed master (go/master/service.go persists the task queue and
+recovers mid-epoch) and parameter servers that outlived trainers. The
+TPU-native, masterless rebuild gets the same guarantees from three
+local pieces wired through the Trainer:
+
+- periodic mid-epoch checkpoints with keep-last-K retention and an
+  atomically-updated LATEST pointer (`manager.CheckpointManager`),
+- auto-resume from the newest COMPLETE checkpoint — manifest
+  sha1-verified, falling back to the previous one on corruption
+  (`CheckpointConfig(dirname, resume=True)`),
+- bad-step guards: a NaN/Inf sentinel on the fetched loss with a
+  configurable policy (`guards.BadStepGuard`) and `reader.retry` for
+  transient input errors.
+
+`inject` is the deterministic fault-injection harness that proves the
+above end-to-end: kill at step k, truncate a checkpoint mid-write,
+poison batch k with NaNs, make a reader raise transiently.
+"""
+
+from .config import CheckpointConfig  # noqa: F401
+from .manager import CheckpointManager, LATEST_FILE  # noqa: F401
+from .guards import BadStepError, BadStepGuard, NAN_POLICIES, is_bad  # noqa
+from . import inject  # noqa: F401
+
+__all__ = ['CheckpointConfig', 'CheckpointManager', 'LATEST_FILE',
+           'BadStepError', 'BadStepGuard', 'NAN_POLICIES', 'is_bad',
+           'inject']

@@ -1,0 +1,113 @@
+"""Bad-step guards: NaN/Inf sentinel on the fetched loss.
+
+A poisoned batch (corrupt input) or a numerical blowup shows up as a
+non-finite loss — and by the time it is fetched, the same dispatch has
+already applied the (equally non-finite) gradient update. Recovery
+therefore means UNDOING state, not just skipping a batch:
+
+- 'raise': surface a BadStepError immediately (default — loud failure
+  beats silent NaN params).
+- 'skip_step': restore the host-side snapshot taken just before the
+  dispatch; net effect is that the bad batch was never trained on. The
+  per-step device->host snapshot is the cost of exact undo — enable
+  only when corrupt inputs are an expected, routine event.
+- 'rollback': reload params + optimizer state from the newest COMPLETE
+  checkpoint (the data stream continues FORWARD past the bad batch —
+  rewinding the reader would replay the same poison forever).
+
+All policies escalate to 'raise' after max_bad_steps consecutive bad
+steps: an unbroken NaN run means the model state, not the input, is
+poisoned.
+"""
+
+import numpy as np
+
+__all__ = ['NAN_POLICIES', 'BadStepError', 'BadStepGuard', 'is_bad']
+
+NAN_POLICIES = ('raise', 'skip_step', 'rollback')
+
+
+class BadStepError(RuntimeError):
+    """Non-finite loss the configured policy could not absorb."""
+
+    def __init__(self, message, step=None, loss=None):
+        super(BadStepError, self).__init__(message)
+        self.step = step
+        self.loss = loss
+
+
+def is_bad(value):
+    """True when a fetched metric contains NaN or +/-Inf."""
+    arr = np.asarray(value)
+    if arr.dtype.kind not in 'fc':
+        return False
+    return not bool(np.all(np.isfinite(arr)))
+
+
+class BadStepGuard(object):
+    """Trainer-side policy engine. Call snapshot() before a dispatch
+    (only required when needs_snapshot), handle() on its fetched loss
+    after; handle returns 'ok' | 'skipped' | 'rolled_back' or raises."""
+
+    def __init__(self, policy, max_bad_steps=8, manager=None,
+                 executor=None, program=None):
+        if policy not in NAN_POLICIES:
+            raise ValueError('nan_policy must be one of %s, got %r'
+                             % (NAN_POLICIES, policy))
+        self.policy = policy
+        self.max_bad_steps = int(max_bad_steps)
+        self._manager = manager
+        self._executor = executor
+        self._program = program
+        self._consecutive = 0
+        self._snap = None
+
+    @property
+    def needs_snapshot(self):
+        return self.policy == 'skip_step'
+
+    def snapshot(self):
+        from .. import io as _io
+        self._snap = _io._snapshot_vars(self._program,
+                                        predicate=_io._is_persistable)
+
+    def _restore_snapshot(self):
+        from .. import io as _io
+        from ..core.scope import global_scope
+        arrays, manifest = self._snap
+        scope = global_scope()
+        for name, arr in arrays.items():
+            scope.set(name, _io._from_numpy(arr, manifest[name]['dtype']))
+
+    def handle(self, loss, step):
+        if not is_bad(loss):
+            self._consecutive = 0
+            return 'ok'
+        self._consecutive += 1
+        head = ('non-finite loss at global step %d (%r)'
+                % (step, np.asarray(loss).ravel()[:4].tolist()))
+        if self.policy == 'raise':
+            raise BadStepError(head + " — nan_policy='raise'",
+                               step=step, loss=loss)
+        if self._consecutive > self.max_bad_steps:
+            raise BadStepError(
+                head + ' — %d consecutive bad steps exceed max_bad_steps='
+                '%d; the model state itself is likely poisoned'
+                % (self._consecutive, self.max_bad_steps),
+                step=step, loss=loss)
+        if self.policy == 'skip_step':
+            if self._snap is None:
+                raise BadStepError(
+                    head + " — nan_policy='skip_step' but no pre-step "
+                    'snapshot was taken', step=step, loss=loss)
+            self._restore_snapshot()
+            return 'skipped'
+        # rollback
+        meta = None
+        if self._manager is not None:
+            meta = self._manager.restore(self._executor, self._program)
+        if meta is None:
+            raise BadStepError(
+                head + " — nan_policy='rollback' but no complete "
+                'checkpoint exists to roll back to', step=step, loss=loss)
+        return 'rolled_back'

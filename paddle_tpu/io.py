@@ -19,7 +19,7 @@ from .core.scope import global_scope
 __all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
            'load_params', 'load_persistables', 'save_inference_model',
            'load_inference_model', 'get_inference_program',
-           'save_checkpoint', 'load_checkpoint']
+           'save_checkpoint', 'load_checkpoint', 'verify_checkpoint']
 
 _PARAMS_FILE = 'params.npz'
 _MANIFEST_FILE = 'manifest.json'
@@ -144,12 +144,13 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     import jax
     main_program = main_program or default_main_program()
     arrays, manifest = _snapshot_vars(main_program, vars, predicate)
-    # one writer per pod: every host gathered the same global values
+    # one writer per pod: every host gathered the same global values;
+    # the commit barrier is timeout-bounded so a host preempted mid-save
+    # surfaces as TimeoutError instead of hanging the pod forever
     if jax.process_index() == 0:
         _write_snapshot(dirname, arrays, manifest, filename)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices('paddle_tpu_save_vars')
+    from .parallel.multihost import barrier
+    barrier('paddle_tpu_save_vars')
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -260,7 +261,8 @@ class AsyncSaveHandle(object):
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=None,
-                    reader=None, async_save=False):
+                    reader=None, async_save=False, trainer_state=None,
+                    reader_pending=0):
     """Full training checkpoint: every persistable incl. optimizer state.
 
     reader: a reader.CheckpointableReader — its (epoch, offset, seed)
@@ -268,6 +270,9 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     mid-epoch with exactly the untrained remainder (the reference data
     master's etcd task-queue recovery, go/master/service.go:165-213,
     done masterless via deterministic replay).
+
+    trainer_state: opaque JSON-able dict recorded as meta['trainer']
+    (the Trainer stores its epoch / in-epoch step here).
 
     async_save: snapshot device->host synchronously (donated buffers
     make deferred reads unsafe), then serialize + write on a background
@@ -285,7 +290,11 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     if step is not None:
         meta['step'] = int(step)
     if reader is not None:
-        meta['reader'] = reader.state_dict()
+        # reader_pending: items pulled into a not-yet-run dispatch
+        # window — recorded as unconsumed so resume replays them
+        meta['reader'] = reader.state_dict(pending=reader_pending)
+    if trainer_state is not None:
+        meta['trainer'] = dict(trainer_state)
 
     def _install(arrays, manifest):
         # snapshot AND meta land under ONE lock acquisition: with the
@@ -324,9 +333,8 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     arrays, manifest = _snapshot_vars(main, predicate=_is_persistable)
     if jax.process_index() == 0:
         _install(arrays, manifest)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices('paddle_tpu_save_checkpoint')
+    from .parallel.multihost import barrier
+    barrier('paddle_tpu_save_checkpoint')
     if async_save:  # multihost fallback: completed no-op handle
         t = threading.Thread(target=lambda: None)
         t.start()
@@ -335,36 +343,55 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     return None
 
 
+def verify_checkpoint(dirname):
+    """Validate that dirname holds a COMPLETE checkpoint: checkpoint.json
+    parses and both recorded sha1s match the installed files. Returns the
+    parsed meta dict; raises ValueError on a missing/torn checkpoint
+    (fault.CheckpointManager uses this to fall back to an older one)."""
+    path = os.path.join(dirname, 'checkpoint.json')
+    try:
+        with open(path) as f:
+            recorded = json.load(f)
+    except FileNotFoundError:
+        raise ValueError(
+            'verify_checkpoint: %r holds no checkpoint.json — not a '
+            '(complete) checkpoint directory' % dirname)
+    except ValueError:
+        raise ValueError(
+            'verify_checkpoint: %r is a torn/incomplete checkpoint — '
+            'checkpoint.json does not parse' % dirname)
+    for key, fname in (('params_sha1', _PARAMS_FILE),
+                       ('manifest_sha1', _MANIFEST_FILE)):
+        want = recorded.get(key)
+        fpath = os.path.join(dirname, fname)
+        # a recorded-but-missing file is the same torn state as a
+        # sha mismatch (partial delete/copy) — diagnose it here
+        # instead of letting _sha1_of raise a bare FileNotFoundError
+        # (caught too: the file can vanish between exists and read)
+        if want is None:
+            continue
+        try:
+            missing = not os.path.exists(fpath)
+            mismatch = (not missing) and _sha1_of(fpath) != want
+        except FileNotFoundError:
+            missing, mismatch = True, False
+        if missing or mismatch:
+            reason = 'is missing' if missing else \
+                'does not match the sha1 recorded in checkpoint.json'
+            raise ValueError(
+                'load_checkpoint: %r is a torn/incomplete checkpoint '
+                '— %s %s (a save was interrupted between renames, or '
+                'the directory was partially copied). Restore from '
+                'an older checkpoint; resuming here would pair '
+                'weights with the wrong step/reader state.'
+                % (dirname, fname, reason))
+    return recorded
+
+
 def load_checkpoint(executor, dirname, main_program=None, reader=None):
     path = os.path.join(dirname, 'checkpoint.json')
     if os.path.exists(path):
-        with open(path) as f:
-            recorded = json.load(f)
-        for key, fname in (('params_sha1', _PARAMS_FILE),
-                           ('manifest_sha1', _MANIFEST_FILE)):
-            want = recorded.get(key)
-            fpath = os.path.join(dirname, fname)
-            # a recorded-but-missing file is the same torn state as a
-            # sha mismatch (partial delete/copy) — diagnose it here
-            # instead of letting _sha1_of raise a bare FileNotFoundError
-            # (caught too: the file can vanish between exists and read)
-            if want is None:
-                continue
-            try:
-                missing = not os.path.exists(fpath)
-                mismatch = (not missing) and _sha1_of(fpath) != want
-            except FileNotFoundError:
-                missing, mismatch = True, False
-            if missing or mismatch:
-                reason = 'is missing' if missing else \
-                    'does not match the sha1 recorded in checkpoint.json'
-                raise ValueError(
-                    'load_checkpoint: %r is a torn/incomplete checkpoint '
-                    '— %s %s (a save was interrupted between renames, or '
-                    'the directory was partially copied). Restore from '
-                    'an older checkpoint; resuming here would pair '
-                    'weights with the wrong step/reader state.'
-                    % (dirname, fname, reason))
+        verify_checkpoint(dirname)
     load_persistables(executor, dirname, main_program)
     if not os.path.exists(path):
         if reader is not None:

@@ -11,6 +11,14 @@ def interpret_mode():
     return os.environ.get('PADDLE_TPU_PALLAS_INTERPRET') == '1'
 
 
+def tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams was named TPUCompilerParams before jax 0.6;
+    resolve whichever this jax ships."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, 'CompilerParams', None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def pallas_enabled():
     """Whether to dispatch hot ops to Pallas kernels.
 

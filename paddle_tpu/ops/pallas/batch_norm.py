@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from . import interpret_mode
+from . import tpu_compiler_params
 
 DEFAULT_BLOCK_R = int(os.environ.get('PADDLE_TPU_BN_BLOCK_R', '512'))
 
@@ -131,7 +132,7 @@ def _fused_bn_fwd(x2, scale, bias, eps, block_r):
             pltpu.VMEM((8, bc), jnp.float32),
             pltpu.VMEM((2, bc), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'arbitrary', 'arbitrary')),
         interpret=interpret_mode(),
     )(x2, scale.reshape(1, c), bias.reshape(1, c))

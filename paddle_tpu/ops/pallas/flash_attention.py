@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from . import interpret_mode
+from . import tpu_compiler_params
 
 DEFAULT_BLOCK_Q = int(os.environ.get('PADDLE_TPU_PALLAS_BLOCK_Q', '512'))
 DEFAULT_BLOCK_K = int(os.environ.get('PADDLE_TPU_PALLAS_BLOCK_K', '128'))
@@ -51,11 +52,16 @@ def _pallas_bwd():
 
 
 def _pick_block(t, prefer):
-    """Largest power-of-two block ≤ prefer that divides t (min 128)."""
-    b = prefer
-    while b > 128 and t % b != 0:
+    """Largest power-of-two block ≤ prefer that divides t. Env overrides
+    (e.g. PADDLE_TPU_PALLAS_BLOCK_K=192) are rounded DOWN to a power of
+    two and halved — below 128 if necessary — until they divide t, so a
+    non-dividing override degrades to a smaller valid block instead of
+    tripping the divisibility assert at trace time."""
+    b = max(1, min(int(prefer), int(t)))
+    b = 1 << (b.bit_length() - 1)   # round down to a power of two
+    while b > 1 and t % b != 0:
         b //= 2
-    return min(b, t)
+    return b
 
 
 def _tile_mask(s, qi, ki, kv_len, causal, block_q, block_k):
@@ -203,7 +209,7 @@ def _flash_fwd(q, k, v, kv_len, causal, sm_scale, block_q):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret_mode(),
     )(*inputs)
@@ -361,7 +367,7 @@ def _flash_bwd(q, k, v, o, lse, g, kv_len, causal, sm_scale, block_q):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret_mode(),
     )(*inputs)
@@ -388,7 +394,7 @@ def _flash_bwd(q, k, v, o, lse, g, kv_len, causal, sm_scale, block_q):
                                lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret_mode(),
     )(*inputs_q)

@@ -15,10 +15,11 @@ Environment contracts supported (first match wins):
 """
 
 import os
+import threading
 
 __all__ = ['init_distributed', 'is_initialized', 'global_device_mesh',
            'host_local_batch', 'process_index', 'process_count',
-           'shard_reader']
+           'shard_reader', 'barrier']
 
 _initialized = False
 
@@ -96,6 +97,48 @@ def host_local_batch(global_batch):
         raise ValueError('global batch %d not divisible by %d hosts'
                          % (global_batch, n))
     return global_batch // n
+
+
+def barrier(tag, timeout=None):
+    """Timeout-bounded pod-wide barrier (checkpoint commits must be
+    single-writer + barrier, but an UNBOUNDED barrier turns one
+    preempted host into a pod-wide hang). Raises TimeoutError when the
+    sync does not complete within `timeout` seconds (default from
+    PADDLE_TPU_BARRIER_TIMEOUT_SECS, 600) so the survivors can exit and
+    be restarted to resume from the newest complete checkpoint.
+    Single-process: no-op. timeout<=0 means wait forever."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    if timeout is None:
+        timeout = float(os.environ.get(
+            'PADDLE_TPU_BARRIER_TIMEOUT_SECS', '600'))
+    from jax.experimental import multihost_utils
+    if timeout <= 0:
+        multihost_utils.sync_global_devices(tag)
+        return
+    errbox = []
+
+    def _sync():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except BaseException as e:
+            errbox.append(e)
+
+    # the caller blocks on join(), so the sync never overlaps training
+    # collectives; the thread only exists to make the wait interruptible
+    t = threading.Thread(target=_sync, daemon=True,
+                         name='paddle_tpu_barrier')
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(
+            'barrier %r: pod sync did not complete within %.0fs — a peer '
+            'host likely died or was preempted mid-checkpoint; restart '
+            'the job and resume from the newest complete checkpoint'
+            % (tag, timeout))
+    if errbox:
+        raise errbox[0]
 
 
 def shard_reader(reader, drop_uneven=True):

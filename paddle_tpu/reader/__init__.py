@@ -6,7 +6,8 @@ plug in via paddle_tpu.reader.recordio when built.
 """
 
 from .decorator import (batch, buffered, cache, chain, compose,  # noqa
-                        firstn, map_readers, shard, shuffle, xmap_readers)
+                        firstn, map_readers, retry, shard, shuffle,
+                        xmap_readers)
 from .decorator import prefetch_to_device  # noqa: F401
 from .staging import staged_superbatch  # noqa: F401
 from .state import CheckpointableReader, checkpointable  # noqa: F401

@@ -7,7 +7,7 @@ from threading import Thread
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'prefetch_to_device',
-           'firstn', 'xmap_readers', 'cache', 'batch', 'shard']
+           'firstn', 'xmap_readers', 'cache', 'batch', 'shard', 'retry']
 
 
 def map_readers(func, *readers):
@@ -174,6 +174,43 @@ def batch(reader, batch_size, drop_last=True):
         if b and not drop_last:
             yield b
     return batch_reader
+
+
+def retry(reader, tries=3, backoff=0.1, exceptions=(OSError,)):
+    """Transient-input-error tolerance: when the underlying reader raises
+    one of `exceptions`, the stream is rebuilt and the already-yielded
+    prefix is SKIPPED on replay (readers here are deterministic — the
+    same contract CheckpointableReader's mid-epoch resume leans on), so
+    consumers see each item at most once, in order.
+
+    `tries` counts consecutive failed attempts: the tries-th consecutive
+    failure re-raises; any successfully yielded item resets the counter.
+    `backoff` seconds before each retry, doubling per consecutive
+    failure (0 disables sleeping).
+    """
+    import time
+    if tries < 1:
+        raise ValueError('retry: tries must be >= 1, got %r' % (tries,))
+
+    def data_reader():
+        yielded = 0
+        failures = 0
+        while True:
+            try:
+                for i, item in enumerate(reader()):
+                    if i < yielded:
+                        continue    # replayed prefix: already delivered
+                    yield item
+                    yielded += 1
+                    failures = 0
+                return
+            except exceptions:
+                failures += 1
+                if failures >= tries:
+                    raise
+                if backoff:
+                    time.sleep(backoff * (2 ** (failures - 1)))
+    return data_reader
 
 
 def resolve_device(place):

@@ -73,8 +73,20 @@ class CheckpointableReader(object):
         self.offset = 0
 
     # ------------------------------------------------------------ state
-    def state_dict(self):
-        return {'epoch': int(self.epoch), 'offset': int(self.offset),
+    def state_dict(self, pending=0):
+        """pending: items already PULLED from the stream but not yet
+        trained on (the Trainer's partially-filled dispatch window) —
+        subtracted from offset so resume replays them. Callers must not
+        pass a pending that spans an epoch boundary (offset resets to 0
+        there; the Trainer defers the save instead)."""
+        pending = int(pending)
+        if pending < 0 or pending > self.offset:
+            raise ValueError(
+                'state_dict: pending=%d not in [0, offset=%d] — pulled-'
+                'but-untrained items cannot span an epoch boundary'
+                % (pending, self.offset))
+        return {'epoch': int(self.epoch),
+                'offset': int(self.offset) - pending,
                 'seed': self._seed, 'shuffle_buf': self._buf}
 
     def load_state_dict(self, state):

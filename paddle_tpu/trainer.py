@@ -4,8 +4,12 @@ fluid.Trainer shape).
 
 A thin, reader-driven loop over the Executor: batches from a v2-style
 reader (optionally prefetched to HBM), per-step/epoch events to a
-handler, checkpointing via io.save_checkpoint.
+handler, checkpointing via the fault.CheckpointManager (periodic
+mid-epoch saves, keep-last-K retention, sha1-verified auto-resume) and
+bad-step guards (fault.guards) on the fetched loss.
 """
+
+import time
 
 import numpy as np
 
@@ -14,6 +18,9 @@ from .core.place import TPUPlace
 from .core.program import (default_main_program, default_startup_program,
                            program_guard)
 from . import io as _io
+from .fault import CheckpointConfig, CheckpointManager
+from .fault import inject as _inject
+from .fault.guards import BadStepGuard
 
 __all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
            'EndStepEvent', 'Trainer']
@@ -62,7 +69,25 @@ class Trainer(object):
                 self.fetches = [self.fetches]
             optimizer_func().minimize(self.fetches[0])
         self.exe = Executor(self.place)
-        self.checkpoint_dir = checkpoint_config
+        if isinstance(checkpoint_config, str):
+            # legacy contract: a bare dirname = epoch-end saves only,
+            # guards off — exactly the pre-fault-subsystem behavior
+            checkpoint_config = CheckpointConfig(checkpoint_config,
+                                                 nan_policy=None)
+        self.checkpoint_config = checkpoint_config
+        self._ckpt = (CheckpointManager(checkpoint_config)
+                      if checkpoint_config is not None else None)
+        self.checkpoint_dir = (checkpoint_config.dirname
+                               if checkpoint_config is not None else None)
+        self._guard = None
+        if checkpoint_config is not None and checkpoint_config.nan_policy:
+            self._guard = BadStepGuard(
+                checkpoint_config.nan_policy,
+                checkpoint_config.max_bad_steps,
+                manager=self._ckpt, executor=self.exe,
+                program=self.program)
+        self._ckpt_reader = None
+        self._last_save = time.monotonic()
         self._step = 0
 
     def _to_feed(self, data, feeder, feed_order):
@@ -86,17 +111,41 @@ class Trainer(object):
         one program. Trailing batches that do not fill a window run
         per-step."""
         event_handler = event_handler or (lambda e: None)
+        _inject.install_from_env()
+        from .reader.state import CheckpointableReader
+        self._ckpt_reader = (reader if isinstance(reader,
+                                                  CheckpointableReader)
+                             else None)
         if reader is not None:
             # Multihost: each host consumes a disjoint shard of the stream
             # (parallel.multihost.shard_reader; no-op on a single host).
             from .parallel.multihost import shard_reader
             reader = shard_reader(reader)
         self.exe.run(self.startup)
+        start_epoch = 0
+        resume_step = 0
+        if self._ckpt is not None and self.checkpoint_config.resume:
+            meta = self._ckpt.restore(self.exe, self.program,
+                                      reader=self._ckpt_reader)
+            if meta is not None:
+                self._step = int(meta.get('step') or 0)
+                # RNG stream continuity (dropout masks): the executor's
+                # step key counter sits one ahead of the trainer's step
+                # (startup consumed key 0)
+                self.exe._step = self._step + 1
+                tstate = meta.get('trainer') or {}
+                start_epoch = int(tstate.get('epoch', 0))
+                resume_step = int(tstate.get('epoch_step', 0))
+        self._last_save = time.monotonic()
         w = int(steps_per_dispatch)
-        for epoch in range(num_epochs):
+        for epoch in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch))
-            step = 0
+            # resumed mid-epoch: the CheckpointableReader replays only
+            # the untrained remainder; step ids continue where they left
+            step = resume_step
+            resume_step = 0
             window = []
+            self._pending = 0
             for data in reader():
                 feed = self._to_feed(data, feeder, feed_order)
                 if w <= 1:
@@ -105,38 +154,91 @@ class Trainer(object):
                 if window and self._feed_sig(feed) != \
                         self._feed_sig(window[0]):
                     # shape change mid-window (bucketed readers): the
-                    # collected prefix runs per-step, stacking resumes
-                    for f in window:
+                    # collected prefix runs per-step, stacking resumes.
+                    # _pending = items PULLED from the reader but not
+                    # yet trained (rest of the prefix + the triggering
+                    # batch) — a checkpoint here must not record them
+                    # as consumed or resume would skip them
+                    flush, window = window, []
+                    for j, f in enumerate(flush):
+                        self._pending = len(flush) - 1 - j + 1
                         step = self._run_one(epoch, step, f,
                                              event_handler)
-                    window = []
+                    self._pending = 0
                 window.append(feed)
                 if len(window) == w:
                     step = self._run_window(epoch, step, window,
                                             event_handler)
                     window = []
-            for feed in window:  # trailing partial window: per-step
+            for j, feed in enumerate(window):  # trailing window: per-step
+                self._pending = len(window) - 1 - j
                 step = self._run_one(epoch, step, feed, event_handler)
+            self._pending = 0
             event_handler(EndEpochEvent(epoch))
-            if self.checkpoint_dir:
-                _io.save_checkpoint(self.exe, self.checkpoint_dir,
-                                    main_program=self.program,
-                                    step=self._step)
+            if self._ckpt is not None and self.checkpoint_config.epoch_end:
+                self._save_checkpoint(epoch + 1, 0)
+        if self._ckpt is not None:
+            # completeness point: LATEST/GC of the last async save landed
+            self._ckpt.wait()
 
     @staticmethod
     def _feed_sig(feed):
         return {n: np.asarray(v).shape for n, v in feed.items()}
 
+    def _save_checkpoint(self, epoch, epoch_step):
+        """Checkpoint NOW, recording where the loop stands: resume
+        restarts at (epoch, epoch_step) with the reader replaying the
+        untrained remainder of that epoch."""
+        self._ckpt.save(self.exe, self.program, step=self._step,
+                        reader=self._ckpt_reader,
+                        reader_pending=getattr(self, '_pending', 0),
+                        trainer_state={'epoch': int(epoch),
+                                       'epoch_step': int(epoch_step)})
+        self._last_save = time.monotonic()
+
+    def _maybe_checkpoint(self, epoch, epoch_step):
+        cfg = self.checkpoint_config
+        if self._ckpt is None or (not cfg.save_every_steps and
+                                  cfg.save_every_secs is None):
+            return
+        if self._ckpt_reader is not None and \
+                getattr(self, '_pending', 0) > self._ckpt_reader.offset:
+            # pulled-but-untrained items span an epoch boundary (offset
+            # already reset); their in-epoch positions are unknowable —
+            # defer to the next cadence point instead of mis-recording
+            return
+        due = bool(cfg.save_every_steps) and self._step > 0 and \
+            self._step % cfg.save_every_steps == 0
+        if not due and cfg.save_every_secs is not None:
+            due = time.monotonic() - self._last_save >= cfg.save_every_secs
+        if due:
+            self._save_checkpoint(epoch, epoch_step)
+
     def _run_one(self, epoch, step, feed, event_handler):
+        g = self._guard
+        if g is not None and g.needs_snapshot:
+            g.snapshot()
         event_handler(BeginStepEvent(epoch, step))
         metrics = self.exe.run(program=self.program, feed=feed,
                                fetch_list=self.fetches)
         self._step += 1
+        verdict = g.handle(metrics[0], self._step) if g is not None \
+            else 'ok'
+        if verdict == 'skipped':
+            self._step -= 1     # the update was undone; it never counted
         event_handler(EndStepEvent(epoch, step, metrics))
+        if verdict == 'ok':
+            # never checkpoint a bad step's state; a skipped/rolled-back
+            # step saves nothing and the next good one resumes cadence
+            self._maybe_checkpoint(epoch, step + 1)
+        _inject.fire('step_end', step=self._step)
         return step + 1
 
     def _run_window(self, epoch, step0, window, event_handler):
         w = len(window)
+        g = self._guard
+        if g is not None and g.needs_snapshot:
+            g.snapshot()
         for i in range(w):
             event_handler(BeginStepEvent(epoch, step0 + i))
         stacked = {name: np.stack([f[name] for f in window])
@@ -146,9 +248,18 @@ class Trainer(object):
                                      fetch_list=self.fetches,
                                      stacked_feed=True)
         self._step += w
+        # a window with ANY bad step is undone as a unit — the steps ran
+        # as one device program, so that's also the undo granularity
+        verdict = g.handle(metrics[0], self._step) if g is not None \
+            else 'ok'
+        if verdict == 'skipped':
+            self._step -= w
         for i in range(w):
             event_handler(EndStepEvent(
                 epoch, step0 + i, [np.asarray(m[i]) for m in metrics]))
+        if verdict == 'ok':
+            self._maybe_checkpoint(epoch, step0 + w)
+        _inject.fire('step_end', step=self._step)
         return step0 + w
 
     def save_params(self, dirname):

@@ -1160,8 +1160,9 @@ def selective_fc_layer(input, size, select=None, act=None, name=None,
     CPU-sparse trick); on the MXU the dense [B,n]×[n,size] matmul IS
     the fast path, so this computes dense and multiplies by the
     0/1 `select` mask — same output, TPU-shaped."""
-    if isinstance(input, (list, tuple)):
-        input = _fl.concat([_flatten2(v) for v in input], axis=-1)
+    # list inputs go straight to fc_layer, which concats while
+    # preserving sequence layout (a local _flatten2 pass would destroy
+    # the [B,T,D] shape and drop the length var)
     out = fc_layer(input=input, size=size, act=act, name=name,
                    param_attr=param_attr, bias_attr=bias_attr)
     if select is not None:

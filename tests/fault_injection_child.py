@@ -1,0 +1,71 @@
+"""Child process for the crash/resume e2e (driven by tests/test_fault.py
+— NOT a test module itself).
+
+Trains a deterministic 2-layer model with mid-epoch checkpointing and
+writes the final parameters to an .npz. Environment contract:
+
+    FT_CKPT_DIR                  checkpoint tree root (required)
+    FT_OUT                       final-params .npz path (required)
+    FT_SYNC_SAVE                 optional: synchronous saves (so commit
+                                 order is deterministic vs the kill step)
+    PADDLE_TPU_FI_KILL_AT_STEP   optional: die (exit 42) at global step k
+    PADDLE_TPU_FI_CORRUPT_CKPT_AT  optional: truncate the checkpoint
+                                 committed at step k
+
+Run once clean to get the reference params; run with the kill var to
+simulate preemption; run again WITHOUT it (resume=True picks up the
+newest complete checkpoint) and the final params must be bit-identical
+to the clean run — init, shuffle order, and updates are all
+deterministic, so any divergence is a checkpoint/replay bug.
+"""
+
+import os
+
+from paddle_tpu.core.platform_boot import force_host_cpu
+
+force_host_cpu()
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import io as pio  # noqa: E402
+from paddle_tpu import reader as R  # noqa: E402
+from paddle_tpu.fault import CheckpointConfig  # noqa: E402
+
+
+def train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=8, act='tanh')
+    pred = fluid.layers.fc(input=h, size=1)
+    return [fluid.layers.mean(fluid.layers.square_error_cost(pred, y))]
+
+
+def batches():
+    rng = np.random.RandomState(7)
+    w = rng.randn(4, 1).astype('float32')
+    for _ in range(12):
+        xs = rng.randn(8, 4).astype('float32')
+        yield {'x': xs, 'y': (xs @ w).astype('float32')}
+
+
+def main():
+    ckpt_dir = os.environ['FT_CKPT_DIR']
+    out = os.environ['FT_OUT']
+    reader = R.CheckpointableReader(batches, shuffle_buf=4, seed=11)
+    cfg = CheckpointConfig(ckpt_dir, save_every_steps=3, keep_last=3,
+                           resume=True,
+                           async_save=not os.environ.get('FT_SYNC_SAVE'))
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        place=fluid.CPUPlace(), checkpoint_config=cfg)
+    trainer.train(num_epochs=2, reader=reader)
+    arrays, _ = pio._snapshot_vars(fluid.default_main_program(),
+                                   predicate=pio._is_parameter)
+    with open(out, 'wb') as f:
+        np.savez(f, **arrays)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,396 @@
+"""Fault-tolerance subsystem (paddle_tpu.fault): mid-epoch checkpoint /
+auto-resume, retention GC, LATEST semantics, truncated-checkpoint
+fallback, NaN-policy matrix, reader.retry, and the subprocess
+crash/resume e2e proving bit-identical final params (reference analog:
+go/master/service.go's etcd task-queue recovery, rebuilt masterless)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io as pio
+from paddle_tpu import reader as R
+from paddle_tpu.fault import (BadStepError, CheckpointConfig,
+                              CheckpointManager, inject)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    inject.clear()
+    yield
+    inject.clear()
+
+
+# --------------------------------------------------------------- helpers
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 1).astype('float32')
+    out = []
+    for _ in range(n):
+        xs = rng.randn(8, 4).astype('float32')
+        out.append({'x': xs, 'y': (xs @ w).astype('float32')})
+    return out
+
+
+def _train_run(cfg, reader, n_epochs=1, event_handler=None):
+    """One Trainer run in a fresh scope/programs; returns the final
+    'fw' parameter (copy)."""
+    def train_func():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1,
+                               param_attr=fluid.ParamAttr(name='fw'),
+                               bias_attr=fluid.ParamAttr(name='fb'))
+        return [fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))]
+
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.reset_default_programs()
+        trainer = fluid.Trainer(
+            train_func=train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+            place=fluid.CPUPlace(), checkpoint_config=cfg)
+        trainer.train(num_epochs=n_epochs, reader=reader,
+                      event_handler=event_handler)
+        return np.asarray(fluid.global_scope().find('fw')).copy()
+
+
+def _build_exe_model(seed=0):
+    """Direct Executor + 1-param model for manager-level tests; returns
+    (exe, step_fn)."""
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name='w'))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(seed)
+    feed = {'x': rng.rand(8, 4).astype('f'),
+            'y': rng.rand(8, 1).astype('f')}
+    return exe, lambda: exe.run(feed=feed, fetch_list=[loss])
+
+
+# -------------------------------------------------- retention / LATEST
+def test_retention_gc_keeps_exactly_k(tmp_path):
+    d = str(tmp_path)
+    exe, step = _build_exe_model()
+    mgr = CheckpointManager(CheckpointConfig(d, keep_last=2,
+                                             async_save=False))
+    for s in range(1, 6):
+        step()
+        mgr.save(exe, fluid.default_main_program(), step=s)
+    dirs = sorted(n for n in os.listdir(d) if n.startswith('step_'))
+    assert dirs == ['step_00000004', 'step_00000005']
+    assert mgr.latest_pointer()[0] == 5
+    with open(os.path.join(d, 'LATEST')) as f:
+        assert f.read().strip() == 'step_00000005'
+
+
+def test_retention_gc_async_path(tmp_path):
+    d = str(tmp_path)
+    exe, step = _build_exe_model()
+    mgr = CheckpointManager(CheckpointConfig(d, keep_last=1,
+                                             async_save=True))
+    for s in (1, 2, 3):
+        step()
+        mgr.save(exe, fluid.default_main_program(), step=s)
+    mgr.wait()
+    dirs = sorted(n for n in os.listdir(d) if n.startswith('step_'))
+    assert dirs == ['step_00000003']
+    assert mgr.latest_pointer()[0] == 3
+
+
+def test_find_latest_empty_tree(tmp_path):
+    mgr = CheckpointManager(CheckpointConfig(str(tmp_path)))
+    assert mgr.find_latest() is None
+    assert mgr.restore(None, None) is None
+
+
+# ------------------------------------------- truncated-checkpoint fallback
+def test_truncated_checkpoint_falls_back(tmp_path):
+    d = str(tmp_path)
+    exe, step = _build_exe_model()
+    mgr = CheckpointManager(CheckpointConfig(d, keep_last=3,
+                                             async_save=False))
+    step()
+    w1 = np.asarray(fluid.global_scope().find('w')).copy()
+    mgr.save(exe, fluid.default_main_program(), step=1)
+    step()
+    mgr.save(exe, fluid.default_main_program(), step=2)
+    assert mgr.latest_pointer()[0] == 2
+    # bit-rot / torn write on the NEWEST checkpoint, which LATEST names
+    inject.truncate_file(os.path.join(mgr.step_dir(2), 'params.npz'))
+    with pytest.raises(ValueError, match='torn|incomplete'):
+        pio.verify_checkpoint(mgr.step_dir(2))
+    fluid.global_scope().set('w', np.zeros_like(w1))
+    with pytest.warns(UserWarning, match='unusable|skipping'):
+        meta = mgr.restore(exe, fluid.default_main_program())
+    assert meta['step'] == 1
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find('w')), w1)
+
+
+def test_find_latest_skips_torn_dir_without_meta(tmp_path):
+    """A save killed before checkpoint.json landed (params present, no
+    meta) must be skipped, not loaded."""
+    d = str(tmp_path)
+    exe, step = _build_exe_model()
+    mgr = CheckpointManager(CheckpointConfig(d, async_save=False))
+    step()
+    mgr.save(exe, fluid.default_main_program(), step=1)
+    torn = mgr.step_dir(9)
+    os.makedirs(torn)
+    with open(os.path.join(torn, 'params.npz'), 'wb') as f:
+        f.write(b'partial write')
+    with pytest.warns(UserWarning, match='skipping'):
+        found = mgr.find_latest()
+    assert found[0] == 1
+
+
+# ----------------------------------------------------- NaN-policy matrix
+def test_nan_policy_raise(tmp_path):
+    batches = _batches(6)
+    poisoned = inject.poison_nans(lambda: iter(batches), 2)
+    cfg = CheckpointConfig(str(tmp_path), nan_policy='raise',
+                           epoch_end=False)
+    with pytest.raises(BadStepError, match='non-finite'):
+        _train_run(cfg, poisoned)
+
+
+def test_nan_policy_skip_step_equals_dropping_the_batch(tmp_path):
+    batches = _batches(6)
+    poisoned = inject.poison_nans(lambda: iter(batches), 2)
+    cfg = CheckpointConfig(str(tmp_path), nan_policy='skip_step',
+                           epoch_end=False)
+    w_skip = _train_run(cfg, poisoned)
+    assert np.all(np.isfinite(w_skip))
+    w_ref = _train_run(None, lambda: iter(
+        [b for i, b in enumerate(batches) if i != 2]))
+    np.testing.assert_array_equal(w_skip, w_ref)
+
+
+def test_nan_policy_rollback_restores_last_checkpoint(tmp_path):
+    batches = _batches(6)
+    poisoned = inject.poison_nans(lambda: iter(batches), 2)
+    # checkpoint every step synchronously: the newest checkpoint IS the
+    # pre-bad-step state, so rollback == skip == dropping the batch
+    cfg = CheckpointConfig(str(tmp_path), save_every_steps=1,
+                           async_save=False, nan_policy='rollback',
+                           epoch_end=False)
+    w_rb = _train_run(cfg, poisoned)
+    assert np.all(np.isfinite(w_rb))
+    w_ref = _train_run(None, lambda: iter(
+        [b for i, b in enumerate(batches) if i != 2]))
+    np.testing.assert_array_equal(w_rb, w_ref)
+
+
+def test_nan_policy_rollback_without_checkpoint_raises(tmp_path):
+    batches = _batches(3)
+    poisoned = inject.poison_nans(lambda: iter(batches), 0)
+    cfg = CheckpointConfig(str(tmp_path), nan_policy='rollback',
+                           epoch_end=False)   # no cadence -> no ckpt yet
+    with pytest.raises(BadStepError, match='no complete checkpoint'):
+        _train_run(cfg, poisoned)
+
+
+def test_nan_policy_max_bad_steps_escalates(tmp_path):
+    batches = _batches(8)
+    all_bad = [{'x': b['x'], 'y': np.full_like(b['y'], np.nan)}
+               for b in batches]
+    cfg = CheckpointConfig(str(tmp_path), nan_policy='skip_step',
+                           max_bad_steps=3, epoch_end=False)
+    with pytest.raises(BadStepError, match='consecutive'):
+        _train_run(cfg, lambda: iter(all_bad))
+
+
+def test_guard_unit_is_bad():
+    from paddle_tpu.fault import is_bad
+    assert is_bad(np.float32('nan'))
+    assert is_bad(np.array([1.0, np.inf]))
+    assert not is_bad(np.array([1.0, -2.0]))
+    assert not is_bad(np.array([1, 2], dtype='int64'))
+
+
+def test_checkpoint_config_validation():
+    with pytest.raises(ValueError, match='dirname'):
+        CheckpointConfig('')
+    with pytest.raises(ValueError, match='keep_last'):
+        CheckpointConfig('d', keep_last=0)
+    with pytest.raises(ValueError, match='nan_policy'):
+        CheckpointConfig('d', nan_policy='explode')
+    with pytest.raises(ValueError, match='save_every_steps'):
+        CheckpointConfig('d', save_every_steps=0)
+
+
+# ----------------------------------------------------------- reader.retry
+def test_retry_recovers_transient_failures():
+    fl = inject.flaky(lambda: iter(range(10)), fail_times=2, fail_after=3)
+    assert list(R.retry(fl, tries=3, backoff=0)()) == list(range(10))
+    assert fl.state == {'fails': 2, 'calls': 3}
+
+
+def test_retry_no_duplicates_no_gaps_after_midstream_failure():
+    fl = inject.flaky(lambda: iter(range(8)), fail_times=1, fail_after=5)
+    got = list(R.retry(fl, tries=2, backoff=0)())
+    assert got == list(range(8))        # prefix not re-yielded
+
+
+def test_retry_exhaustion_reraises():
+    fl = inject.flaky(lambda: iter(range(5)), fail_times=99, fail_after=1)
+    with pytest.raises(inject.TransientReaderError):
+        list(R.retry(fl, tries=3, backoff=0)())
+
+
+def test_retry_backoff_doubles(monkeypatch):
+    import time as _time
+    sleeps = []
+    monkeypatch.setattr(_time, 'sleep', lambda s: sleeps.append(s))
+    fl = inject.flaky(lambda: iter(range(4)), fail_times=2, fail_after=0)
+    assert list(R.retry(fl, tries=4, backoff=0.05)()) == [0, 1, 2, 3]
+    assert sleeps == [0.05, 0.1]
+
+
+# ------------------------------------------------- mid-epoch auto-resume
+class _Preempted(Exception):
+    pass
+
+
+def test_mid_epoch_resume_in_process(tmp_path):
+    """Preempt (via an exception) after 5 steps of epoch 0, restart with
+    resume=True, and the final params match an uninterrupted run exactly
+    — mid-epoch state (params, step, reader offset) round-trips."""
+    d = str(tmp_path / 'ckpt')
+    batches = _batches(10, seed=3)
+
+    def make_reader():
+        return R.CheckpointableReader(lambda: iter(batches),
+                                      shuffle_buf=4, seed=9)
+
+    def cfg():
+        return CheckpointConfig(d, save_every_steps=2, async_save=False,
+                                resume=True, nan_policy=None)
+
+    count = [0]
+
+    def killer(e):
+        if isinstance(e, fluid.trainer.EndStepEvent):
+            count[0] += 1
+            if count[0] == 5:
+                raise _Preempted()
+
+    with pytest.raises(_Preempted):
+        _train_run(cfg(), make_reader(), n_epochs=2, event_handler=killer)
+    assert CheckpointManager(cfg()).find_latest()[0] == 4
+
+    w_resumed = _train_run(cfg(), make_reader(), n_epochs=2)
+    w_ref = _train_run(None, make_reader(), n_epochs=2)
+    np.testing.assert_array_equal(w_resumed, w_ref)
+
+
+def test_resume_noop_on_empty_tree(tmp_path):
+    d = str(tmp_path / 'never_written')
+    cfg = CheckpointConfig(d, resume=True, epoch_end=False,
+                           nan_policy=None)
+    w = _train_run(cfg, lambda: iter(_batches(3)))
+    assert np.all(np.isfinite(w))
+
+
+# -------------------------------------------- subprocess crash/resume e2e
+def _run_child(tmp, tag, extra_env, reuse_ckpt=None):
+    env = dict(os.environ)
+    for k in ('PADDLE_TPU_FI_KILL_AT_STEP', 'PADDLE_TPU_FI_CORRUPT_CKPT_AT',
+              'XLA_FLAGS'):
+        env.pop(k, None)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    ckpt = reuse_ckpt or os.path.join(str(tmp), tag + '_ckpt')
+    out = os.path.join(str(tmp), tag + '.npz')
+    env['FT_CKPT_DIR'] = ckpt
+    env['FT_OUT'] = out
+    env.update(extra_env)
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, 'tests', 'fault_injection_child.py')],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    return p, ckpt, out
+
+
+@pytest.fixture(scope='module')
+def clean_run(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('ft_clean')
+    p, _, out = _run_child(tmp, 'clean', {})
+    assert p.returncode == 0, p.stderr
+    return np.load(out)
+
+
+def _assert_bit_identical(a, b):
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_e2e_kill_and_resume_bit_identical(tmp_path, clean_run):
+    # run killed mid-epoch at injected step 7 (12 steps/epoch)
+    p, ckpt, out = _run_child(tmp_path, 'killed',
+                              {'PADDLE_TPU_FI_KILL_AT_STEP': '7'})
+    assert p.returncode == inject.KILL_EXIT_CODE, (p.returncode, p.stderr)
+    assert not os.path.exists(out)      # died before finishing
+    assert os.path.isdir(ckpt)          # ...but left checkpoints behind
+    # restart WITHOUT the fault env: resume=True picks up the newest
+    # complete checkpoint and finishes the job
+    p, _, out = _run_child(tmp_path, 'resumed', {}, reuse_ckpt=ckpt)
+    assert p.returncode == 0, p.stderr
+    _assert_bit_identical(clean_run, np.load(out))
+
+
+def test_e2e_corrupt_newest_checkpoint_falls_back(tmp_path, clean_run):
+    # sync saves (deterministic commit order); checkpoint at step 9 is
+    # truncated right after its commit, then the process dies at step 10
+    p, ckpt, out = _run_child(
+        tmp_path, 'corrupt',
+        {'PADDLE_TPU_FI_KILL_AT_STEP': '10',
+         'PADDLE_TPU_FI_CORRUPT_CKPT_AT': '9',
+         'FT_SYNC_SAVE': '1'})
+    assert p.returncode == inject.KILL_EXIT_CODE, (p.returncode, p.stderr)
+    # precondition: LATEST names the corrupted checkpoint
+    with open(os.path.join(ckpt, 'LATEST')) as f:
+        assert f.read().strip() == 'step_00000009'
+    with pytest.raises(ValueError, match='torn|incomplete'):
+        pio.verify_checkpoint(os.path.join(ckpt, 'step_00000009'))
+    # resume detects the sha1 mismatch, falls back to step 6, and still
+    # reproduces the uninterrupted run bit-for-bit
+    p, _, out = _run_child(tmp_path, 'corrupt_resumed', {},
+                           reuse_ckpt=ckpt)
+    assert p.returncode == 0, p.stderr
+    assert 'unusable' in p.stderr or 'falling back' in p.stderr
+    _assert_bit_identical(clean_run, np.load(out))
+
+
+# --------------------------------------------------- satellite regressions
+def test_pallas_block_override_rounded_to_divisor():
+    from paddle_tpu.ops.pallas.flash_attention import _pick_block
+    assert _pick_block(256, 192) == 128   # non-pow2 override degrades
+    assert _pick_block(256, 512) == 256
+    assert _pick_block(64, 512) == 64
+    assert _pick_block(96, 128) == 32     # halves below 128 to a divisor
+    assert _pick_block(128, 128) == 128
+
+
+def test_reader_state_pending_adjustment():
+    r = R.CheckpointableReader(lambda: iter(range(10)))
+    gen = r()
+    for _ in range(4):
+        next(gen)
+    gen.close()
+    assert r.state_dict()['offset'] == 4
+    assert r.state_dict(pending=3)['offset'] == 1
+    with pytest.raises(ValueError, match='pending'):
+        r.state_dict(pending=5)

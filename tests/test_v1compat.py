@@ -245,8 +245,11 @@ def test_context_projection_matches_numpy():
 
 def test_unshimmed_name_names_fluid_equivalent():
     import paddle_tpu.trainer_config_helpers.layers as v1l
-    with pytest.raises(NotImplementedError, match='fc'):
-        v1l.selective_fc_layer
+    # selective_fc_layer graduated to a real implementation in round 5;
+    # sub_nested_seq_layer is still unshimmed (LoD depth>1 descoped)
+    assert callable(v1l.selective_fc_layer)
+    with pytest.raises(NotImplementedError, match='LoD'):
+        v1l.sub_nested_seq_layer
     with pytest.raises(AttributeError):
         v1l.definitely_not_a_layer
     # recurrent_group graduated from this list in round 5 (recurrent.py)
