@@ -93,6 +93,19 @@ def _store_path():
         os.path.dirname(os.path.abspath(__file__)), 'ONCHIP_r05.jsonl'))
 
 
+def _metrics_path():
+    """Telemetry JSONL beside the results store: every workload child
+    enables paddle_tpu.observe and appends its snapshots/summary here
+    (pid-tagged lines), so each on-chip window leaves diagnosable
+    numbers — compile seconds, cache hits, phase timings, MFU — not
+    just the headline value. tools/metrics_report.py summarizes it."""
+    env = os.environ.get('PADDLE_TPU_METRICS_JSONL')
+    if env:
+        return env
+    root, _ = os.path.splitext(_store_path())
+    return root + '_metrics.jsonl'
+
+
 def store_put(key, workload, backend, value=None, ok=True, env=None,
               provenance='driver', error=None):
     rec = {'key': key, 'workload': workload, 'backend': backend,
@@ -527,6 +540,17 @@ def pallas_parity():
 
 def _run_workload_child(workload, backend, reduced):
     """Child-process entry: run ONE workload, print 'RESULT <number>'."""
+    from paddle_tpu import observe
+    # metrics JSONL beside the result lines; summary line lands via the
+    # atexit hook even when a later phase hangs and the watchdog kills us.
+    # The AOT cost probe (~doubles each compile) stays off by default
+    # here: relay watchdog budgets are tight and bench computes its MFU
+    # analytically; executor.first_dispatch_seconds still records
+    # per-key compile wall for free. Opt back in with
+    # PADDLE_TPU_OBSERVE_COST=1.
+    os.environ.setdefault('PADDLE_TPU_OBSERVE_COST', '0')
+    observe.enable(jsonl=_metrics_path(),
+                   trace=os.environ.get('PADDLE_TPU_TRACE_JSON'))
     if backend == 'cpu':
         from paddle_tpu.core.platform_boot import force_host_cpu
         force_host_cpu()
@@ -659,6 +683,8 @@ def main():
     # on the same compile. Harmless where the backend ignores it.
     os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
                           '/tmp/paddle_tpu_jax_cache')
+    # every workload child writes telemetry here (inherited env)
+    os.environ.setdefault('PADDLE_TPU_METRICS_JSONL', _metrics_path())
     forced = os.environ.get('BENCH_BACKEND')
     if forced:
         backend, degraded = forced, False

@@ -10,8 +10,12 @@ a donated dict argument, so parameter updates are in-place in HBM and steps
 run with zero host round-trips beyond feed/fetch.
 """
 
+import os
+import time
+
 import numpy as np
 
+from .. import observe as _obs
 from .dtypes import to_jnp_dtype
 from .place import CPUPlace, TPUPlace
 from .program import Variable, default_main_program
@@ -104,7 +108,7 @@ def _remat_policy(name):
 
 class _Compiled(object):
     __slots__ = ('fn', 'raw_fn', 'scope_in_names', 'scope_out_names',
-                 'feed_names', 'fetch_names')
+                 'feed_names', 'fetch_names', 'flops')
 
     def __init__(self, fn, raw_fn, scope_in_names, scope_out_names,
                  feed_names, fetch_names):
@@ -114,6 +118,7 @@ class _Compiled(object):
         self.scope_out_names = scope_out_names
         self.feed_names = feed_names
         self.fetch_names = fetch_names
+        self.flops = None  # per-step XLA cost-analysis FLOPs (observe)
 
 
 _SUB_BLOCK_ATTRS = ('sub_block', 'true_block', 'false_block')
@@ -217,17 +222,36 @@ class Executor(object):
         key = (id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names))
         compiled = self._cache.get(key) if use_program_cache else None
+        self.last_cache_miss = compiled is None
         if compiled is None:
-            compiled = self._compile(program, sorted(feed_vals), fetch_names)
+            compiled = self._observed_compile(
+                'single', key,
+                lambda: self._compile(program, sorted(feed_vals),
+                                      fetch_names))
             if use_program_cache:
                 self._cache[key] = compiled
+        elif _obs.enabled():
+            _obs.inc('executor.cache_hit_total', kind='single',
+                     key=_obs.key_id(key))
 
         scope_vals, feed_vals = self._prepare_inputs(
             'Executor.run', program, compiled, scope, feed_vals)
+        if _obs.enabled() and compiled.flops is None:
+            self._cost_account(compiled, key, scope_vals, feed_vals)
 
         step_i = np.int32(self._step)
         self._step += 1
-        fetches, new_scope = compiled.fn(scope_vals, feed_vals, step_i)
+        if _obs.enabled() and self.last_cache_miss:
+            # first dispatch of this key = XLA compile + one step; a
+            # near-free compile-time signal even when the AOT cost
+            # probe is off (PADDLE_TPU_OBSERVE_COST=0)
+            t0 = time.perf_counter()
+            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step_i)
+            _obs.record('executor.first_dispatch_seconds',
+                        time.perf_counter() - t0, kind='single',
+                        key=_obs.key_id(key))
+        else:
+            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step_i)
 
         for name, value in new_scope.items():
             scope.set(name, value)
@@ -285,8 +309,12 @@ class Executor(object):
                program.remat_policy, feed_sig, tuple(fetch_names),
                steps, stacked_feed)
         compiled = self._cache.get(key)
+        self.last_cache_miss = compiled is None
         if compiled is None:
-            base = self._compile(program, sorted(feed_vals), fetch_names)
+            base = self._observed_compile(
+                'multi', key,
+                lambda: self._compile(program, sorted(feed_vals),
+                                      fetch_names))
 
             # state that is read each step chains through the scan carry;
             # written-only persistables (no reader) are ALSO carried —
@@ -325,13 +353,27 @@ class Executor(object):
                                  base.scope_in_names, base.scope_out_names,
                                  base.feed_names, base.fetch_names)
             self._cache[key] = compiled
+        elif _obs.enabled():
+            _obs.inc('executor.cache_hit_total', kind='multi',
+                     key=_obs.key_id(key))
 
         scope_vals, feed_vals = self._prepare_inputs(
             'Executor.run_steps', program, compiled, scope, feed_vals,
             feed_stack_axis=stacked_feed)
+        if _obs.enabled() and compiled.flops is None:
+            one_feed = {n: v[0] for n, v in feed_vals.items()} \
+                if stacked_feed else feed_vals
+            self._cost_account(compiled, key, scope_vals, one_feed)
         step0 = np.int32(self._step)
         self._step += steps
-        fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
+        if _obs.enabled() and self.last_cache_miss:
+            t0 = time.perf_counter()
+            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
+            _obs.record('executor.first_dispatch_seconds',
+                        time.perf_counter() - t0, kind='multi',
+                        key=_obs.key_id(key))
+        else:
+            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
         for name, value in new_scope.items():
             scope.set(name, value)
         if return_numpy:
@@ -339,6 +381,49 @@ class Executor(object):
         return list(fetches)
 
     # -------------------------------------------------------------- helpers
+    def _observed_compile(self, kind, key, compile_fn):
+        """Trace/prune/compile with telemetry: cache-miss counter, a
+        span, and per-key trace seconds. The XLA compile itself happens
+        lazily at the first dispatch (and is separately accounted by
+        _cost_account's AOT probe when observability is on)."""
+        if not _obs.enabled():
+            return compile_fn()
+        kid = _obs.key_id(key)
+        _obs.inc('executor.cache_miss_total', kind=kind, key=kid)
+        t0 = time.perf_counter()
+        with _obs.span('executor.trace', kind=kind, key=kid):
+            compiled = compile_fn()
+        _obs.record('executor.trace_seconds',
+                    time.perf_counter() - t0, kind=kind, key=kid)
+        return compiled
+
+    def _cost_account(self, compiled, key, scope_vals, feed_vals):
+        """Best-effort per-step FLOPs via an AOT compile of the un-donated
+        step fn + XLA cost_analysis (observe-enabled runs only; one extra
+        compile per cache miss — PADDLE_TPU_OBSERVE_COST=0 opts out).
+        Also the honest 'executor.compile_seconds' measurement: whole-
+        program XLA compile time per (program, shapes) key."""
+        if os.environ.get('PADDLE_TPU_OBSERVE_COST') == '0':
+            compiled.flops = 0.0
+            return
+        import jax
+        kid = _obs.key_id(key)
+        try:
+            t0 = time.perf_counter()
+            with _obs.span('executor.xla_compile', key=kid):
+                exe = jax.jit(compiled.raw_fn).lower(
+                    scope_vals, feed_vals, np.int32(0)).compile()
+            dt = time.perf_counter() - t0
+            _obs.record('executor.compile_seconds', dt, key=kid)
+            _obs.overhead('compile', dt)
+            compiled.flops = _obs.cost_analysis_flops(exe) or 0.0
+        except Exception:
+            compiled.flops = 0.0   # tried; never retry per key
+        if compiled.flops:
+            _obs.set_gauge('executor.step_flops', compiled.flops)
+            _obs.set_gauge('executor.step_flops_by_key', compiled.flops,
+                           key=kid)
+
     def _normalize_feed(self, block, feed):
         """Normalize feed values to arrays with the declared
         (canonicalized) dtype. Values already on device (jax Arrays) are
@@ -406,6 +491,9 @@ class Executor(object):
         all_ops = list(block.ops)
         reads_cache = {}  # amortizes the sub-block walk across the 3 passes
         ops = _prune_ops(block, all_ops, fetch_names, reads_cache)
+        if _obs.enabled():
+            _obs.inc('executor.ops_pruned_total', len(all_ops) - len(ops))
+            _obs.inc('executor.ops_lowered_total', len(ops))
 
         # Data vars actually consumed must be fed.
         consumed = set()

@@ -22,6 +22,8 @@ poisoned.
 
 import numpy as np
 
+from .. import observe as _obs
+
 __all__ = ['NAN_POLICIES', 'BadStepError', 'BadStepGuard', 'is_bad']
 
 NAN_POLICIES = ('raise', 'skip_step', 'rollback')
@@ -84,12 +86,17 @@ class BadStepGuard(object):
             self._consecutive = 0
             return 'ok'
         self._consecutive += 1
+        _obs.inc('fault.bad_steps_total')
         head = ('non-finite loss at global step %d (%r)'
                 % (step, np.asarray(loss).ravel()[:4].tolist()))
         if self.policy == 'raise':
+            _obs.inc('fault.guard_triggers_total', policy='raise',
+                     action='raise')
             raise BadStepError(head + " — nan_policy='raise'",
                                step=step, loss=loss)
         if self._consecutive > self.max_bad_steps:
+            _obs.inc('fault.guard_triggers_total', policy=self.policy,
+                     action='escalate')
             raise BadStepError(
                 head + ' — %d consecutive bad steps exceed max_bad_steps='
                 '%d; the model state itself is likely poisoned'
@@ -101,6 +108,8 @@ class BadStepGuard(object):
                     head + " — nan_policy='skip_step' but no pre-step "
                     'snapshot was taken', step=step, loss=loss)
             self._restore_snapshot()
+            _obs.inc('fault.guard_triggers_total', policy='skip_step',
+                     action='skipped')
             return 'skipped'
         # rollback
         meta = None
@@ -110,4 +119,6 @@ class BadStepGuard(object):
             raise BadStepError(
                 head + " — nan_policy='rollback' but no complete "
                 'checkpoint exists to roll back to', step=step, loss=loss)
+        _obs.inc('fault.guard_triggers_total', policy='rollback',
+                 action='rolled_back')
         return 'rolled_back'

@@ -23,9 +23,11 @@ import os
 import re
 import shutil
 import threading
+import time
 import warnings
 
 from .. import io as _io
+from .. import observe as _obs
 from . import inject
 
 __all__ = ['CheckpointManager', 'LATEST_FILE', 'STEP_DIR_FMT']
@@ -91,6 +93,8 @@ class CheckpointManager(object):
         in-flight write."""
         self.wait()
         d = self.step_dir(step)
+        t0 = time.monotonic()
+        _obs.inc('fault.checkpoint_saves_total')
         handle = _io.save_checkpoint(
             executor, d, main_program=main_program, step=step,
             reader=reader, trainer_state=trainer_state,
@@ -98,11 +102,16 @@ class CheckpointManager(object):
             async_save=self.config.async_save)
         if handle is None or handle.done():
             self._commit(step, d)
+            _obs.record('fault.checkpoint_save_seconds',
+                        time.monotonic() - t0, mode='sync')
             return
         def _finalize():
             try:
                 handle.result()
                 self._commit(step, d)
+                # async latency: save() call to durable commit
+                _obs.record('fault.checkpoint_save_seconds',
+                            time.monotonic() - t0, mode='async')
             except BaseException as e:
                 self._errbox.append(e)
         t = threading.Thread(target=_finalize, daemon=True,
@@ -158,13 +167,18 @@ class CheckpointManager(object):
         exists."""
         for step, path in self._candidates():
             try:
+                t0 = time.monotonic()
                 meta = _io.verify_checkpoint(path)
                 _io.load_checkpoint(
                     executor, path, main_program,
                     reader=reader if (reader is not None and
                                       meta.get('reader')) else None)
+                _obs.record('fault.checkpoint_restore_seconds',
+                            time.monotonic() - t0)
+                _obs.inc('fault.resume_total')
                 return meta
             except Exception as e:
+                _obs.inc('fault.checkpoint_unusable_total')
                 warnings.warn('CheckpointManager: checkpoint %r unusable '
                               '(%s: %s); falling back to the previous one'
                               % (path, type(e).__name__, e))

@@ -1,0 +1,290 @@
+"""paddle_tpu.observe — the telemetry subsystem.
+
+Three pieces, one switch:
+
+- a dependency-free metrics registry (labeled counters / gauges /
+  histograms) with a periodic JSONL sink and an end-of-run summary
+  table (`registry.py`),
+- host-side span tracing exported as Chrome-trace/Perfetto JSON,
+  bridged to ``jax.profiler.TraceAnnotation`` so host spans line up
+  with XLA device traces (`spans.py`),
+- MFU/goodput accounting: XLA ``cost_analysis()`` FLOPs vs the chip's
+  peak, and productive-steps-over-total-wall goodput that charges
+  restart/recompile/checkpoint time against the run (`mfu.py`).
+
+Instrumented call sites across the executor, trainer, reader, fault,
+and parallel layers all funnel through the module-level helpers here
+(``inc`` / ``set_gauge`` / ``record`` / ``span``), every one of which
+checks ``enabled()`` first — a module-global read — so with
+observability off a hot loop pays one boolean test per call site and
+nothing else. Turn it on with::
+
+    from paddle_tpu import observe
+    observe.enable(jsonl='run_metrics.jsonl', trace='run_trace.json')
+    ...train...
+    observe.disable()          # final snapshot + trace export
+
+or ``PADDLE_TPU_METRICS_JSONL=... PADDLE_TPU_TRACE_JSON=...`` with
+``observe.enable_from_env()`` (bench.py and tools/onchip_watcher.py do
+exactly this). See docs/observability.md for the metric catalog.
+"""
+
+import atexit
+import contextlib
+import json
+import os
+import time
+import zlib
+
+from .mfu import (GoodputTracker, cost_analysis_flops,  # noqa: F401
+                  device_peak_flops)
+from .registry import Registry
+from .spans import SpanRecorder
+
+__all__ = ['enabled', 'enable', 'enable_from_env', 'disable', 'reset',
+           'registry', 'spans', 'counter', 'gauge', 'histogram', 'inc',
+           'set_gauge', 'add_gauge', 'record', 'get_gauge', 'get_counter',
+           'span', 'key_id', 'flush', 'maybe_flush', 'export_trace',
+           'run_begin', 'step_done', 'overhead', 'goodput',
+           'step_telemetry', 'summary_table', 'snapshot',
+           'device_peak_flops', 'cost_analysis_flops']
+
+_enabled = False          # THE gate: helpers read this module global
+_REG = Registry()
+_SPANS = SpanRecorder()
+_GOODPUT = GoodputTracker()
+_SINK = {'path': None, 'every_secs': 30.0, 'last': 0.0,
+         'trace_path': None}
+_atexit_armed = []
+
+
+# ------------------------------------------------------------- lifecycle
+def enabled():
+    """True when telemetry is on. The disabled fast path everywhere is
+    this one global read."""
+    return _enabled
+
+
+def enable(jsonl=None, trace=None, every_secs=30.0):
+    """Turn telemetry on. `jsonl` appends periodic metric snapshots
+    (one JSON object per line) plus a final ``kind: "summary"`` line on
+    disable()/exit; `trace` writes a Chrome-trace JSON of all recorded
+    spans at the same points. `every_secs` throttles maybe_flush()."""
+    global _enabled
+    _enabled = True
+    if jsonl is not None:
+        _SINK['path'] = jsonl
+    if trace is not None:
+        _SINK['trace_path'] = trace
+    _SINK['every_secs'] = every_secs
+    _SINK['last'] = time.monotonic()
+    if not _atexit_armed:
+        _atexit_armed.append(True)
+        atexit.register(_atexit_flush)
+
+
+def enable_from_env(environ=None):
+    """enable() iff PADDLE_TPU_METRICS_JSONL and/or PADDLE_TPU_TRACE_JSON
+    (or PADDLE_TPU_OBSERVE=1) is set; returns whether telemetry is on."""
+    env = os.environ if environ is None else environ
+    jsonl = env.get('PADDLE_TPU_METRICS_JSONL')
+    trace = env.get('PADDLE_TPU_TRACE_JSON')
+    if jsonl or trace or env.get('PADDLE_TPU_OBSERVE') == '1':
+        enable(jsonl=jsonl, trace=trace)
+    return _enabled
+
+
+def disable():
+    """Final snapshot (kind 'summary') + trace export, then gate off."""
+    global _enabled
+    if _enabled:
+        flush(kind='summary')
+        export_trace()
+    _enabled = False
+
+
+def reset():
+    """Clear every metric, span, and the goodput ledger (sink config and
+    the enabled flag survive). profiler.reset_profiler() calls this."""
+    _REG.clear()
+    _SPANS.clear()
+    _GOODPUT.reset()
+
+
+def _atexit_flush():
+    if _enabled and _SINK['path']:
+        try:
+            flush(kind='summary')
+        except Exception:
+            pass
+    if _enabled and _SINK['trace_path']:
+        try:
+            export_trace()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------- access
+def registry():
+    return _REG
+
+
+def spans():
+    return _SPANS
+
+
+def counter(name, help=''):
+    return _REG.counter(name, help)
+
+
+def gauge(name, help=''):
+    return _REG.gauge(name, help)
+
+
+def histogram(name, help=''):
+    return _REG.histogram(name, help)
+
+
+# ------------------------------------------------- gated helper facade
+# Call sites in hot loops use these: when disabled each is one global
+# read + return.
+def inc(name, n=1, **labels):
+    if _enabled:
+        _REG.counter(name).inc(n, **labels)
+
+
+def set_gauge(name, value, **labels):
+    if _enabled:
+        _REG.gauge(name).set(value, **labels)
+
+
+def add_gauge(name, n, **labels):
+    if _enabled:
+        _REG.gauge(name).add(n, **labels)
+
+
+def record(name, value, **labels):
+    if _enabled:
+        _REG.histogram(name).observe(value, **labels)
+
+
+def get_gauge(name, default=None, **labels):
+    return _REG.gauge(name).value(default=default, **labels)
+
+
+def get_counter(name, **labels):
+    return _REG.counter(name).value(**labels)
+
+
+class _NullCtx(object):
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _SpanCtx(object):
+    __slots__ = ('name', 'attrs', '_sp')
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._sp = _SPANS.begin(self.name, self.attrs or None)
+        return self._sp
+
+    def __exit__(self, *exc):
+        _SPANS.end(self._sp)
+        return False
+
+
+def span(name, **attrs):
+    """Context manager recording one nested host span (and, when jax is
+    loaded, a jax.profiler.TraceAnnotation of the same name). No-op
+    singleton when disabled."""
+    if not _enabled:
+        return _NULL
+    return _SpanCtx(name, attrs)
+
+
+def key_id(key):
+    """Stable 8-hex-digit id for an unwieldy cache key, used as a metric
+    label (full keys embed object ids and shape tuples)."""
+    return '%08x' % (zlib.crc32(repr(key).encode()) & 0xffffffff)
+
+
+# ---------------------------------------------------------------- sink
+def flush(kind='snapshot'):
+    """Write one JSONL snapshot line now (if a sink path is set)."""
+    _SINK['last'] = time.monotonic()
+    path = _SINK['path']
+    if not path:
+        return
+    _GOODPUT.publish(_REG)
+    line = _REG.to_json_line(ts=round(time.time(), 3), kind=kind,
+                             pid=os.getpid())
+    with open(path, 'a') as f:
+        f.write(line + '\n')
+
+
+def maybe_flush():
+    """Time-throttled flush — call freely from step loops."""
+    if not _enabled or not _SINK['path']:
+        return
+    if time.monotonic() - _SINK['last'] >= _SINK['every_secs']:
+        flush()
+
+
+def export_trace(path=None):
+    """Write the Chrome trace JSON (default: the enable(trace=...) path).
+    Returns the path written, or None when there is nowhere to write."""
+    path = path or _SINK['trace_path']
+    if not path:
+        return None
+    return _SPANS.export(path)
+
+
+def summary_table():
+    _GOODPUT.publish(_REG)
+    return _REG.summary_table()
+
+
+def snapshot():
+    _GOODPUT.publish(_REG)
+    return _REG.snapshot()
+
+
+# ---------------------------------------------------------- mfu/goodput
+def run_begin():
+    if _enabled:
+        _GOODPUT.begin()
+
+
+def step_done(seconds, steps=1):
+    if _enabled:
+        _GOODPUT.step(seconds, steps)
+
+
+def overhead(kind, seconds):
+    if _enabled:
+        _GOODPUT.overhead(kind, seconds)
+
+
+def goodput():
+    return _GOODPUT.goodput()
+
+
+def step_telemetry():
+    """Small per-step dict attached to EndStepEvent (cheap reads only):
+    step wall time EMA / throughput / MFU / goodput, where known."""
+    return {
+        'steps_per_sec_ema': get_gauge('trainer.steps_per_sec_ema'),
+        'step_seconds_last': get_gauge('trainer.step_seconds_last'),
+        'mfu': get_gauge('trainer.mfu'),
+        'goodput': _GOODPUT.goodput(),
+    }

@@ -1,0 +1,144 @@
+"""MFU and goodput accounting.
+
+MFU (model FLOPs utilization) = observed FLOPs/s divided by the chip's
+peak FLOPs/s — the lingua franca of TPU perf comparisons. FLOPs come
+from XLA's own ``compiled.cost_analysis()`` of the step program (the
+executor records them per compiled step when observability is on), so
+the number reflects the program the hardware actually ran, not an
+analytic model.
+
+Goodput = productive training seconds / total run wall seconds. Time
+spent compiling, checkpointing, restoring after a restart, or undoing
+bad steps counts AGAINST the run: a job that spends 10% of its wall
+clock recompiling after preemptions has 0.9 goodput no matter how fast
+its steps are.
+"""
+
+import os
+import time
+
+__all__ = ['PEAK_TFLOPS_BF16', 'device_peak_flops', 'cost_analysis_flops',
+           'GoodputTracker']
+
+# bf16 dense peak per chip generation (TFLOP/s per chip). Matmul peak
+# from public TPU specs; override with PADDLE_TPU_PEAK_TFLOPS (or the
+# bench's BENCH_PEAK_TFLOPS) for exotic SKUs.
+PEAK_TFLOPS_BF16 = {
+    'v2': 45.0,
+    'v3': 123.0,
+    'v4': 275.0,
+    'v5e': 197.0,
+    'v5litepod': 197.0,
+    'v5p': 459.0,
+    'v6e': 918.0,
+}
+
+
+def device_peak_flops(device=None):
+    """Peak FLOP/s of `device` (default: jax's first device), or None
+    when unknown (e.g. cpu) and no env override is set."""
+    for var in ('PADDLE_TPU_PEAK_TFLOPS', 'BENCH_PEAK_TFLOPS'):
+        v = os.environ.get(var)
+        if v:
+            return float(v) * 1e12
+    if device is None:
+        import sys
+        jax = sys.modules.get('jax')
+        if jax is None:
+            return None
+        try:
+            devs = jax.devices()
+        except Exception:
+            return None
+        if not devs:
+            return None
+        device = devs[0]
+    kind = (getattr(device, 'device_kind', '') or '').lower()
+    for key, tf in sorted(PEAK_TFLOPS_BF16.items(), key=lambda kv: -len(
+            kv[0])):
+        if key in kind.replace(' ', '').replace('tpu', ''):
+            return tf * 1e12
+    if 'tpu' in kind:
+        return PEAK_TFLOPS_BF16['v5e'] * 1e12  # conservative default
+    return None
+
+
+def cost_analysis_flops(compiled):
+    """FLOPs per execution from an XLA Compiled/cost-analysis result.
+    Accepts a jax Compiled object, a cost-analysis dict, or a list of
+    dicts (jax returns either depending on version). None on failure."""
+    ca = compiled
+    if hasattr(ca, 'cost_analysis'):
+        try:
+            ca = ca.cost_analysis()
+        except Exception:
+            return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get('flops', 0.0) or 0.0)
+    return flops if flops > 0 else None
+
+
+class GoodputTracker(object):
+    """Productive-vs-overhead wall-time ledger for one run.
+
+    begin() anchors the run start; step(seconds) credits productive
+    time; overhead(kind, seconds) debits compile/checkpoint/restore/
+    bad-step time. publish() writes the derived gauges into a metrics
+    registry:
+
+        run.wall_seconds         total wall since begin()
+        run.productive_seconds   sum of credited step time
+        run.productive_steps     number of credited steps
+        run.goodput              productive / wall  (0..1)
+        run.overhead_seconds{kind=...}  per-cause debit
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._productive = 0.0
+        self._steps = 0
+        self._overhead = {}
+
+    def begin(self):
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+
+    @property
+    def started(self):
+        return self._t0 is not None
+
+    def step(self, seconds, steps=1):
+        self.begin()
+        self._productive += float(seconds)
+        self._steps += int(steps)
+
+    def overhead(self, kind, seconds):
+        self.begin()
+        self._overhead[kind] = self._overhead.get(kind, 0.0) + float(
+            seconds)
+
+    def goodput(self):
+        if self._t0 is None:
+            return None
+        wall = time.monotonic() - self._t0
+        if wall <= 0:
+            return None
+        return min(1.0, self._productive / wall)
+
+    def publish(self, registry):
+        if self._t0 is None:
+            return
+        wall = max(time.monotonic() - self._t0, 1e-9)
+        registry.gauge('run.wall_seconds').set(wall)
+        registry.gauge('run.productive_seconds').set(self._productive)
+        registry.gauge('run.productive_steps').set(self._steps)
+        registry.gauge('run.goodput').set(min(1.0, self._productive / wall))
+        g = registry.gauge('run.overhead_seconds')
+        for kind, secs in self._overhead.items():
+            g.set(secs, kind=kind)

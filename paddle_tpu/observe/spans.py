@@ -1,0 +1,124 @@
+"""Host-side span tracing exported as Chrome-trace/Perfetto JSON.
+
+Spans are nested host wall-time intervals (compile, step, feed, fetch,
+checkpoint, barrier...). Each completed span becomes one Chrome-trace
+"complete" event (``ph: "X"`` with ``ts``/``dur`` in microseconds), so
+the file written by export() loads directly in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing, with nesting recovered
+from containment on the (pid, tid) track.
+
+Bridge to device traces: when jax is already loaded, entering a span
+also enters ``jax.profiler.TraceAnnotation(name)``, so the SAME span
+names show up inside an XLA device trace captured with
+``profiler.start_profiler(trace_dir=...)`` — host intervals and device
+ops line up by name in one Perfetto view.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ['SpanRecorder', 'MAX_EVENTS']
+
+# bound memory in unbounded runs: keep the first MAX_EVENTS spans and
+# count the rest (dropped count is recorded in the export metadata)
+MAX_EVENTS = 200000
+
+
+class _Span(object):
+    __slots__ = ('name', 'attrs', 't0', 'ann')
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.ann = None
+
+
+class SpanRecorder(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._dropped = 0
+        self._tls = threading.local()
+        # one zero point for the whole recorder: perf_counter deltas
+        # anchored to an epoch timestamp so ts is meaningful across
+        # threads and aligns with the jax trace clock reasonably well
+        self._epoch0 = time.time() - time.perf_counter()
+
+    # ---------------------------------------------------------- record
+    def begin(self, name, attrs=None, bridge_jax=True):
+        sp = _Span(name, attrs)
+        if bridge_jax:
+            jax = sys.modules.get('jax')
+            if jax is not None:
+                try:
+                    sp.ann = jax.profiler.TraceAnnotation(name)
+                    sp.ann.__enter__()
+                except Exception:
+                    sp.ann = None
+        stack = getattr(self._tls, 'stack', None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(sp)
+        sp.t0 = time.perf_counter()
+        return sp
+
+    def end(self, sp=None):
+        t1 = time.perf_counter()
+        stack = getattr(self._tls, 'stack', None)
+        if not stack:
+            return
+        top = stack.pop()
+        if sp is not None and top is not sp:
+            # mismatched end (generator-based caller): unwind to sp
+            while stack and top is not sp:
+                top = stack.pop()
+        if top.ann is not None:
+            try:
+                top.ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        ev = {'name': top.name, 'ph': 'X', 'pid': os.getpid(),
+              'tid': threading.get_ident(),
+              'ts': (self._epoch0 + top.t0) * 1e6,
+              'dur': (t1 - top.t0) * 1e6}
+        if top.attrs:
+            ev['args'] = top.attrs
+        with self._lock:
+            if len(self._events) < MAX_EVENTS:
+                self._events.append(ev)
+            else:
+                self._dropped += 1
+
+    def depth(self):
+        return len(getattr(self._tls, 'stack', ()) or ())
+
+    # ---------------------------------------------------------- export
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+
+    def chrome_trace(self):
+        """Chrome trace JSON object (dict) of all completed spans."""
+        with self._lock:
+            doc = {'traceEvents': list(self._events),
+                   'displayTimeUnit': 'ms'}
+            if self._dropped:
+                doc['paddle_tpu_dropped_spans'] = self._dropped
+            return doc
+
+    def export(self, path):
+        doc = self.chrome_trace()
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path

@@ -16,6 +16,9 @@ Environment contracts supported (first match wins):
 
 import os
 import threading
+import time
+
+from .. import observe as _obs
 
 __all__ = ['init_distributed', 'is_initialized', 'global_device_mesh',
            'host_local_batch', 'process_index', 'process_count',
@@ -114,8 +117,14 @@ def barrier(tag, timeout=None):
         timeout = float(os.environ.get(
             'PADDLE_TPU_BARRIER_TIMEOUT_SECS', '600'))
     from jax.experimental import multihost_utils
+    # per-tag wait histogram: the straggler detector — a host whose
+    # peers' barrier waits grow is the slow one (observe enabled runs)
+    t0 = time.perf_counter()
     if timeout <= 0:
-        multihost_utils.sync_global_devices(tag)
+        with _obs.span('multihost.barrier', tag=tag):
+            multihost_utils.sync_global_devices(tag)
+        _obs.record('multihost.barrier_wait_seconds',
+                    time.perf_counter() - t0, tag=tag)
         return
     errbox = []
 
@@ -130,13 +139,17 @@ def barrier(tag, timeout=None):
     t = threading.Thread(target=_sync, daemon=True,
                          name='paddle_tpu_barrier')
     t.start()
-    t.join(timeout)
+    with _obs.span('multihost.barrier', tag=tag):
+        t.join(timeout)
     if t.is_alive():
+        _obs.inc('multihost.barrier_timeout_total', tag=tag)
         raise TimeoutError(
             'barrier %r: pod sync did not complete within %.0fs — a peer '
             'host likely died or was preempted mid-checkpoint; restart '
             'the job and resume from the newest complete checkpoint'
             % (tag, timeout))
+    _obs.record('multihost.barrier_wait_seconds',
+                time.perf_counter() - t0, tag=tag)
     if errbox:
         raise errbox[0]
 

@@ -3,22 +3,33 @@ paddle/fluid/platform/profiler.cc).
 
 TPU-native: wraps jax.profiler (XLA trace -> TensorBoard/perfetto) and adds
 host-side per-run wall timing with a sorted summary table, mirroring the
-reference's profiler.start_profiler/stop_profiler/profiler context."""
+reference's profiler.start_profiler/stop_profiler/profiler context.
+
+One timing substrate: record_event stores into the paddle_tpu.observe
+registry (histograms named ``profiler.<event>``), so profiler events
+surface in metrics JSONL snapshots alongside the rest of the telemetry
+and summarize() is just an aggregate over those histograms. The
+``_active`` gate bounds memory: events outside a start/stop_profiler
+window are not recorded at all."""
 
 import contextlib
 import time
 
+from . import observe as _obs
+
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
            'stop_profiler', 'record_event', 'StepTimer']
 
-_events = []
+_EVENT_PREFIX = 'profiler.'
 _active = False
 _trace_dir = None
 
 
 def reset_profiler():
-    global _events
-    _events = []
+    # clears the observe registry (profiler.* histograms included) and
+    # recorded spans — the profiler and the telemetry subsystem share
+    # one substrate, so they reset together
+    _obs.reset()
 
 
 def start_profiler(state='All', tracer_option=None, trace_dir=None):
@@ -67,17 +78,23 @@ def record_event(name):
     try:
         yield
     finally:
-        if _active or True:
-            _events.append((name, time.perf_counter() - t0))
+        # gated on _active: an un-started profiler records nothing
+        # (the old `_active or True` leaked every event into a module
+        # list forever — unbounded growth in long runs)
+        if _active:
+            _obs.registry().histogram(_EVENT_PREFIX + name).observe(
+                time.perf_counter() - t0)
 
 
 def summarize(sorted_key='total'):
-    agg = {}
-    for name, dt in _events:
-        total, count = agg.get(name, (0.0, 0))
-        agg[name] = (total + dt, count + 1)
-    rows = [(name, total, count, total / count)
-            for name, (total, count) in agg.items()]
+    rows = []
+    for h in _obs.registry().metrics(_EVENT_PREFIX):
+        if h.kind != 'histogram':
+            continue
+        count, total = h.aggregate()
+        if count:
+            rows.append((h.name[len(_EVENT_PREFIX):], total, count,
+                         total / count))
     rows.sort(key=lambda r: -r[1])
     lines = ['%-40s %12s %8s %12s' % ('Event', 'Total(s)', 'Calls',
                                       'Avg(s)')]

@@ -5,6 +5,8 @@ import random
 from queue import Queue
 from threading import Thread
 
+from .. import observe as _obs
+
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'prefetch_to_device',
            'firstn', 'xmap_readers', 'cache', 'batch', 'shard', 'retry']
@@ -83,6 +85,10 @@ def buffered(reader, size):
         t.start()
         e = q.get()
         while e is not end:
+            if _obs.enabled():
+                # occupancy AFTER the pop: 0 means the consumer is
+                # starved (the producer is the bottleneck)
+                _obs.set_gauge('reader.buffered_queue_depth', q.qsize())
             yield e
             e = q.get()
     return data_reader
@@ -206,7 +212,9 @@ def retry(reader, tries=3, backoff=0.1, exceptions=(OSError,)):
                 return
             except exceptions:
                 failures += 1
+                _obs.inc('reader.retry_total')
                 if failures >= tries:
+                    _obs.inc('reader.retry_exhausted_total')
                     raise
                 if backoff:
                     time.sleep(backoff * (2 ** (failures - 1)))

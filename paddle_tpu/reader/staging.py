@@ -14,6 +14,8 @@ import ctypes
 
 import numpy as np
 
+from .. import observe as _obs
+
 __all__ = ['staged_superbatch', 'fields_to_device']
 
 
@@ -83,6 +85,15 @@ def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
             raise MemoryError('staging_open failed (%d bytes x %d)'
                               % (total, n_buffers))
         err = _q.Queue()
+        state = {'produced': 0, 'consumed': 0}
+
+        def _ring_gauges():
+            # occupancy: committed windows not yet consumed, 0..n_buffers
+            # (pinned at n_buffers-ish = reader ahead of the device; at 0
+            # = the input pipeline is the bottleneck)
+            _obs.set_gauge('reader.staging_ring_occupancy',
+                           state['produced'] - state['consumed'])
+            _obs.set_gauge('reader.staging_ring_slots', n_buffers)
 
         def produce():
             try:
@@ -112,6 +123,10 @@ def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
                                            arr.ctypes.data, sizes[n])
                     if lib.staging_commit(ring, total):
                         raise RuntimeError('staging_commit failed')
+                    state['produced'] += 1
+                    if _obs.enabled():
+                        _obs.inc('reader.staging_windows_produced_total')
+                        _ring_gauges()
                     batches = []
             except Exception as e:  # surfaced on the consumer side
                 err.put(e)
@@ -144,6 +159,10 @@ def staged_superbatch(reader, steps, feed_names=None, n_buffers=3,
                 window = fields_to_device(fields, target)
                 if lib.staging_release(ring):
                     raise RuntimeError('staging_release failed')
+                state['consumed'] += 1
+                if _obs.enabled():
+                    _obs.inc('reader.staging_windows_consumed_total')
+                    _ring_gauges()
                 yield window
         finally:
             lib.staging_close_ring(ring)

@@ -18,6 +18,7 @@ from .core.place import TPUPlace
 from .core.program import (default_main_program, default_startup_program,
                            program_guard)
 from . import io as _io
+from . import observe as _obs
 from .fault import CheckpointConfig, CheckpointManager
 from .fault import inject as _inject
 from .fault.guards import BadStepGuard
@@ -43,10 +44,20 @@ class BeginStepEvent(object):
 
 
 class EndStepEvent(object):
-    def __init__(self, epoch_id, step_id, metrics):
+    """Step result delivered to the event handler. Beyond the fetched
+    `metrics`, carries `wall_time` (this step's host wall seconds —
+    windowed steps report wall/window) and, when observability is on,
+    `telemetry`: a small dict (steps_per_sec_ema / step_seconds_last /
+    mfu / goodput) so handlers can log throughput without re-timing
+    steps themselves."""
+
+    def __init__(self, epoch_id, step_id, metrics, wall_time=None,
+                 telemetry=None):
         self.epoch = epoch_id
         self.step = step_id
         self.metrics = metrics
+        self.wall_time = wall_time
+        self.telemetry = telemetry
 
 
 class Trainer(object):
@@ -89,6 +100,7 @@ class Trainer(object):
         self._ckpt_reader = None
         self._last_save = time.monotonic()
         self._step = 0
+        self._peak_flops = None   # lazy device_peak_flops() (observe)
 
     def _to_feed(self, data, feeder, feed_order):
         if feeder is not None:
@@ -112,6 +124,7 @@ class Trainer(object):
         per-step."""
         event_handler = event_handler or (lambda e: None)
         _inject.install_from_env()
+        _obs.run_begin()
         from .reader.state import CheckpointableReader
         self._ckpt_reader = (reader if isinstance(reader,
                                                   CheckpointableReader)
@@ -125,9 +138,12 @@ class Trainer(object):
         start_epoch = 0
         resume_step = 0
         if self._ckpt is not None and self.checkpoint_config.resume:
+            t_restore = time.monotonic()
             meta = self._ckpt.restore(self.exe, self.program,
                                       reader=self._ckpt_reader)
             if meta is not None:
+                # restart recovery is run overhead, not training time
+                _obs.overhead('restore', time.monotonic() - t_restore)
                 self._step = int(meta.get('step') or 0)
                 # RNG stream continuity (dropout masks): the executor's
                 # step key counter sits one ahead of the trainer's step
@@ -147,7 +163,11 @@ class Trainer(object):
             window = []
             self._pending = 0
             for data in reader():
+                t_feed = time.perf_counter()
                 feed = self._to_feed(data, feeder, feed_order)
+                if _obs.enabled():
+                    _obs.record('trainer.phase_seconds',
+                                time.perf_counter() - t_feed, phase='feed')
                 if w <= 1:
                     step = self._run_one(epoch, step, feed, event_handler)
                     continue
@@ -180,6 +200,8 @@ class Trainer(object):
         if self._ckpt is not None:
             # completeness point: LATEST/GC of the last async save landed
             self._ckpt.wait()
+        if _obs.enabled():
+            _obs.flush()   # end-of-train snapshot (no-op without a sink)
 
     @staticmethod
     def _feed_sig(feed):
@@ -189,11 +211,14 @@ class Trainer(object):
         """Checkpoint NOW, recording where the loop stands: resume
         restarts at (epoch, epoch_step) with the reader replaying the
         untrained remainder of that epoch."""
-        self._ckpt.save(self.exe, self.program, step=self._step,
-                        reader=self._ckpt_reader,
-                        reader_pending=getattr(self, '_pending', 0),
-                        trainer_state={'epoch': int(epoch),
-                                       'epoch_step': int(epoch_step)})
+        t0 = time.monotonic()
+        with _obs.span('fault.checkpoint_save', step=self._step):
+            self._ckpt.save(self.exe, self.program, step=self._step,
+                            reader=self._ckpt_reader,
+                            reader_pending=getattr(self, '_pending', 0),
+                            trainer_state={'epoch': int(epoch),
+                                           'epoch_step': int(epoch_step)})
+        _obs.overhead('checkpoint', time.monotonic() - t0)
         self._last_save = time.monotonic()
 
     def _maybe_checkpoint(self, epoch, epoch_step):
@@ -214,19 +239,59 @@ class Trainer(object):
         if due:
             self._save_checkpoint(epoch, epoch_step)
 
+    def _record_step(self, wall, compute_s, fetch_s, verdict, steps=1):
+        """Telemetry for one dispatch: phase histograms, throughput EMA,
+        MFU, and the goodput ledger. A dispatch that compiled charges its
+        wall time to overhead (goodput counts recompiles against the
+        run); bad steps likewise."""
+        if not _obs.enabled():
+            return
+        _obs.record('trainer.phase_seconds', compute_s, phase='compute')
+        _obs.record('trainer.phase_seconds', fetch_s, phase='fetch')
+        per_step = wall / steps
+        _obs.record('trainer.step_seconds', per_step)
+        _obs.set_gauge('trainer.step_seconds_last', per_step)
+        rate = steps / wall if wall > 0 else 0.0
+        prev = _obs.get_gauge('trainer.steps_per_sec_ema')
+        _obs.set_gauge('trainer.steps_per_sec_ema',
+                       rate if prev is None else 0.9 * prev + 0.1 * rate)
+        if getattr(self.exe, 'last_cache_miss', False):
+            _obs.overhead('first_dispatch', wall)
+        elif verdict == 'ok':
+            _obs.step_done(wall, steps)
+        else:
+            _obs.overhead('bad_step', wall)
+        flops = _obs.get_gauge('executor.step_flops')
+        if flops:
+            if self._peak_flops is None:
+                self._peak_flops = _obs.device_peak_flops() or 0.0
+            if self._peak_flops:
+                _obs.set_gauge('trainer.mfu', min(
+                    1.0, steps * flops / wall / self._peak_flops))
+        _obs.maybe_flush()
+
     def _run_one(self, epoch, step, feed, event_handler):
         g = self._guard
         if g is not None and g.needs_snapshot:
             g.snapshot()
         event_handler(BeginStepEvent(epoch, step))
-        metrics = self.exe.run(program=self.program, feed=feed,
-                               fetch_list=self.fetches)
+        t0 = time.perf_counter()
+        with _obs.span('trainer.step', step=self._step):
+            fetched = self.exe.run(program=self.program, feed=feed,
+                                   fetch_list=self.fetches,
+                                   return_numpy=False)
+            t_run = time.perf_counter()
+            metrics = [np.asarray(v) for v in fetched]
+        t1 = time.perf_counter()
         self._step += 1
         verdict = g.handle(metrics[0], self._step) if g is not None \
             else 'ok'
         if verdict == 'skipped':
             self._step -= 1     # the update was undone; it never counted
-        event_handler(EndStepEvent(epoch, step, metrics))
+        self._record_step(t1 - t0, t_run - t0, t1 - t_run, verdict)
+        event_handler(EndStepEvent(
+            epoch, step, metrics, wall_time=t1 - t0,
+            telemetry=_obs.step_telemetry() if _obs.enabled() else None))
         if verdict == 'ok':
             # never checkpoint a bad step's state; a skipped/rolled-back
             # step saves nothing and the next good one resumes cadence
@@ -243,10 +308,16 @@ class Trainer(object):
             event_handler(BeginStepEvent(epoch, step0 + i))
         stacked = {name: np.stack([f[name] for f in window])
                    for name in window[0]}
-        metrics = self.exe.run_steps(w, program=self.program,
-                                     feed=stacked,
-                                     fetch_list=self.fetches,
-                                     stacked_feed=True)
+        t0 = time.perf_counter()
+        with _obs.span('trainer.window', steps=w, step0=self._step):
+            fetched = self.exe.run_steps(w, program=self.program,
+                                         feed=stacked,
+                                         fetch_list=self.fetches,
+                                         stacked_feed=True,
+                                         return_numpy=False)
+            t_run = time.perf_counter()
+            metrics = [np.asarray(v) for v in fetched]
+        t1 = time.perf_counter()
         self._step += w
         # a window with ANY bad step is undone as a unit — the steps ran
         # as one device program, so that's also the undo granularity
@@ -254,9 +325,13 @@ class Trainer(object):
             else 'ok'
         if verdict == 'skipped':
             self._step -= w
+        self._record_step(t1 - t0, t_run - t0, t1 - t_run, verdict,
+                          steps=w)
+        telemetry = _obs.step_telemetry() if _obs.enabled() else None
         for i in range(w):
             event_handler(EndStepEvent(
-                epoch, step0 + i, [np.asarray(m[i]) for m in metrics]))
+                epoch, step0 + i, [np.asarray(m[i]) for m in metrics],
+                wall_time=(t1 - t0) / w, telemetry=telemetry))
         if verdict == 'ok':
             self._maybe_checkpoint(epoch, step0 + w)
         _inject.fire('step_end', step=self._step)

@@ -1,0 +1,154 @@
+"""Summarize a paddle_tpu.observe metrics JSONL.
+
+Reads the snapshot/summary lines written by ``observe.enable(jsonl=...)``
+(one JSON object per line; bench.py and tools/onchip_watcher.py children
+append here, pid-tagged) and prints a human summary: p50/p95/max per
+histogram, final counter/gauge values, and the MFU/goodput headline.
+
+    python tools/metrics_report.py ONCHIP_r05_metrics.jsonl
+    python tools/metrics_report.py run.jsonl --json | jq .mfu
+
+By default the newest ``kind: "summary"`` line is reported (the
+end-of-run state); ``--all-pids`` reports the newest summary per pid,
+``--snapshot`` takes the newest line of any kind. ``--json`` emits one
+machine-readable object for scripting — a fast test exercises both
+paths so this tool cannot bit-rot.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_records(path):
+    """Parse records, skipping torn lines (concurrent appenders)."""
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def pick(records, any_kind=False):
+    """Newest summary record (fallback: newest of any kind)."""
+    if not any_kind:
+        summaries = [r for r in records if r.get('kind') == 'summary']
+        if summaries:
+            return summaries[-1]
+    return records[-1] if records else None
+
+
+def derive(rec):
+    """Flat scripting-friendly view of one record."""
+    gauges = rec.get('gauges', {})
+    out = {
+        'ts': rec.get('ts'),
+        'pid': rec.get('pid'),
+        'kind': rec.get('kind'),
+        'counters': rec.get('counters', {}),
+        'gauges': gauges,
+        'histograms': rec.get('histograms', {}),
+        'mfu': gauges.get('trainer.mfu'),
+        'goodput': gauges.get('run.goodput'),
+        'step_flops': gauges.get('executor.step_flops'),
+        'steps_per_sec_ema': gauges.get('trainer.steps_per_sec_ema'),
+    }
+    return out
+
+
+def _fmt_val(v):
+    if isinstance(v, float):
+        return '%.6g' % v
+    return str(v)
+
+
+def render(rec):
+    lines = []
+    d = derive(rec)
+    head = []
+    if d['mfu'] is not None:
+        head.append('MFU %.2f%%' % (100.0 * d['mfu']))
+    if d['goodput'] is not None:
+        head.append('goodput %.2f%%' % (100.0 * d['goodput']))
+    if d['steps_per_sec_ema'] is not None:
+        head.append('%.4g steps/s' % d['steps_per_sec_ema'])
+    if d['step_flops'] is not None:
+        head.append('%.4g FLOPs/step' % d['step_flops'])
+    lines.append('== %s (pid %s, ts %s) %s' % (
+        d['kind'] or 'record', d['pid'], d['ts'],
+        ('— ' + ', '.join(head)) if head else ''))
+    hists = d['histograms']
+    if hists:
+        lines.append('%-52s %8s %12s %12s %12s'
+                     % ('Histogram', 'Count', 'P50', 'P95', 'Max'))
+        for name in sorted(hists):
+            st = hists[name]
+            lines.append('%-52s %8d %12.6g %12.6g %12.6g'
+                         % (name, st.get('count', 0),
+                            st.get('p50') or 0.0, st.get('p95') or 0.0,
+                            st.get('max') or 0.0))
+    if d['gauges']:
+        lines.append('%-52s %14s' % ('Gauge', 'Value'))
+        for name in sorted(d['gauges']):
+            lines.append('%-52s %14s' % (name, _fmt_val(d['gauges'][name])))
+    if d['counters']:
+        lines.append('%-52s %14s' % ('Counter', 'Value'))
+        for name in sorted(d['counters']):
+            lines.append('%-52s %14s'
+                         % (name, _fmt_val(d['counters'][name])))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Summarize a paddle_tpu.observe metrics JSONL.')
+    p.add_argument('path', help='metrics JSONL file')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON object')
+    p.add_argument('--snapshot', action='store_true',
+                   help='use the newest record of any kind, not just '
+                        'the newest end-of-run summary')
+    p.add_argument('--all-pids', action='store_true',
+                   help='report the newest record per pid (multi-child '
+                        'bench runs)')
+    args = p.parse_args(argv)
+
+    records = load_records(args.path)
+    if not records:
+        sys.stderr.write('metrics_report: no parseable records in %s\n'
+                         % args.path)
+        return 1
+    if args.all_pids:
+        by_pid = {}
+        for r in records:
+            if args.snapshot or r.get('kind') == 'summary':
+                by_pid[r.get('pid')] = r
+        chosen = [by_pid[k] for k in sorted(by_pid, key=str)] \
+            or [records[-1]]
+    else:
+        chosen = [pick(records, any_kind=args.snapshot)]
+
+    try:
+        if args.json:
+            docs = [derive(r) for r in chosen]
+            print(json.dumps(docs[0] if len(docs) == 1 else docs))
+        else:
+            print('\n\n'.join(render(r) for r in chosen))
+    except BrokenPipeError:      # `... | head` is a normal way to use this
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -111,6 +111,11 @@ def main():
     # halfway through compiling finishes instantly on the driver's run
     os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
                           '/tmp/paddle_tpu_jax_cache')
+    # telemetry: every workload child (bench._run_workload subprocess)
+    # enables paddle_tpu.observe and appends pid-tagged snapshots to the
+    # shared metrics JSONL beside the results store
+    os.environ.setdefault('PADDLE_TPU_METRICS_JSONL',
+                          bench._metrics_path())
     attempts = {k: 0 for k, *_ in QUEUE + TOOL_QUEUE}
     done = set(bench.store_load())  # resumable: ok records are final
 
