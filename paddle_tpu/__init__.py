@@ -36,6 +36,7 @@ from . import trainer  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from . import fault  # noqa: F401
 from .fault import CheckpointConfig  # noqa: F401
+from . import serving  # noqa: F401
 from . import memory_optimize as _memory_optimize_mod  # noqa: F401
 from .memory_optimize import memory_optimize, release_memory  # noqa: F401
 from .core.errors import EnforceError, enforce  # noqa: F401

@@ -11,6 +11,7 @@ run with zero host round-trips beyond feed/fetch.
 """
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -197,9 +198,66 @@ class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace(0)
         self._cache = {}
+        # Serving runs this executor from concurrent threads: _lock
+        # guards the compile cache, the per-key compile locks, and the
+        # global step counter; last_cache_miss is per-thread so one
+        # thread's hit can't mask another thread's miss.
+        self._lock = threading.Lock()
+        self._compile_locks = {}
+        # The step fn DONATES its scope inputs (param buffers alias
+        # outputs); two concurrent dispatches on one scope would hand
+        # the second a deleted buffer. Dispatch + scope write-back is
+        # therefore one critical section; traces/compiles of distinct
+        # keys still run concurrently.
+        self._dispatch_lock = threading.Lock()
+        self._tls = threading.local()
         self._step = 0
         from .platform_boot import arm_compile_cache
         arm_compile_cache()
+
+    @property
+    def last_cache_miss(self):
+        """Whether THIS thread's most recent run()/run_steps() call
+        missed the compile cache (thread-local: concurrent serving
+        threads each see their own answer)."""
+        return getattr(self._tls, 'last_cache_miss', False)
+
+    @last_cache_miss.setter
+    def last_cache_miss(self, value):
+        self._tls.last_cache_miss = value
+
+    def _next_steps(self, n):
+        """Atomically claim n global step indices (dropout keys fold
+        the step index; two threads must never share one)."""
+        with self._lock:
+            step0 = self._step
+            self._step += n
+        return np.int32(step0)
+
+    def _lookup_or_compile(self, kind, key, use_cache, compile_fn):
+        """Compile-cache access, safe under concurrent serving threads:
+        a hit is one locked dict read; a miss takes a per-key lock so
+        two threads racing on the same (program, shapes) signature
+        compile ONCE — the loser blocks, then reads the winner's entry
+        as a hit. Distinct keys still compile concurrently. Returns
+        (compiled, missed)."""
+        if not use_cache:
+            return self._observed_compile(kind, key, compile_fn), True
+        with self._lock:
+            compiled = self._cache.get(key)
+            if compiled is not None:
+                return compiled, False
+            key_lock = self._compile_locks.setdefault(key,
+                                                      threading.Lock())
+        with key_lock:
+            with self._lock:
+                compiled = self._cache.get(key)
+            if compiled is not None:
+                return compiled, False
+            compiled = self._observed_compile(kind, key, compile_fn)
+            with self._lock:
+                self._cache[key] = compiled
+        return compiled, True
 
     # ------------------------------------------------------------------ run
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -221,40 +279,38 @@ class Executor(object):
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names))
-        compiled = self._cache.get(key) if use_program_cache else None
-        self.last_cache_miss = compiled is None
-        if compiled is None:
-            compiled = self._observed_compile(
-                'single', key,
-                lambda: self._compile(program, sorted(feed_vals),
-                                      fetch_names))
-            if use_program_cache:
-                self._cache[key] = compiled
-        elif _obs.enabled():
+        compiled, missed = self._lookup_or_compile(
+            'single', key, use_program_cache,
+            lambda: self._compile(program, sorted(feed_vals),
+                                  fetch_names))
+        self.last_cache_miss = missed
+        if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='single',
                      key=_obs.key_id(key))
 
-        scope_vals, feed_vals = self._prepare_inputs(
-            'Executor.run', program, compiled, scope, feed_vals)
-        if _obs.enabled() and compiled.flops is None:
-            self._cost_account(compiled, key, scope_vals, feed_vals)
+        with self._dispatch_lock:
+            scope_vals, feed_vals = self._prepare_inputs(
+                'Executor.run', program, compiled, scope, feed_vals)
+            if _obs.enabled() and compiled.flops is None:
+                self._cost_account(compiled, key, scope_vals, feed_vals)
 
-        step_i = np.int32(self._step)
-        self._step += 1
-        if _obs.enabled() and self.last_cache_miss:
-            # first dispatch of this key = XLA compile + one step; a
-            # near-free compile-time signal even when the AOT cost
-            # probe is off (PADDLE_TPU_OBSERVE_COST=0)
-            t0 = time.perf_counter()
-            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step_i)
-            _obs.record('executor.first_dispatch_seconds',
-                        time.perf_counter() - t0, kind='single',
-                        key=_obs.key_id(key))
-        else:
-            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step_i)
+            step_i = self._next_steps(1)
+            if _obs.enabled() and self.last_cache_miss:
+                # first dispatch of this key = XLA compile + one step; a
+                # near-free compile-time signal even when the AOT cost
+                # probe is off (PADDLE_TPU_OBSERVE_COST=0)
+                t0 = time.perf_counter()
+                fetches, new_scope = compiled.fn(scope_vals, feed_vals,
+                                                 step_i)
+                _obs.record('executor.first_dispatch_seconds',
+                            time.perf_counter() - t0, kind='single',
+                            key=_obs.key_id(key))
+            else:
+                fetches, new_scope = compiled.fn(scope_vals, feed_vals,
+                                                 step_i)
 
-        for name, value in new_scope.items():
-            scope.set(name, value)
+            for name, value in new_scope.items():
+                scope.set(name, value)
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]
@@ -308,13 +364,9 @@ class Executor(object):
         key = ('multi', id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names),
                steps, stacked_feed)
-        compiled = self._cache.get(key)
-        self.last_cache_miss = compiled is None
-        if compiled is None:
-            base = self._observed_compile(
-                'multi', key,
-                lambda: self._compile(program, sorted(feed_vals),
-                                      fetch_names))
+
+        def _build_multi():
+            base = self._compile(program, sorted(feed_vals), fetch_names)
 
             # state that is read each step chains through the scan carry;
             # written-only persistables (no reader) are ALSO carried —
@@ -349,33 +401,38 @@ class Executor(object):
                 return stacked, final_scope
 
             jit_multi = jax.jit(multi_fn, donate_argnums=(0,))
-            compiled = _Compiled(jit_multi, base.raw_fn,
-                                 base.scope_in_names, base.scope_out_names,
-                                 base.feed_names, base.fetch_names)
-            self._cache[key] = compiled
-        elif _obs.enabled():
+            return _Compiled(jit_multi, base.raw_fn,
+                             base.scope_in_names, base.scope_out_names,
+                             base.feed_names, base.fetch_names)
+
+        compiled, missed = self._lookup_or_compile(
+            'multi', key, True, _build_multi)
+        self.last_cache_miss = missed
+        if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='multi',
                      key=_obs.key_id(key))
 
-        scope_vals, feed_vals = self._prepare_inputs(
-            'Executor.run_steps', program, compiled, scope, feed_vals,
-            feed_stack_axis=stacked_feed)
-        if _obs.enabled() and compiled.flops is None:
-            one_feed = {n: v[0] for n, v in feed_vals.items()} \
-                if stacked_feed else feed_vals
-            self._cost_account(compiled, key, scope_vals, one_feed)
-        step0 = np.int32(self._step)
-        self._step += steps
-        if _obs.enabled() and self.last_cache_miss:
-            t0 = time.perf_counter()
-            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
-            _obs.record('executor.first_dispatch_seconds',
-                        time.perf_counter() - t0, kind='multi',
-                        key=_obs.key_id(key))
-        else:
-            fetches, new_scope = compiled.fn(scope_vals, feed_vals, step0)
-        for name, value in new_scope.items():
-            scope.set(name, value)
+        with self._dispatch_lock:
+            scope_vals, feed_vals = self._prepare_inputs(
+                'Executor.run_steps', program, compiled, scope, feed_vals,
+                feed_stack_axis=stacked_feed)
+            if _obs.enabled() and compiled.flops is None:
+                one_feed = {n: v[0] for n, v in feed_vals.items()} \
+                    if stacked_feed else feed_vals
+                self._cost_account(compiled, key, scope_vals, one_feed)
+            step0 = self._next_steps(steps)
+            if _obs.enabled() and self.last_cache_miss:
+                t0 = time.perf_counter()
+                fetches, new_scope = compiled.fn(scope_vals, feed_vals,
+                                                 step0)
+                _obs.record('executor.first_dispatch_seconds',
+                            time.perf_counter() - t0, kind='multi',
+                            key=_obs.key_id(key))
+            else:
+                fetches, new_scope = compiled.fn(scope_vals, feed_vals,
+                                                 step0)
+            for name, value in new_scope.items():
+                scope.set(name, value)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

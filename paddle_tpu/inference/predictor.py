@@ -27,12 +27,28 @@ class Predictor(object):
             self.program.amp = 'bf16'
         self._compiled = {}
 
+    def feed_specs(self):
+        """{feed name: (shape, dtype)} for the model's declared inputs;
+        shape uses -1 for unbound (batch/sequence) dims. Serving warmup
+        synthesizes bucket-shaped feeds from this."""
+        block = self.program.global_block()
+        out = {}
+        for name in self.feed_names:
+            var = block.var(name)
+            out[name] = (tuple(var.shape), var.dtype)
+        return out
+
     def predict(self, feed):
         """feed: dict name -> array. Returns list of numpy arrays."""
         fluid = self._fluid
         missing = [n for n in self.feed_names if n not in feed]
         if missing:
             raise ValueError('predict: missing feeds %s' % missing)
+        unknown = sorted(n for n in feed if n not in self.feed_names)
+        if unknown:
+            raise ValueError(
+                'predict: unexpected feed names %s — this model feeds %s'
+                % (unknown, list(self.feed_names)))
         with fluid.scope_guard(self.scope):
             return self.exe.run(program=self.program, feed=feed,
                                 fetch_list=self.fetch_targets,

@@ -220,9 +220,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                         for v in target_vars],
         'program': program_to_dict(inference_program),
     }
-    with open(os.path.join(dirname,
-                           model_filename or '__model__.json'), 'w') as f:
-        json.dump(meta, f)
+    # atomic like every other artifact (fault's unique-tmp + rename
+    # convention): a crash mid-dump must not leave a torn __model__.json
+    # that load_inference_model parses as corrupt
+    _write_atomic(os.path.join(dirname, model_filename or '__model__.json'),
+                  lambda f: f.write(json.dumps(meta).encode()))
     save_persistables(executor, dirname, main_program,
                       filename=params_filename)
     return inference_program

@@ -1,0 +1,15 @@
+"""paddle_tpu.serving — online inference engine.
+
+Dynamic micro-batching + shape buckets + AOT warmup over the
+`inference.Predictor`: bounded request queue with typed backpressure
+(`QueueFullError`), a batcher thread assembling micro-batches under a
+`batch_timeout_ms` deadline, padding up a fixed `BucketLadder` so the
+set of XLA signatures is bounded and precompilable (`warmup()`), and
+full `observe` wiring (queue depth, batch size, padding waste,
+queue/batch/compute latency). See docs/serving.md; load-test with
+tools/serving_bench.py.
+"""
+
+from .buckets import BatchInfo, BucketLadder, pow2_ladder  # noqa: F401
+from .engine import (EngineClosedError, QueueFullError,  # noqa: F401
+                     ServingEngine)

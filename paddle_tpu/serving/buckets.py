@@ -1,0 +1,241 @@
+"""Shape buckets for online serving.
+
+Every distinct feed signature compiles a fresh XLA executable (one
+compile-cache key per (program, shapes) pair — core/executor.py), so
+unconstrained request shapes mean unbounded compiles under live
+traffic. The ladder bounds the signature set: the batch dimension of
+every feed pads UP a fixed rung list (powers of two through
+``max_batch_size`` by default), and optionally per-feed sequence axes
+pad up a ``seq_lens`` ladder. The signature set is then
+``len(batch_sizes) × len(seq_lens or [1])`` — small, known ahead of
+time, and enumerable for AOT warmup (`ServingEngine.warmup`).
+
+Padding policy: ``pad='edge'`` (default) replicates the last real
+slice, so padding rows stay in-distribution — an all-zero row can NaN
+a log/softmax path — and ``pad='zero'`` pads with zeros for models
+that consume an explicit validity mask. Results are un-padded before
+they reach the caller either way, so padded values never surface.
+"""
+
+import numpy as np
+
+__all__ = ['BucketLadder', 'BatchInfo', 'pow2_ladder']
+
+
+def pow2_ladder(hi, lo=1):
+    """Powers of two from `lo` up through `hi`; `hi` itself caps the
+    ladder when it is not a power of two (the top rung must admit a
+    full batch)."""
+    hi, lo = int(hi), int(lo)
+    if lo < 1 or hi < lo:
+        raise ValueError('pow2_ladder: need 1 <= lo <= hi, got '
+                         'lo=%d hi=%d' % (lo, hi))
+    rungs = []
+    r = 1
+    while r < lo:
+        r *= 2
+    while r < hi:
+        rungs.append(r)
+        r *= 2
+    rungs.append(hi)
+    return rungs
+
+
+def _pad_axis(arr, axis, target, mode):
+    cur = arr.shape[axis]
+    if cur == target:
+        return arr
+    if cur > target:
+        raise ValueError('cannot pad axis %d from %d down to %d'
+                         % (axis, cur, target))
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - cur)
+    if mode == 'zero':
+        return np.pad(arr, widths)
+    return np.pad(arr, widths, mode='edge')
+
+
+class BatchInfo(object):
+    """Assembly record for one padded micro-batch: enough to un-pad the
+    results and to build validity masks."""
+
+    __slots__ = ('sizes', 'total', 'batch_bucket', 'seq_sizes',
+                 'seq_bucket')
+
+    def __init__(self, sizes, batch_bucket, seq_sizes=None,
+                 seq_bucket=None):
+        self.sizes = list(sizes)          # real rows per request
+        self.total = sum(self.sizes)
+        self.batch_bucket = batch_bucket  # padded leading dim
+        self.seq_sizes = seq_sizes        # real seq len per request
+        self.seq_bucket = seq_bucket      # padded seq dim (or None)
+
+    def waste(self):
+        """Fraction of dispatched elements that are padding (batch ×
+        seq when sequence bucketing is on) — the padding-waste
+        histogram's unit."""
+        if self.seq_bucket is None:
+            return 1.0 - float(self.total) / self.batch_bucket
+        real = sum(n * t for n, t in zip(self.sizes, self.seq_sizes))
+        return 1.0 - float(real) / (self.batch_bucket * self.seq_bucket)
+
+    def batch_mask(self, dtype='float32'):
+        """[batch_bucket] — 1 for real rows, 0 for padding."""
+        m = np.zeros((self.batch_bucket,), dtype=dtype)
+        m[:self.total] = 1
+        return m
+
+    def token_mask(self, dtype='float32'):
+        """[batch_bucket, seq_bucket] — 1 for real (row, position)
+        pairs. Requires sequence bucketing."""
+        if self.seq_bucket is None:
+            raise ValueError('token_mask: no sequence bucketing '
+                             'configured on this ladder')
+        m = np.zeros((self.batch_bucket, self.seq_bucket), dtype=dtype)
+        row = 0
+        for n, t in zip(self.sizes, self.seq_sizes):
+            m[row:row + n, :t] = 1
+            row += n
+        return m
+
+
+class BucketLadder(object):
+    """Pads request micro-batches up a fixed shape ladder.
+
+    The batch dimension (axis 0 of every feed) pads up `batch_sizes`;
+    optionally, per-feed sequence axes (``seq_axes={'ids': 1}``) pad up
+    `seq_lens` — every feed in one micro-batch lands on the same
+    (batch rung, seq rung) pair, so the executor sees exactly one
+    compile-cache key per rung pair.
+    """
+
+    def __init__(self, max_batch_size, batch_sizes=None, seq_axes=None,
+                 seq_lens=None, pad='edge'):
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError('max_batch_size must be >= 1')
+        self.batch_sizes = sorted(set(int(b) for b in batch_sizes)) \
+            if batch_sizes else pow2_ladder(self.max_batch_size)
+        if self.batch_sizes[-1] != self.max_batch_size:
+            raise ValueError(
+                'batch_sizes top rung %d != max_batch_size %d'
+                % (self.batch_sizes[-1], self.max_batch_size))
+        self.seq_axes = dict(seq_axes or {})
+        self.seq_lens = sorted(set(int(t) for t in seq_lens)) \
+            if seq_lens else None
+        if self.seq_axes and not self.seq_lens:
+            raise ValueError('seq_axes given without a seq_lens ladder')
+        if pad not in ('edge', 'zero'):
+            raise ValueError("pad must be 'edge' or 'zero', got %r" % pad)
+        self.pad = pad
+
+    # ------------------------------------------------------------ rungs
+    def bucket_batch(self, n):
+        """Smallest batch rung >= n."""
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        raise ValueError('batch of %d rows exceeds the top bucket %d'
+                         % (n, self.batch_sizes[-1]))
+
+    def bucket_seq(self, t):
+        """Smallest seq rung >= t."""
+        for s in self.seq_lens:
+            if t <= s:
+                return s
+        raise ValueError('sequence length %d exceeds the top seq '
+                         'bucket %d' % (t, self.seq_lens[-1]))
+
+    def signatures(self):
+        """Every (batch rung, seq rung or None) pair — the complete,
+        bounded set of XLA signatures live traffic can produce; warmup
+        compiles exactly these."""
+        if not self.seq_lens:
+            return [(b, None) for b in self.batch_sizes]
+        return [(b, s) for b in self.batch_sizes for s in self.seq_lens]
+
+    # --------------------------------------------------------- assemble
+    @staticmethod
+    def rows_of(feed):
+        """Leading-dim row count of one request's feed dict (validated
+        consistent across its arrays)."""
+        rows = None
+        for name, value in feed.items():
+            arr = np.asarray(value)
+            if arr.ndim == 0:
+                raise ValueError('feed %r is a scalar — serving feeds '
+                                 'need a leading batch axis' % name)
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise ValueError(
+                    'inconsistent leading dims in one request: %r has '
+                    '%d rows, expected %d' % (name, arr.shape[0], rows))
+        if rows is None:
+            raise ValueError('empty feed dict')
+        return rows
+
+    def _seq_len_of(self, feed):
+        return max(np.asarray(feed[name]).shape[axis]
+                   for name, axis in self.seq_axes.items())
+
+    def assemble(self, feeds):
+        """Pack per-request feed dicts into ONE padded micro-batch.
+
+        feeds: list of {name: array} with a shared leading batch axis
+        per request. Returns ``(padded_feed, info)``; run the padded
+        feed through the model, then `disassemble` the fetches with the
+        same `info`.
+        """
+        if not feeds:
+            raise ValueError('assemble: no requests')
+        names = sorted(feeds[0])
+        for f in feeds[1:]:
+            if sorted(f) != names:
+                raise ValueError('requests in one batch disagree on '
+                                 'feed names: %s vs %s'
+                                 % (sorted(f), names))
+        sizes = [self.rows_of(f) for f in feeds]
+        bucket = self.bucket_batch(sum(sizes))
+        seq_sizes = seq_bucket = None
+        if self.seq_axes:
+            seq_sizes = [self._seq_len_of(f) for f in feeds]
+            seq_bucket = self.bucket_seq(max(seq_sizes))
+        info = BatchInfo(sizes, bucket, seq_sizes, seq_bucket)
+        padded = {}
+        for name in names:
+            parts = []
+            for f in feeds:
+                arr = np.asarray(f[name])
+                if name in self.seq_axes:
+                    arr = _pad_axis(arr, self.seq_axes[name], seq_bucket,
+                                    self.pad)
+                parts.append(arr)
+            cat = parts[0] if len(parts) == 1 else \
+                np.concatenate(parts, axis=0)
+            padded[name] = _pad_axis(cat, 0, bucket, self.pad)
+        return padded, info
+
+    def disassemble(self, fetches, info, fetch_seq_axes=None):
+        """Split padded fetch arrays back into per-request results.
+
+        fetches: list of arrays with the padded batch leading dim.
+        fetch_seq_axes: optional {fetch index: axis} naming which fetch
+        axes carry the padded sequence dim, so each request gets its
+        real length back. Returns one list of fetch arrays per request.
+        """
+        fetch_seq_axes = fetch_seq_axes or {}
+        per_request = [[] for _ in info.sizes]
+        for j, arr in enumerate(fetches):
+            arr = np.asarray(arr)
+            row = 0
+            for i, n in enumerate(info.sizes):
+                part = arr[row:row + n]
+                row += n
+                axis = fetch_seq_axes.get(j)
+                if axis is not None and info.seq_sizes is not None:
+                    sl = [slice(None)] * part.ndim
+                    sl[axis] = slice(0, info.seq_sizes[i])
+                    part = part[tuple(sl)]
+                per_request[i].append(part)
+        return per_request

@@ -1,0 +1,289 @@
+"""Load generator for paddle_tpu.serving — closed- and open-loop.
+
+Drives a `ServingEngine` (tiny built-in MLP by default, or any
+`save_inference_model` directory via --model-dir) and reports
+p50/p95/p99 request latency plus throughput:
+
+    python tools/serving_bench.py --duration 2 --clients 8
+    python tools/serving_bench.py --mode open --qps 500 --duration 5
+    python tools/serving_bench.py --json | jq .latency_ms.p99
+
+closed loop: `--clients` threads each keep exactly one request in
+flight (latency under a fixed concurrency); open loop: one pacer
+submits at `--qps` regardless of completions (latency under offered
+load — overload shows up as `requests_rejected` growing, the
+QueueFullError backpressure path). Request batch sizes are sampled
+uniformly from [--rows-lo, --rows-hi].
+
+Metrics land in the standard observe pipeline: pass --metrics-jsonl
+(or set PADDLE_TPU_METRICS_JSONL) and read the run afterwards with
+tools/metrics_report.py. --json emits one machine-readable object on
+stdout — its schema is asserted by tests/test_serving.py so this tool
+cannot rot.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_tiny_model(dirname, in_dim=8, hidden=16, classes=4):
+    """Save the default benchmark model: fc-relu-fc-softmax."""
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
+    h = fluid.layers.fc(input=x, size=hidden, act='relu')
+    out = fluid.layers.fc(input=h, size=classes, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(dirname, ['x'], [out], exe)
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    return dirname
+
+
+class _Stats(object):
+    """Thread-safe request ledger."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.latencies = []
+        self.rows = 0
+        self.ok = 0
+        self.rejected = 0
+        self.errors = 0
+
+    def done(self, seconds, rows):
+        with self.mu:
+            self.latencies.append(seconds)
+            self.ok += 1
+            self.rows += rows
+
+    def reject(self):
+        with self.mu:
+            self.rejected += 1
+
+    def error(self):
+        with self.mu:
+            self.errors += 1
+
+
+def _percentiles(latencies):
+    if not latencies:
+        return {'p50': None, 'p95': None, 'p99': None, 'mean': None,
+                'max': None}
+    arr = np.sort(np.asarray(latencies, dtype=np.float64)) * 1000.0
+    pick = lambda q: float(arr[min(len(arr) - 1, int(q * len(arr)))])  # noqa
+    return {'p50': pick(0.50), 'p95': pick(0.95), 'p99': pick(0.99),
+            'mean': float(arr.mean()), 'max': float(arr[-1])}
+
+
+def _closed_loop(engine, make_feed, stats, deadline, clients):
+    from paddle_tpu.serving import QueueFullError
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while time.perf_counter() < deadline:
+            feed, rows = make_feed(rng)
+            t0 = time.perf_counter()
+            try:
+                engine.predict(feed, timeout=60)
+            except QueueFullError:
+                stats.reject()
+                continue
+            except Exception:
+                stats.error()
+                continue
+            stats.done(time.perf_counter() - t0, rows)
+
+    threads = [threading.Thread(target=client, args=(1000 + i,),
+                                daemon=True) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _open_loop(engine, make_feed, stats, deadline, qps, seed=7):
+    from paddle_tpu.serving import QueueFullError
+    rng = np.random.RandomState(seed)
+    period = 1.0 / qps
+    next_t = time.perf_counter()
+    while time.perf_counter() < deadline:
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        next_t += period * float(rng.exponential(1.0))  # Poisson arrivals
+        feed, rows = make_feed(rng)
+        t0 = time.perf_counter()
+        try:
+            fut = engine.submit(feed)
+        except QueueFullError:
+            stats.reject()
+            continue
+
+        def _cb(f, t0=t0, rows=rows):
+            # latency clocked at resolution (dispatcher thread), not at
+            # a late collection point — open-loop p99 must not include
+            # generator bookkeeping
+            try:
+                f.result()
+                stats.done(time.perf_counter() - t0, rows)
+            except Exception:
+                stats.error()
+        fut.add_done_callback(_cb)
+    # engine.shutdown(drain=True) in main() is the completion barrier
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='paddle_tpu.serving load generator')
+    p.add_argument('--model-dir', default=None,
+                   help='save_inference_model dir (default: build a '
+                        'tiny MLP in a temp dir)')
+    p.add_argument('--mode', choices=['closed', 'open'], default='closed')
+    p.add_argument('--duration', type=float, default=2.0,
+                   help='seconds of load after warmup')
+    p.add_argument('--clients', type=int, default=4,
+                   help='closed-loop concurrent clients')
+    p.add_argument('--qps', type=float, default=200.0,
+                   help='open-loop offered request rate')
+    p.add_argument('--max-batch-size', type=int, default=8)
+    p.add_argument('--batch-timeout-ms', type=float, default=2.0)
+    p.add_argument('--max-queue-depth', type=int, default=64)
+    p.add_argument('--rows-lo', type=int, default=1,
+                   help='min rows per request')
+    p.add_argument('--rows-hi', type=int, default=0,
+                   help='max rows per request (default max-batch-size)')
+    p.add_argument('--no-warmup', action='store_true',
+                   help='skip AOT warmup (shows live-compile cost)')
+    p.add_argument('--metrics-jsonl', default=None,
+                   help='observe JSONL path (or set '
+                        'PADDLE_TPU_METRICS_JSONL)')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON object')
+    args = p.parse_args(argv)
+
+    from paddle_tpu import observe
+    from paddle_tpu.inference import create_predictor
+    from paddle_tpu.serving import ServingEngine
+
+    model_dir = args.model_dir or build_tiny_model(
+        os.path.join(tempfile.mkdtemp(prefix='serving_bench_'), 'model'))
+
+    # counters on AFTER the model build so executor.cache_miss_total
+    # counts serving compiles only — with warmup on, cache_misses ==
+    # warmup signatures is the zero-live-compile invariant the report
+    # (and the smoke test) asserts
+    jsonl = args.metrics_jsonl or os.environ.get(
+        'PADDLE_TPU_METRICS_JSONL')
+    observe.enable(jsonl=jsonl)
+
+    predictor = create_predictor(model_dir)
+    specs = predictor.feed_specs()
+    engine = ServingEngine(predictor,
+                           max_batch_size=args.max_batch_size,
+                           batch_timeout_ms=args.batch_timeout_ms,
+                           max_queue_depth=args.max_queue_depth)
+
+    rows_hi = args.rows_hi or args.max_batch_size
+    feed_shapes = {n: [d for d in shape] for n, (shape, _) in
+                   specs.items()}
+
+    def make_feed(rng):
+        rows = int(rng.randint(args.rows_lo, rows_hi + 1))
+        feed = {}
+        for name, (shape, dtype) in specs.items():
+            dims = [rows] + [int(d) for d in shape[1:]]
+            if any(d < 0 for d in dims[1:]):
+                raise SystemExit(
+                    'serving_bench: feed %r has unbound non-batch dims '
+                    '%s — this generator only drives fixed-shape '
+                    'models' % (name, shape))
+            feed[name] = rng.rand(*dims).astype('float32') \
+                if str(dtype).startswith(('float', 'bfloat')) \
+                else np.zeros(dims, dtype=str(dtype))
+        return feed, rows
+
+    t_w0 = time.perf_counter()
+    signatures = 0 if args.no_warmup else engine.warmup()
+    warmup_s = time.perf_counter() - t_w0
+    engine.start()
+
+    stats = _Stats()
+    t0 = time.perf_counter()
+    deadline = t0 + args.duration
+    if args.mode == 'closed':
+        _closed_loop(engine, make_feed, stats, deadline, args.clients)
+    else:
+        _open_loop(engine, make_feed, stats, deadline, args.qps)
+    engine.shutdown(drain=True)
+    wall = time.perf_counter() - t0
+
+    snap = observe.snapshot()
+    counters = snap['counters']
+    misses = sum(v for k, v in counters.items()
+                 if k.startswith('executor.cache_miss_total'))
+    hits = sum(v for k, v in counters.items()
+               if k.startswith('executor.cache_hit_total'))
+    waste = snap['histograms'].get('serving.padding_waste', {})
+    bsz = snap['histograms'].get('serving.batch_size', {})
+
+    report = {
+        'mode': args.mode,
+        'duration_s': round(wall, 4),
+        'clients': args.clients if args.mode == 'closed' else None,
+        'offered_qps': args.qps if args.mode == 'open' else None,
+        'requests_ok': stats.ok,
+        'requests_rejected': stats.rejected,
+        'requests_errored': stats.errors,
+        'rows': stats.rows,
+        'throughput_rps': round(stats.ok / wall, 2) if wall else None,
+        'throughput_rows_per_s': round(stats.rows / wall, 2)
+        if wall else None,
+        'latency_ms': _percentiles(stats.latencies),
+        'batch_size_mean': bsz.get('mean'),
+        'padding_waste_mean': waste.get('mean'),
+        'warmup': {'signatures': signatures,
+                   'seconds': round(warmup_s, 4)},
+        'executor': {'cache_misses': misses, 'cache_hits': hits},
+        'engine': {'max_batch_size': args.max_batch_size,
+                   'batch_timeout_ms': args.batch_timeout_ms,
+                   'max_queue_depth': args.max_queue_depth,
+                   'buckets': engine._ladder.batch_sizes},
+        'feed_shapes': feed_shapes,
+    }
+    observe.disable()
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        lat = report['latency_ms']
+        print('serving_bench: %s loop, %.2fs' % (args.mode, wall))
+        print('  requests   ok=%d rejected=%d errored=%d (%.1f req/s, '
+              '%.1f rows/s)' % (stats.ok, stats.rejected, stats.errors,
+                                report['throughput_rps'] or 0.0,
+                                report['throughput_rows_per_s'] or 0.0))
+        if lat['p50'] is not None:
+            print('  latency ms p50=%.2f p95=%.2f p99=%.2f mean=%.2f '
+                  'max=%.2f' % (lat['p50'], lat['p95'], lat['p99'],
+                                lat['mean'], lat['max']))
+        print('  batching   mean batch=%.2f rows, mean padding waste='
+              '%.1f%%' % (bsz.get('mean') or 0.0,
+                          100.0 * (waste.get('mean') or 0.0)))
+        print('  compiles   %d warmup signatures in %.2fs; %d total '
+              'misses, %d hits' % (signatures, warmup_s, misses, hits))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
