@@ -350,6 +350,110 @@ def bench_rnn_lstm(batch=128, seq=100, vocab=30000, hidden=128,
     return batch * seq / _time_multi(exe, feed, [loss], iters)
 
 
+def bench_pipeline_ablation(model='transformer', steps=20, batch=None,
+                            seq=64, vocab=32000, image=224,
+                            depths=(1, 2, 4)):
+    """Sync-vs-async trainer loop (ISSUE 4): the same HOST-FED workload
+    through Trainer.train at pipeline_depth 1/2/4. Unlike the headline
+    bench (device-resident feed, run_steps windows), every step here
+    pays reader iteration + _to_feed + h2d + metric fetch — exactly the
+    overheads the pipelined loop overlaps with device compute. Epoch 0
+    warms the compile cache; epoch 1 is timed. Reports per-depth
+    throughput plus the measured overlap fraction
+    (1 - (host_blocked + device_blocked)/wall over the timed epoch),
+    which also lands in the metrics JSONL as gauges."""
+    import time as _t
+    from paddle_tpu import observe as _observe
+    import paddle_tpu.trainer as _trmod
+
+    out = {'model': model, 'steps_per_epoch': steps}
+    for d in depths:
+        fluid = _fresh()
+        if model == 'transformer':
+            from paddle_tpu.models import transformer as T
+            b = batch or 64
+
+            def train_func():
+                avg_cost, _ = T.transformer_base(
+                    src_vocab_size=vocab, trg_vocab_size=vocab,
+                    src_seq_len=seq, trg_seq_len=seq,
+                    max_length=max(256, seq))
+                return [avg_cost]
+
+            def reader():
+                for i in range(steps):
+                    yield T.make_fake_batch(b, seq, seq, vocab, vocab,
+                                            seed=i)
+
+            unit = b * seq
+            opt = lambda: fluid.optimizer.Adam(learning_rate=1e-4)
+        else:
+            from paddle_tpu.models.resnet import resnet50_with_loss
+            b = batch or 64
+
+            def train_func():
+                _, avg_cost, _ = resnet50_with_loss()
+                return [avg_cost]
+
+            def reader():
+                rng = np.random.RandomState(0)
+                for i in range(steps):
+                    yield {'image': rng.rand(b, 3, image,
+                                             image).astype('float32'),
+                           'label': rng.randint(
+                               0, 1000, (b, 1)).astype('int64')}
+
+            unit = b
+            opt = lambda: fluid.optimizer.Momentum(learning_rate=0.1,
+                                                   momentum=0.9)
+
+        state = {}
+
+        def handler(e, state=state):
+            if isinstance(e, _trmod.BeginEpochEvent) and e.epoch == 1:
+                state['hb0'] = _observe.get_gauge(
+                    'trainer.host_blocked_seconds') or 0.0
+                state['db0'] = _observe.get_gauge(
+                    'trainer.device_blocked_seconds') or 0.0
+                state['t0'] = _t.perf_counter()
+            elif isinstance(e, _trmod.EndEpochEvent) and e.epoch == 1:
+                state['t1'] = _t.perf_counter()
+                state['hb1'] = _observe.get_gauge(
+                    'trainer.host_blocked_seconds') or 0.0
+                state['db1'] = _observe.get_gauge(
+                    'trainer.device_blocked_seconds') or 0.0
+
+        trainer = fluid.Trainer(train_func=train_func,
+                                optimizer_func=opt,
+                                place=fluid.TPUPlace(0))
+        trainer.program.amp = 'bf16'
+        trainer.train(num_epochs=2, event_handler=handler, reader=reader,
+                      pipeline_depth=d,
+                      host_prefetch=(2 if d > 1 else 0))
+        wall = state['t1'] - state['t0']
+        key = 'd%d' % d
+        out[key + '_per_sec'] = round(unit * steps / wall, 1)
+        if _observe.enabled():
+            overlap = max(0.0, 1.0 - (
+                (state['hb1'] - state['hb0']) +
+                (state['db1'] - state['db0'])) / wall)
+            out[key + '_overlap'] = round(overlap, 4)
+            # into the metrics JSONL so the on-chip watcher's relay
+            # runs capture it beside the throughput rows
+            _observe.set_gauge('bench.pipeline_overlap_fraction',
+                               overlap, model=model, depth=d)
+            _observe.set_gauge('bench.pipeline_per_sec',
+                               out[key + '_per_sec'], model=model,
+                               depth=d)
+    if out.get('d1_per_sec'):
+        for d in depths[1:]:
+            k = 'd%d_per_sec' % d
+            if out.get(k):
+                out['async_speedup_d%d' % d] = round(
+                    out[k] / out['d1_per_sec'], 3)
+    return out
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -580,6 +684,19 @@ def _run_workload_child(workload, backend, reduced):
             # mode — the numbers are meaningless off-chip anyway
             os.environ.setdefault('PADDLE_TPU_PALLAS_INTERPRET', '1')
         print('RESULT_JSON %s' % json.dumps(attention_microbench(**kw)),
+              flush=True)
+        return
+    if workload in ('pipeline_transformer', 'pipeline_resnet50'):
+        model = 'transformer' if workload.endswith('transformer') \
+            else 'resnet50'
+        if reduced:
+            kw = dict(steps=6, batch=8, seq=16, vocab=512) \
+                if model == 'transformer' else \
+                dict(steps=4, batch=2, image=32)
+        else:
+            kw = {}
+        print('RESULT_JSON %s'
+              % json.dumps(bench_pipeline_ablation(model, **kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':
@@ -922,6 +1039,19 @@ def main():
             if not err:
                 ablations['transformer_tok_per_sec_single_dispatch'] = \
                     round(tok_1d, 1)
+        if alive() and not over_budget(extra=150.0):
+            # pipelined trainer loop (ISSUE 4): the host-fed sync vs
+            # D=2/4 ablation — feed/h2d/fetch overlap measured e2e,
+            # with the overlap fraction beside each throughput row
+            pl, err = run_rec('pipeline_transformer',
+                              'pipeline_transformer', timeout + 150)
+            if not err:
+                ablations['pipeline_transformer'] = pl
+        if on_chip and alive() and not over_budget(extra=150.0):
+            plr, err = run_rec('pipeline_resnet50', 'pipeline_resnet50',
+                               timeout + 150)
+            if not err:
+                ablations['pipeline_resnet50'] = plr
         if on_chip and alive() and not over_budget(extra=timeout + 200.0):
             # seq-4096 e2e pair: the long-context claim measured, both
             # attention paths
@@ -1106,7 +1236,9 @@ if __name__ == '__main__':
                                 'resnet50',
                                 'resnet50_anatomy', 'attention_microbench',
                                 'pallas_parity', 'moe_cap1.0',
-                                'moe_cap1.25', 'moe_cap2.0'])
+                                'moe_cap1.25', 'moe_cap2.0',
+                                'pipeline_transformer',
+                                'pipeline_resnet50'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

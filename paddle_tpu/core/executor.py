@@ -107,6 +107,45 @@ def _remat_policy(name):
     raise ValueError('unknown remat policy %r' % name)
 
 
+class StepHandle(object):
+    """One dispatched-but-unresolved step (or run_steps window).
+
+    JAX dispatch is asynchronous: ``run(..., return_handle=True)``
+    returns as soon as the computation is enqueued, with the fetches
+    still device futures. ``resolve()`` blocks on them (np.asarray —
+    the only true sync on a tunneled relay) and returns the numpy
+    metrics; ``ready()`` peeks without blocking. ``dispatched_at``
+    timestamps the enqueue so the pipelined trainer can attribute
+    host-blocked vs device-blocked wall time."""
+
+    __slots__ = ('fetches', 'steps', 'dispatched_at', 'cache_miss',
+                 '_resolved')
+
+    def __init__(self, fetches, steps=1, cache_miss=False):
+        self.fetches = fetches
+        self.steps = int(steps)
+        self.cache_miss = bool(cache_miss)
+        self.dispatched_at = time.perf_counter()
+        self._resolved = None
+
+    def ready(self):
+        """True when every fetch has landed (non-blocking peek)."""
+        if self._resolved is not None:
+            return True
+        try:
+            return all(bool(v.is_ready()) for v in self.fetches)
+        except AttributeError:
+            return True   # plain numpy values: nothing in flight
+
+    def resolve(self):
+        """Block until the dispatch completes; returns numpy metrics.
+        Idempotent — the device references are dropped on first call."""
+        if self._resolved is None:
+            self._resolved = [np.asarray(v) for v in self.fetches]
+            self.fetches = self._resolved
+        return self._resolved
+
+
 class _Compiled(object):
     __slots__ = ('fn', 'raw_fn', 'scope_in_names', 'scope_out_names',
                  'feed_names', 'fetch_names', 'flops')
@@ -261,7 +300,8 @@ class Executor(object):
 
     # ------------------------------------------------------------------ run
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True,
+            return_handle=False):
         import jax
 
         _ensure_ops_imported()
@@ -312,13 +352,17 @@ class Executor(object):
             for name, value in new_scope.items():
                 scope.set(name, value)
 
+        if return_handle:
+            return StepHandle(list(fetches), steps=1,
+                              cache_miss=self.last_cache_miss)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
 
     # ---------------------------------------------------------- multi-step
     def run_steps(self, steps, program=None, feed=None, fetch_list=None,
-                  scope=None, return_numpy=True, stacked_feed=False):
+                  scope=None, return_numpy=True, stacked_feed=False,
+                  return_handle=False):
         """Run `steps` training steps as ONE XLA execution: the compiled
         step function is wrapped in a lax.scan, so per-dispatch overhead
         (host->device feed, dispatch latency — ~5 ms through a tunneled
@@ -433,6 +477,9 @@ class Executor(object):
                                                  step0)
             for name, value in new_scope.items():
                 scope.set(name, value)
+        if return_handle:
+            return StepHandle(list(fetches), steps=steps,
+                              cache_miss=self.last_cache_miss)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

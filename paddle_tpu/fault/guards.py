@@ -18,6 +18,24 @@ therefore means UNDOING state, not just skipping a batch:
 All policies escalate to 'raise' after max_bad_steps consecutive bad
 steps: an unbroken NaN run means the model state, not the input, is
 poisoned.
+
+Pipelined training (Trainer.train(pipeline_depth=D)) widens these
+semantics explicitly — a bad loss is only SEEN when its step resolves,
+up to D-1 dispatches after later steps already applied their (equally
+poisoned) updates:
+
+- 'raise' surfaces the BadStepError at resolve time, ≤ D-1 steps after
+  the bad dispatch.
+- 'skip_step' snapshots once per drain group (cadence = D, taken at
+  pipeline-empty points, where the device->host readback cannot stall
+  in-flight work) and undoes the WHOLE group on any bad step —
+  rollback granularity ≤ D steps, including good steps that resolved
+  earlier in the same group. Both detections force a documented
+  re-sync: the trainer drains every in-flight dispatch before the
+  guard restores state, so the restore wins over all prior scope
+  writes.
+- 'rollback' keeps its granularity (newest complete checkpoint); the
+  in-flight steps behind the bad one are drained and discarded.
 """
 
 import numpy as np
@@ -81,7 +99,12 @@ class BadStepGuard(object):
         for name, arr in arrays.items():
             scope.set(name, _io._from_numpy(arr, manifest[name]['dtype']))
 
-    def handle(self, loss, step):
+    def handle(self, loss, step, steps=1):
+        """`steps`: how many training steps this verdict covers — 1 for
+        a per-step dispatch, w for a run_steps window, and the whole
+        drain group (≤ pipeline_depth) under pipelined skip_step, where
+        the snapshot restore undoes every step since the last
+        pipeline-empty point."""
         if not is_bad(loss):
             self._consecutive = 0
             return 'ok'
@@ -89,6 +112,8 @@ class BadStepGuard(object):
         _obs.inc('fault.bad_steps_total')
         head = ('non-finite loss at global step %d (%r)'
                 % (step, np.asarray(loss).ravel()[:4].tolist()))
+        if steps > 1:
+            head += ' [undo unit: %d steps]' % int(steps)
         if self.policy == 'raise':
             _obs.inc('fault.guard_triggers_total', policy='raise',
                      action='raise')

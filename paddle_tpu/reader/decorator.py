@@ -5,6 +5,8 @@ import random
 from queue import Queue
 from threading import Thread
 
+import numpy as np
+
 from .. import observe as _obs
 
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
@@ -72,25 +74,48 @@ def buffered(reader, size):
         pass
     end = EndSignal()
 
-    def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
-
     def data_reader():
+        from queue import Full
+        from threading import Event
         r = reader()
         q = Queue(maxsize=size)
-        t = Thread(target=read_worker, args=(r, q))
+        closed = Event()
+
+        def put(item):
+            # close-aware put: a consumer that stopped pulling
+            # (break / GeneratorExit) leaves the queue full forever —
+            # a bare q.put would pin this thread for the process
+            # lifetime, one leaked thread per abandoned epoch
+            while not closed.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except Full:
+                    pass
+            return False
+
+        def read_worker():
+            for d in r:
+                if not put(d):
+                    return
+            put(end)
+
+        t = Thread(target=read_worker,
+                   name='paddle_tpu_buffered_reader')
         t.daemon = True
         t.start()
-        e = q.get()
-        while e is not end:
-            if _obs.enabled():
-                # occupancy AFTER the pop: 0 means the consumer is
-                # starved (the producer is the bottleneck)
-                _obs.set_gauge('reader.buffered_queue_depth', q.qsize())
-            yield e
+        try:
             e = q.get()
+            while e is not end:
+                if _obs.enabled():
+                    # occupancy AFTER the pop: 0 means the consumer is
+                    # starved (the producer is the bottleneck)
+                    _obs.set_gauge('reader.buffered_queue_depth',
+                                   q.qsize())
+                yield e
+                e = q.get()
+        finally:
+            closed.set()
     return data_reader
 
 
@@ -246,8 +271,17 @@ def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
     reader yields dicts (or tuples zipped with feed_names); yields dicts
     of device arrays. `place` (a paddle place or jax device) selects the
     target device; default is jax's default device.
+
+    Mutation safety: a reader that reuses its output buffers (recordio
+    slots, a preallocated decode array) is safe to prefetch — on hosts
+    where XLA:CPU zero-copies aligned arrays the batch is copied before
+    device_put (staging.host_alias_safe, the same invariant as the
+    staging ring), so the producer overwriting its slot cannot corrupt
+    an in-flight prefetched batch.
     """
     import jax
+
+    from .staging import host_alias_safe
 
     device = resolve_device(place)
 
@@ -255,12 +289,15 @@ def prefetch_to_device(reader, feed_names=None, buffer_size=2, place=None):
         import collections
         queue = collections.deque()
         norm = [None]
+        target = device if device is not None else jax.devices()[0]
 
         def put(item):
             if norm[0] is None:
                 norm[0] = feed_normalizer(item, feed_names)
             item = norm[0](item)
-            queue.append({k: jax.device_put(v, device)
+            queue.append({k: jax.device_put(
+                host_alias_safe(np.asarray(v) if not hasattr(v, 'devices')
+                                else v, target), device)
                           for k, v in item.items()})
 
         it = iter(reader())

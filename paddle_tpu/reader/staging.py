@@ -16,7 +16,7 @@ import numpy as np
 
 from .. import observe as _obs
 
-__all__ = ['staged_superbatch', 'fields_to_device']
+__all__ = ['staged_superbatch', 'fields_to_device', 'host_alias_safe']
 
 
 def _load():
@@ -24,19 +24,30 @@ def _load():
     return load_staging()
 
 
+def host_alias_safe(arr, target):
+    """Return `arr` safe to device_put onto `target` while the caller
+    keeps mutating its buffer: XLA:CPU zero-copies aligned host arrays,
+    so the 'device' array would alias the source slot — copy there.
+    Real accelerators DMA a fresh HBM buffer; no copy needed. The one
+    home of the invariant, shared by fields_to_device (staging ring
+    slots) and reader.prefetch_to_device (readers that reuse their
+    output buffers, e.g. recordio slots)."""
+    if getattr(target, 'platform', None) == 'cpu' and \
+            isinstance(arr, np.ndarray):
+        return arr.copy()
+    return arr
+
+
 def fields_to_device(fields, target):
     """fields: name -> numpy view ALIASING a reusable staging slot.
-    Copies on host-aliasing platforms (CPU jax zero-copies aligned host
-    arrays — the 'device' array would alias the slot), device_puts, and
-    blocks until the h2d transfer completes so the caller may release
-    and reuse the slot. The one home of that invariant — shared by
-    staged_superbatch and recordio_superbatch."""
+    Copies on host-aliasing platforms (host_alias_safe), device_puts,
+    and blocks until the h2d transfer completes so the caller may
+    release and reuse the slot."""
     import jax
     window = {}
     for name, arr in fields.items():
-        if target.platform == 'cpu':
-            arr = arr.copy()
-        window[name] = jax.device_put(arr, target)
+        window[name] = jax.device_put(host_alias_safe(arr, target),
+                                      target)
     for v in window.values():
         v.block_until_ready()
     return window

@@ -1,0 +1,436 @@
+"""Pipelined async training loop (ISSUE 4): bounded in-flight
+dispatches, host prefetch worker, device-resident feeds, widened guard
+semantics, and the new overlap telemetry."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io as _io
+from paddle_tpu.reader import decorator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _linreg_train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1)
+    return [fluid.layers.mean(fluid.layers.square_error_cost(pred, y))]
+
+
+def _make_batches(n, batch=8, seed=4, wseed=3):
+    rng = np.random.RandomState(wseed)
+    w = rng.randn(4, 1).astype('float32')
+    r = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xs = r.randn(batch, 4).astype('float32')
+        out.append({'x': xs, 'y': xs @ w})
+    return out
+
+
+def _train(batches, num_epochs=1, events=None, ckpt=None, **train_kw):
+    """One fresh training run; returns (losses, final persistables)."""
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.reset_default_programs()
+        trainer = fluid.Trainer(
+            train_func=_linreg_train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+            place=fluid.CPUPlace(), checkpoint_config=ckpt)
+        losses = []
+
+        def handler(e):
+            if events is not None:
+                events.append((type(e).__name__,
+                               getattr(e, 'step', None)))
+            if isinstance(e, fluid.trainer.EndStepEvent):
+                losses.append(float(np.asarray(
+                    e.metrics[0]).reshape(())))
+
+        trainer.train(num_epochs=num_epochs, event_handler=handler,
+                      reader=lambda: iter(batches), **train_kw)
+        arrays, _ = _io._snapshot_vars(trainer.program,
+                                       predicate=_io._is_persistable)
+        return losses, arrays, trainer
+
+
+# ------------------------------------------------ bit-identical e2e
+@pytest.mark.parametrize('depth', [2, 4])
+def test_pipelined_bit_identical_per_step(depth):
+    """pipeline_depth>1 reproduces the sync loop's trajectory exactly:
+    same per-step losses, bitwise-identical final params."""
+    batches = _make_batches(7)
+    base_losses, base_params, _ = _train(batches, num_epochs=2)
+    pl_losses, pl_params, _ = _train(batches, num_epochs=2,
+                                     pipeline_depth=depth)
+    assert pl_losses == base_losses
+    assert set(pl_params) == set(base_params)
+    for k in base_params:
+        np.testing.assert_array_equal(pl_params[k], base_params[k])
+
+
+@pytest.mark.parametrize('depth', [2, 4])
+def test_pipelined_bit_identical_windowed(depth):
+    """Pipelined run_steps windows (w=3, trailing remainder per-step)
+    match the sync windowed loop bitwise."""
+    batches = _make_batches(7)
+    base_losses, base_params, _ = _train(batches, steps_per_dispatch=3)
+    pl_losses, pl_params, _ = _train(batches, steps_per_dispatch=3,
+                                     pipeline_depth=depth)
+    np.testing.assert_allclose(pl_losses, base_losses, rtol=0, atol=0)
+    for k in base_params:
+        np.testing.assert_array_equal(pl_params[k], base_params[k])
+
+
+def test_host_prefetch_matches_inline():
+    """The host prefetch worker changes where feed prep runs, never
+    what is dispatched."""
+    batches = _make_batches(7)
+    _, base_params, _ = _train(batches, pipeline_depth=2)
+    _, pf_params, _ = _train(batches, pipeline_depth=2, host_prefetch=3)
+    for k in base_params:
+        np.testing.assert_array_equal(pf_params[k], base_params[k])
+
+
+def test_stacked_windows_device_resident():
+    """stacked_windows=True feeds device-resident [w, ...] superbatches
+    (the staged_superbatch contract) straight to run_steps — same
+    trajectory as host-side window stacking."""
+    import jax
+    batches = _make_batches(6)
+    base_losses, base_params, _ = _train(batches, steps_per_dispatch=2)
+
+    def superbatches():
+        for i in range(0, 6, 2):
+            pair = batches[i:i + 2]
+            yield {n: jax.device_put(np.stack([b[n] for b in pair]))
+                   for n in pair[0]}
+
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.reset_default_programs()
+        trainer = fluid.Trainer(
+            train_func=_linreg_train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.1),
+            place=fluid.CPUPlace())
+        losses = []
+        trainer.train(
+            num_epochs=1,
+            event_handler=lambda e: losses.append(float(np.asarray(
+                e.metrics[0]).reshape(())))
+            if isinstance(e, fluid.trainer.EndStepEvent) else None,
+            reader=superbatches, steps_per_dispatch=2,
+            stacked_windows=True, pipeline_depth=2)
+        arrays, _ = _io._snapshot_vars(trainer.program,
+                                       predicate=_io._is_persistable)
+    np.testing.assert_allclose(losses, base_losses, rtol=0, atol=0)
+    for k in base_params:
+        np.testing.assert_array_equal(arrays[k], base_params[k])
+
+
+# ------------------------------------------------- event ordering
+def test_event_ordering_contract():
+    """Begin fires at dispatch, End at resolve: both streams stay
+    in step order, End(k) never precedes Begin(k), and no step is ever
+    resolved more than pipeline_depth dispatches late."""
+    depth = 3
+    events = []
+    _train(_make_batches(9), events=events, pipeline_depth=depth)
+    begins = [s for n, s in events if n == 'BeginStepEvent']
+    ends = [s for n, s in events if n == 'EndStepEvent']
+    assert begins == list(range(9))
+    assert ends == list(range(9))
+    seen_begin, seen_end = set(), set()
+    for name, s in events:
+        if name == 'BeginStepEvent':
+            seen_begin.add(s)
+        elif name == 'EndStepEvent':
+            assert s in seen_begin          # End only after its Begin
+            seen_end.add(s)
+        # bounded pipeline: in-flight = begun minus ended <= depth
+        assert len(seen_begin) - len(seen_end) <= depth
+    # depth>1 actually overlaps: some Begin(k+1) precedes End(k)
+    first_end = events.index(('EndStepEvent', 0))
+    assert ('BeginStepEvent', 1) in events[:first_end]
+
+
+def test_depth1_event_stream_is_sync():
+    """pipeline_depth=1 keeps the classic strict interleave."""
+    events = []
+    _train(_make_batches(5), events=events, pipeline_depth=1)
+    steps = [e for e in events if e[0] in ('BeginStepEvent',
+                                           'EndStepEvent')]
+    expect = []
+    for i in range(5):
+        expect += [('BeginStepEvent', i), ('EndStepEvent', i)]
+    assert steps == expect
+
+
+# ------------------------------------------------------ guards
+def _poisoned_batches(n, poison_at):
+    batches = _make_batches(n)
+    batches[poison_at] = {
+        'x': np.full((8, 4), np.nan, 'float32'),
+        'y': np.zeros((8, 1), 'float32')}
+    return batches
+
+
+def test_guard_raise_at_depth(tmp_path):
+    """'raise' surfaces the BadStepError even when the bad step is
+    detected at resolve, dispatches late."""
+    cfg = fluid.CheckpointConfig(str(tmp_path), nan_policy='raise',
+                                 epoch_end=False)
+    from paddle_tpu.fault.guards import BadStepError
+    with pytest.raises(BadStepError):
+        _train(_poisoned_batches(6, 2), ckpt=cfg, pipeline_depth=3)
+
+
+def test_guard_skip_step_at_depth_group_undo(tmp_path):
+    """skip_step at depth D: the snapshot covers the whole drain group,
+    so a bad step undoes the group (<= D steps) and training continues —
+    final params equal a run that never saw the group's batches."""
+    batches = _poisoned_batches(6, 3)
+    cfg = fluid.CheckpointConfig(str(tmp_path / 'a'),
+                                 nan_policy='skip_step',
+                                 epoch_end=False)
+    _, params, trainer = _train(batches, ckpt=cfg, pipeline_depth=2)
+    # groups of 2: [0,1] ok, [2,3] undone as a unit (3 is bad), [4,5] ok
+    assert trainer._step == 4
+    for arr in params.values():
+        assert np.isfinite(np.asarray(arr)).all()
+    control = [batches[i] for i in (0, 1, 4, 5)]
+    cfg2 = fluid.CheckpointConfig(str(tmp_path / 'b'),
+                                  nan_policy='skip_step',
+                                  epoch_end=False)
+    _, want, _ = _train(control, ckpt=cfg2, pipeline_depth=2)
+    for k in want:
+        np.testing.assert_array_equal(params[k], want[k])
+    # every step still fired its events (the drained one included):
+    events = []
+    cfg3 = fluid.CheckpointConfig(str(tmp_path / 'c'),
+                                  nan_policy='skip_step',
+                                  epoch_end=False)
+    _train(batches, ckpt=cfg3, pipeline_depth=2, events=events)
+    assert [s for n, s in events if n == 'EndStepEvent'] == \
+        list(range(6))
+
+
+def test_guard_skip_step_depth1_unchanged(tmp_path):
+    """At depth 1 the widened semantics degenerate to the classic
+    single-step undo."""
+    batches = _poisoned_batches(5, 2)
+    cfg = fluid.CheckpointConfig(str(tmp_path / 'a'),
+                                 nan_policy='skip_step',
+                                 epoch_end=False)
+    _, params, trainer = _train(batches, ckpt=cfg, pipeline_depth=1)
+    assert trainer._step == 4          # only the bad step was undone
+    control = [batches[i] for i in (0, 1, 3, 4)]
+    cfg2 = fluid.CheckpointConfig(str(tmp_path / 'b'),
+                                  nan_policy='skip_step',
+                                  epoch_end=False)
+    _, want, _ = _train(control, ckpt=cfg2)
+    for k in want:
+        np.testing.assert_array_equal(params[k], want[k])
+
+
+def test_pipelined_checkpoint_cadence_resume(tmp_path):
+    """Mid-epoch cadence saves under pipelining drain first: a resumed
+    run replays exactly the untrained remainder (bit-identical params),
+    even though the save point floated up to D-1 steps."""
+    from paddle_tpu.reader.state import CheckpointableReader
+    batches = _make_batches(8)
+    base_losses, base_params, _ = _train(batches)
+
+    def run(dirname, resume):
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.reset_default_programs()
+            cfg = fluid.CheckpointConfig(dirname, save_every_steps=3,
+                                         resume=resume, epoch_end=False,
+                                         async_save=False,
+                                         nan_policy=None)
+            trainer = fluid.Trainer(
+                train_func=_linreg_train_func,
+                optimizer_func=lambda: fluid.optimizer.SGD(
+                    learning_rate=0.1),
+                place=fluid.CPUPlace(), checkpoint_config=cfg)
+            reader = CheckpointableReader(lambda: iter(batches))
+            stop = {'n': 0}
+
+            def handler(e):
+                if isinstance(e, fluid.trainer.EndStepEvent):
+                    stop['n'] += 1
+                    if not resume and stop['n'] == 6:
+                        raise KeyboardInterrupt   # simulated preemption
+            try:
+                trainer.train(num_epochs=1, event_handler=handler,
+                              reader=reader, pipeline_depth=2)
+            except KeyboardInterrupt:
+                return None
+            arrays, _ = _io._snapshot_vars(
+                trainer.program, predicate=_io._is_persistable)
+            return arrays
+
+    d = str(tmp_path)
+    assert run(d, resume=False) is None     # killed at step 6
+    arrays = run(d, resume=True)            # resumes past the save
+    for k in base_params:
+        np.testing.assert_array_equal(arrays[k], base_params[k])
+
+
+# ----------------------------------------------------- StepHandle
+def test_executor_step_handle():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    out = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {'x': np.ones((2, 3), 'float32')}
+    want = exe.run(feed=feed, fetch_list=[out])[0]
+    h = exe.run(feed=feed, fetch_list=[out], return_handle=True)
+    assert h.steps == 1 and h.dispatched_at > 0
+    got = h.resolve()
+    assert h.ready()
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want))
+    assert h.resolve() is got               # idempotent
+
+
+# ------------------------------------------------- reader satellites
+def test_prefetch_to_device_mutation_safety_and_tail():
+    """A reader that reuses its output buffer (recordio-slot style)
+    must not corrupt in-flight prefetched batches on hosts where
+    XLA:CPU zero-copies aligned arrays; the buffered tail drains after
+    the source exhausts."""
+    buf = np.zeros((2, 3), dtype='float32')
+
+    def reuse_reader():
+        for i in range(5):
+            buf[:] = i          # overwrite the SAME buffer every yield
+            yield {'x': buf}
+
+    dev = decorator.prefetch_to_device(reuse_reader, buffer_size=2)
+    got = [np.asarray(b['x']).copy() for b in dev()]
+    assert len(got) == 5                         # tail fully drained
+    for i, arr in enumerate(got):
+        np.testing.assert_allclose(arr, i)       # no slot aliasing
+
+
+def test_buffered_early_exit_no_thread_leak():
+    """Breaking out of a buffered reader must not leave its worker
+    thread blocked in q.put forever."""
+    def slow_reader():
+        for i in range(10000):
+            yield i
+
+    before = {t.ident for t in threading.enumerate()}
+    for _ in range(3):                 # one leaked thread per epoch…
+        it = decorator.buffered(slow_reader, size=2)()
+        assert next(it) == 0
+        it.close()                     # early consumer exit
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.name == 'paddle_tpu_buffered_reader'
+                  and t.is_alive() and t.ident not in before]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, 'buffered worker threads leaked: %r' % leaked
+    # normal full consumption still intact
+    assert [x for x in decorator.buffered(slow_reader, size=4)()][:5] \
+        == [0, 1, 2, 3, 4]
+
+
+def test_trainer_prefetch_worker_no_thread_leak(tmp_path):
+    """The trainer's host_prefetch worker exits when training aborts
+    mid-epoch."""
+    batches = _make_batches(50)
+
+    class Boom(RuntimeError):
+        pass
+
+    def handler(e):
+        if isinstance(e, fluid.trainer.EndStepEvent) and e.step >= 2:
+            raise Boom()
+
+    with pytest.raises(Boom):
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.reset_default_programs()
+            trainer = fluid.Trainer(
+                train_func=_linreg_train_func,
+                optimizer_func=lambda: fluid.optimizer.SGD(
+                    learning_rate=0.1),
+                place=fluid.CPUPlace())
+            trainer.train(num_epochs=1, event_handler=handler,
+                          reader=lambda: iter(batches),
+                          pipeline_depth=2, host_prefetch=2)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == 'paddle_tpu_trainer_prefetch'
+                 and t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, 'prefetch worker leaked: %r' % alive
+
+
+# ------------------------------------------------------ telemetry
+def test_pipeline_metrics_flow(tmp_path):
+    """inflight/resolve/blocked metrics land in the registry and flow
+    through the JSONL into tools/metrics_report.py's overlap figure."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import metrics_report
+    finally:
+        sys.path.pop(0)
+    from paddle_tpu import observe
+
+    jsonl = str(tmp_path / 'm.jsonl')
+    observe.reset()
+    observe.enable(jsonl=jsonl)
+    try:
+        _train(_make_batches(6), pipeline_depth=2, host_prefetch=2)
+        snap = observe.snapshot()
+        assert 'trainer.inflight_depth' in snap['gauges']
+        assert 'trainer.pipeline_overlap_fraction' in snap['gauges']
+        hists = snap['histograms']
+        assert hists['trainer.resolve_seconds']['count'] >= 6
+        hb = snap['gauges'].get('trainer.host_blocked_seconds')
+        db = snap['gauges'].get('trainer.device_blocked_seconds')
+        assert hb is not None and hb >= 0.0
+        assert db is None or db >= 0.0
+        observe.flush()
+    finally:
+        observe._SINK['path'] = None
+        observe._SINK['trace_path'] = None
+        observe.disable()
+        observe.reset()
+    recs = metrics_report.load_records(jsonl)
+    assert recs
+    d = metrics_report.derive(metrics_report.pick(recs, any_kind=True))
+    assert d['overlap_fraction'] is not None
+    assert 0.0 <= d['overlap_fraction'] <= 1.0
+    assert 'overlap' in metrics_report.render(recs[-1])
+
+
+def test_windowed_feed_histogram_labeled(tmp_path):
+    """Window stacking records its feed cost under a steps=w label so
+    per-step phase percentiles stay comparable across dispatch modes."""
+    from paddle_tpu import observe
+    observe.reset()
+    observe.enable()
+    try:
+        _train(_make_batches(6), steps_per_dispatch=3)
+        reg = observe.registry()
+        h = reg.histogram('trainer.phase_seconds')
+        assert h.count(phase='feed', steps=3) == 2      # two windows
+        assert h.count(phase='feed') == 6               # per-batch
+        assert h.count(phase='compute', steps=3) == 2
+        assert h.count(phase='compute') == 0            # no singles ran
+    finally:
+        observe.disable()
+        observe.reset()

@@ -60,7 +60,22 @@ def derive(rec):
         'goodput': gauges.get('run.goodput'),
         'step_flops': gauges.get('executor.step_flops'),
         'steps_per_sec_ema': gauges.get('trainer.steps_per_sec_ema'),
+        'host_blocked_seconds':
+            gauges.get('trainer.host_blocked_seconds'),
+        'device_blocked_seconds':
+            gauges.get('trainer.device_blocked_seconds'),
     }
+    # pipelined-loop overlap: 1 - (host-blocked + device-blocked)/wall.
+    # The trainer publishes its own per-train() figure; reconstruct
+    # from the blocked ledgers when only those made it into the record.
+    overlap = gauges.get('trainer.pipeline_overlap_fraction')
+    if overlap is None:
+        hb = out['host_blocked_seconds']
+        db = out['device_blocked_seconds']
+        wall = gauges.get('run.wall_seconds')
+        if hb is not None and db is not None and wall:
+            overlap = max(0.0, 1.0 - (hb + db) / wall)
+    out['overlap_fraction'] = overlap
     return out
 
 
@@ -80,6 +95,8 @@ def render(rec):
         head.append('goodput %.2f%%' % (100.0 * d['goodput']))
     if d['steps_per_sec_ema'] is not None:
         head.append('%.4g steps/s' % d['steps_per_sec_ema'])
+    if d['overlap_fraction'] is not None:
+        head.append('overlap %.2f%%' % (100.0 * d['overlap_fraction']))
     if d['step_flops'] is not None:
         head.append('%.4g FLOPs/step' % d['step_flops'])
     lines.append('== %s (pid %s, ts %s) %s' % (

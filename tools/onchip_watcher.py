@@ -68,6 +68,10 @@ QUEUE = [
     ('transformer_seq4096_pallas', 'transformer_seq4096',
      {'PADDLE_TPU_USE_PALLAS': '1'}, 700),
     ('transformer_seq256', 'transformer_seq256', None, 600),
+    # pipelined trainer loop sync-vs-D=2/4 (host-fed; overlap fraction
+    # lands in the metrics JSONL beside the throughput rows)
+    ('pipeline_transformer', 'pipeline_transformer', None, 700),
+    ('pipeline_resnet50', 'pipeline_resnet50', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),
