@@ -497,8 +497,12 @@ class Executor(object):
         t0 = time.perf_counter()
         with _obs.span('executor.trace', kind=kind, key=kid):
             compiled = compile_fn()
-        _obs.record('executor.trace_seconds',
-                    time.perf_counter() - t0, kind=kind, key=kid)
+        dt = time.perf_counter() - t0
+        _obs.record('executor.trace_seconds', dt, kind=kind, key=kid)
+        # a mid-run compile is exactly the kind of last-seconds context a
+        # postmortem needs (shape churn right before death)
+        _obs.flight_event('compile', kind=kind, key=kid,
+                          trace_seconds=round(dt, 6))
         return compiled
 
     def _cost_account(self, compiled, key, scope_vals, feed_vals):

@@ -117,33 +117,56 @@ class BadStepGuard(object):
         if self.policy == 'raise':
             _obs.inc('fault.guard_triggers_total', policy='raise',
                      action='raise')
-            raise BadStepError(head + " — nan_policy='raise'",
+            err = BadStepError(head + " — nan_policy='raise'",
                                step=step, loss=loss)
+            self._flight_raise(err, step, 'raise', 'bad_step')
+            raise err
         if self._consecutive > self.max_bad_steps:
             _obs.inc('fault.guard_triggers_total', policy=self.policy,
                      action='escalate')
-            raise BadStepError(
+            err = BadStepError(
                 head + ' — %d consecutive bad steps exceed max_bad_steps='
                 '%d; the model state itself is likely poisoned'
                 % (self._consecutive, self.max_bad_steps),
                 step=step, loss=loss)
+            self._flight_raise(err, step, self.policy, 'max_bad_steps')
+            raise err
         if self.policy == 'skip_step':
             if self._snap is None:
-                raise BadStepError(
+                err = BadStepError(
                     head + " — nan_policy='skip_step' but no pre-step "
                     'snapshot was taken', step=step, loss=loss)
+                self._flight_raise(err, step, 'skip_step', 'bad_step')
+                raise err
             self._restore_snapshot()
             _obs.inc('fault.guard_triggers_total', policy='skip_step',
                      action='skipped')
+            _obs.flight_event('guard_trip', step=step, policy='skip_step',
+                              action='skipped', undo_steps=int(steps))
             return 'skipped'
         # rollback
         meta = None
         if self._manager is not None:
             meta = self._manager.restore(self._executor, self._program)
         if meta is None:
-            raise BadStepError(
+            err = BadStepError(
                 head + " — nan_policy='rollback' but no complete "
                 'checkpoint exists to roll back to', step=step, loss=loss)
+            self._flight_raise(err, step, 'rollback', 'bad_step')
+            raise err
         _obs.inc('fault.guard_triggers_total', policy='rollback',
                  action='rolled_back')
+        _obs.flight_event('guard_trip', step=step, policy='rollback',
+                          action='rolled_back',
+                          restored_step=meta.get('step'))
         return 'rolled_back'
+
+    @staticmethod
+    def _flight_raise(err, step, policy, reason):
+        """A guard raise is the run's death sentence: record the trip
+        and dump the postmortem HERE, while the exception context is
+        richest (the trainer's outer handler dedupes on the same
+        exception object)."""
+        _obs.flight_event('guard_trip', step=step, policy=policy,
+                          action='raise', error=str(err))
+        _obs.flight_dump(reason, exc=err)

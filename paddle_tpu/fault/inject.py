@@ -83,6 +83,16 @@ def fire(point, step=None, dirname=None):
         return
     if (point == 'step_end' and plan.kill_at_step is not None
             and step is not None and step >= plan.kill_at_step):
+        # The one concession before the hard kill: a flight-recorder
+        # postmortem (no-op unless armed) — exactly what a real
+        # preemption's SIGTERM grace window would leave behind.
+        try:
+            from .. import observe as _obs
+            _obs.flight_event('kill', step=step,
+                              kill_at_step=plan.kill_at_step)
+            _obs.flight_dump('fault_injection_kill')
+        except Exception:
+            pass
         # os._exit: no atexit, no flushes, no thread joins — the closest
         # in-process stand-in for a preempted VM. >= (not ==) so a
         # windowed dispatch that jumps past k still dies.

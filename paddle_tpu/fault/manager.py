@@ -95,6 +95,9 @@ class CheckpointManager(object):
         d = self.step_dir(step)
         t0 = time.monotonic()
         _obs.inc('fault.checkpoint_saves_total')
+        _obs.flight_event('checkpoint_save', step=int(step),
+                          mode='async' if self.config.async_save
+                          else 'sync')
         handle = _io.save_checkpoint(
             executor, d, main_program=main_program, step=step,
             reader=reader, trainer_state=trainer_state,
@@ -176,6 +179,8 @@ class CheckpointManager(object):
                 _obs.record('fault.checkpoint_restore_seconds',
                             time.monotonic() - t0)
                 _obs.inc('fault.resume_total')
+                _obs.flight_event('checkpoint_restore', step=int(step),
+                                  path=os.path.basename(path))
                 return meta
             except Exception as e:
                 _obs.inc('fault.checkpoint_unusable_total')

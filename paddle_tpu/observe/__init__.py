@@ -1,16 +1,27 @@
 """paddle_tpu.observe — the telemetry subsystem.
 
-Three pieces, one switch:
+Six pieces, one switch:
 
 - a dependency-free metrics registry (labeled counters / gauges /
-  histograms) with a periodic JSONL sink and an end-of-run summary
-  table (`registry.py`),
+  histograms) with a periodic JSONL sink, an end-of-run summary table,
+  and a Prometheus text-exposition renderer (`registry.py`),
 - host-side span tracing exported as Chrome-trace/Perfetto JSON,
   bridged to ``jax.profiler.TraceAnnotation`` so host spans line up
   with XLA device traces (`spans.py`),
 - MFU/goodput accounting: XLA ``cost_analysis()`` FLOPs vs the chip's
   peak, and productive-steps-over-total-wall goodput that charges
-  restart/recompile/checkpoint time against the run (`mfu.py`).
+  restart/recompile/checkpoint time against the run (`mfu.py`),
+- a live diagnostics HTTP server — ``serve(port=...)`` or
+  ``PADDLE_TPU_STATUSZ_PORT`` — with /metrics /varz /statusz /tracez
+  /healthz /readyz and a pluggable health-check registry
+  (`diagnostics.py`),
+- a flight recorder: bounded ring of structured events dumped as a
+  postmortem JSON on trainer exceptions, guard raises, SIGTERM, and
+  injected kills — armed by ``PADDLE_TPU_FLIGHT_DUMP`` even with
+  metrics off (`flight.py`, rendered by tools/flight_report.py),
+- streaming anomaly detection: EWMA z-score detectors over loss /
+  step-time / anything fed to ``anomaly()``, flipping /healthz to
+  degraded while tripped (`anomaly.py`).
 
 Instrumented call sites across the executor, trainer, reader, fault,
 and parallel layers all funnel through the module-level helpers here
@@ -33,9 +44,13 @@ import atexit
 import contextlib
 import json
 import os
+import sys
+import threading
 import time
 import zlib
 
+from .anomaly import AnomalyMonitor
+from .flight import FlightRecorder
 from .mfu import (GoodputTracker, cost_analysis_flops,  # noqa: F401
                   device_peak_flops)
 from .registry import Registry
@@ -47,15 +62,37 @@ __all__ = ['enabled', 'enable', 'enable_from_env', 'disable', 'reset',
            'span', 'key_id', 'flush', 'maybe_flush', 'export_trace',
            'run_begin', 'step_done', 'overhead', 'goodput',
            'step_telemetry', 'summary_table', 'snapshot',
-           'device_peak_flops', 'cost_analysis_flops']
+           'device_peak_flops', 'cost_analysis_flops',
+           # live diagnostics / crash forensics / anomaly surface
+           'serve', 'stop_serving', 'register_health_check',
+           'unregister_health_check', 'flight_recorder', 'flight_event',
+           'flight_dump', 'flight_dump_path', 'arm_flight',
+           'arm_flight_from_env', 'anomaly', 'anomaly_state',
+           'anomaly_tripped']
 
 _enabled = False          # THE gate: helpers read this module global
 _REG = Registry()
 _SPANS = SpanRecorder()
 _GOODPUT = GoodputTracker()
+_FLIGHT = FlightRecorder()
+_ANOMALY = AnomalyMonitor()
 _SINK = {'path': None, 'every_secs': 30.0, 'last': 0.0,
          'trace_path': None}
 _atexit_armed = []
+
+# flight recording has its own single-read gate so a crash-forensics-
+# only run (PADDLE_TPU_FLIGHT_DUMP set, metrics off) still records the
+# ring. _flight_on == (_enabled or _flight_armed), maintained at every
+# state change, so the disabled hot path stays ONE boolean read.
+_flight_on = False
+_flight_armed = False
+_FLIGHT_DUMP = {'path': None, 'last_exc': None, 'last_path': None}
+
+# span drops become a registry counter (satellite: a truncated trace is
+# detectable from /metrics alone). Name-based lookup so registry.clear()
+# cannot orphan the counter object.
+_SPANS.on_drop = lambda n=1: (
+    _REG.counter('spans_dropped_total').inc(n) if _enabled else None)
 
 
 # ------------------------------------------------------------- lifecycle
@@ -70,8 +107,9 @@ def enable(jsonl=None, trace=None, every_secs=30.0):
     (one JSON object per line) plus a final ``kind: "summary"`` line on
     disable()/exit; `trace` writes a Chrome-trace JSON of all recorded
     spans at the same points. `every_secs` throttles maybe_flush()."""
-    global _enabled
+    global _enabled, _flight_on
     _enabled = True
+    _flight_on = True
     if jsonl is not None:
         _SINK['path'] = jsonl
     if trace is not None:
@@ -85,30 +123,47 @@ def enable(jsonl=None, trace=None, every_secs=30.0):
 
 def enable_from_env(environ=None):
     """enable() iff PADDLE_TPU_METRICS_JSONL and/or PADDLE_TPU_TRACE_JSON
-    (or PADDLE_TPU_OBSERVE=1) is set; returns whether telemetry is on."""
+    (or PADDLE_TPU_OBSERVE=1) is set; additionally arms the flight
+    recorder from PADDLE_TPU_FLIGHT_DUMP and starts the diagnostics
+    server on PADDLE_TPU_STATUSZ_PORT. Returns whether telemetry is
+    on."""
     env = os.environ if environ is None else environ
     jsonl = env.get('PADDLE_TPU_METRICS_JSONL')
     trace = env.get('PADDLE_TPU_TRACE_JSON')
     if jsonl or trace or env.get('PADDLE_TPU_OBSERVE') == '1':
         enable(jsonl=jsonl, trace=trace)
+    arm_flight_from_env(env)
+    port = env.get('PADDLE_TPU_STATUSZ_PORT')
+    if port:
+        try:
+            serve(port=int(port))
+        except Exception as e:
+            import warnings
+            warnings.warn('observe: diagnostics server on port %s failed '
+                          'to start (%s: %s)' % (port, type(e).__name__, e))
     return _enabled
 
 
 def disable():
-    """Final snapshot (kind 'summary') + trace export, then gate off."""
-    global _enabled
+    """Final snapshot (kind 'summary') + trace export, then gate off.
+    Flight recording stays on when separately armed (arm_flight)."""
+    global _enabled, _flight_on
     if _enabled:
         flush(kind='summary')
         export_trace()
     _enabled = False
+    _flight_on = _flight_armed
 
 
 def reset():
-    """Clear every metric, span, and the goodput ledger (sink config and
-    the enabled flag survive). profiler.reset_profiler() calls this."""
+    """Clear every metric, span, flight event, anomaly baseline, and the
+    goodput ledger (sink config and the enabled flag survive).
+    profiler.reset_profiler() calls this."""
     _REG.clear()
     _SPANS.clear()
     _GOODPUT.reset()
+    _FLIGHT.clear()
+    _ANOMALY.reset()
 
 
 def _atexit_flush():
@@ -227,7 +282,7 @@ def flush(kind='snapshot'):
         return
     _GOODPUT.publish(_REG)
     line = _REG.to_json_line(ts=round(time.time(), 3), kind=kind,
-                             pid=os.getpid())
+                             pid=os.getpid(), host=_host())
     with open(path, 'a') as f:
         f.write(line + '\n')
 
@@ -254,9 +309,25 @@ def summary_table():
     return _REG.summary_table()
 
 
+def _host():
+    """jax.process_index() when jax is loaded and initialized, else 0 —
+    the `host` tag on flushed/snapshot records that makes merged
+    multihost JSONLs attributable (never imports jax itself)."""
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
 def snapshot():
     _GOODPUT.publish(_REG)
-    return _REG.snapshot()
+    snap = _REG.snapshot()
+    snap['host'] = _host()
+    snap['pid'] = os.getpid()
+    return snap
 
 
 # ---------------------------------------------------------- mfu/goodput
@@ -288,3 +359,175 @@ def step_telemetry():
         'mfu': get_gauge('trainer.mfu'),
         'goodput': _GOODPUT.goodput(),
     }
+
+
+# ----------------------------------------------------- diagnostics server
+def serve(port=None, host='127.0.0.1'):
+    """Start the live diagnostics HTTP server (/metrics /varz /statusz
+    /tracez /healthz /readyz — see observe/diagnostics.py). Stdlib-only,
+    daemon thread, idempotent. port=None reads PADDLE_TPU_STATUSZ_PORT
+    (default 0 = ephemeral; read the bound port off the returned
+    object). Implies enable(): a scrape endpoint over an empty registry
+    would be pointless."""
+    from . import diagnostics
+    if port is None:
+        port = int(os.environ.get('PADDLE_TPU_STATUSZ_PORT', '0') or 0)
+    if not _enabled:
+        enable()
+    return diagnostics.start(host=host, port=int(port))
+
+
+def stop_serving():
+    """Shut the diagnostics server down (no-op when not running)."""
+    from . import diagnostics
+    diagnostics.stop()
+
+
+def register_health_check(name, fn, readiness_only=False):
+    """Plug a health check into /healthz (and /readyz); fn() returns
+    truthy/falsy or (ok, detail). readiness_only=True gates only
+    /readyz (e.g. ServingEngine.ready before warmup)."""
+    from . import diagnostics
+    diagnostics.register_health_check(name, fn,
+                                      readiness_only=readiness_only)
+
+
+def unregister_health_check(name):
+    from . import diagnostics
+    diagnostics.unregister_health_check(name)
+
+
+# --------------------------------------------------------- flight recorder
+def flight_recorder():
+    return _FLIGHT
+
+
+def flight_event(kind, /, **data):
+    """Append one structured event to the flight ring. One module-global
+    boolean read + return when neither telemetry nor the flight
+    recorder is armed (the hot-path contract)."""
+    if _flight_on:
+        _FLIGHT.record(kind, **data)
+
+
+def arm_flight(path=None, capacity=None):
+    """Turn flight recording on independently of the metrics gate and
+    (optionally) set the postmortem dump path. With a path set, a
+    SIGTERM — the preemption signal — dumps before the default handler
+    runs."""
+    global _flight_armed, _flight_on
+    _flight_armed = True
+    _flight_on = True
+    if capacity:
+        _FLIGHT.capacity = int(capacity)
+    if path:
+        _FLIGHT_DUMP['path'] = path
+        _install_sigterm_handler()
+    return _FLIGHT
+
+
+def arm_flight_from_env(environ=None):
+    """arm_flight() iff PADDLE_TPU_FLIGHT_DUMP names a dump path (the
+    Trainer calls this at train start, so a preempted run leaves a
+    postmortem without any code change)."""
+    env = os.environ if environ is None else environ
+    path = env.get('PADDLE_TPU_FLIGHT_DUMP')
+    if path:
+        arm_flight(path=path)
+    return _flight_on
+
+
+def flight_dump_path():
+    return _FLIGHT_DUMP['path']
+
+
+def flight_dump(reason, exc=None, path=None, extra=None):
+    """Write the postmortem JSON now (ring + final metrics snapshot +
+    last spans + anomaly state + exception). No-op unless flight
+    recording is on AND a path is known (arm_flight/env/explicit).
+    Re-dumping for the SAME exception object is a no-op, so the guard's
+    dump and the trainer's outer except don't overwrite each other's
+    reason. Never raises — forensics must not mask the original
+    failure. Returns the path written, or None."""
+    if not _flight_on:
+        return None
+    path = path or _FLIGHT_DUMP['path']
+    if not path:
+        return None
+    if exc is not None and exc is _FLIGHT_DUMP['last_exc']:
+        return _FLIGHT_DUMP['last_path']
+    try:
+        _GOODPUT.publish(_REG)
+        p = _FLIGHT.dump(path, reason, exc=exc,
+                         metrics=_REG.snapshot(),
+                         spans=_SPANS.events()[-100:],
+                         anomalies=_ANOMALY.state(),
+                         host=_host(), extra=extra)
+    except Exception:
+        return None
+    if exc is not None:
+        _FLIGHT_DUMP['last_exc'] = exc
+        _FLIGHT_DUMP['last_path'] = p
+    return p
+
+
+_sigterm_state = {'installed': False}
+
+
+def _install_sigterm_handler():
+    """Dump a postmortem on SIGTERM (the preemption notice), then chain
+    to the previously installed handler / default behavior. Main-thread
+    only (signal.signal's requirement); never fails the caller."""
+    if _sigterm_state['installed']:
+        return
+    import signal
+    if threading.current_thread() is not threading.main_thread():
+        return
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            flight_dump('sigterm')
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        _sigterm_state['installed'] = True
+    except (ValueError, OSError):
+        pass
+
+
+# ------------------------------------------------------ anomaly detection
+def anomaly(signal, value):
+    """Feed one sample to the streaming anomaly monitor (EWMA z-score
+    per signal — see observe/anomaly.py). Publishes
+    anomaly_score{signal=}/anomaly_tripped{signal=} gauges, counts
+    trips, records trip/clear flight events, and flips /healthz to
+    degraded while tripped. One boolean read + return when disabled.
+    Returns the sample's z-score (None when disabled)."""
+    if not _enabled:
+        return None
+    score, transitioned, tripped = _ANOMALY.observe(signal, value)
+    _REG.gauge('anomaly_score').set(score, signal=signal)
+    _REG.gauge('anomaly_tripped').set(1 if tripped else 0, signal=signal)
+    if transitioned:
+        if tripped:
+            _REG.counter('anomaly_trips_total').inc(signal=signal)
+            flight_event('anomaly_trip', signal=signal, score=score,
+                         value=value)
+        else:
+            flight_event('anomaly_clear', signal=signal)
+    return score
+
+
+def anomaly_state():
+    """{signal: detector state} — /statusz and postmortems."""
+    return _ANOMALY.state()
+
+
+def anomaly_tripped():
+    """Sorted names of currently-tripped anomaly signals."""
+    return _ANOMALY.tripped()

@@ -1,0 +1,160 @@
+"""Streaming anomaly detection: EWMA mean/variance z-score detectors.
+
+The bad-step guards (fault/guards.py) are a POSTcondition — they fire
+after a loss is already NaN, when the update is already applied. The
+anomaly monitor is the leading indicator: it tracks an exponentially
+weighted mean and variance per signal (loss, grad norm, step time, or
+anything else fed to it) and scores each new sample by its z-distance
+from the running baseline. A score past the threshold trips the
+detector; the trip surfaces as
+
+- ``anomaly_score{signal=...}`` / ``anomaly_tripped{signal=...}``
+  gauges and an ``anomaly_trips_total{signal=...}`` counter in the
+  metrics registry,
+- a ``/healthz`` flip to degraded on the diagnostics server while any
+  detector is tripped,
+- an ``anomaly_trip`` flight-recorder event (so the postmortem shows
+  the leading indicator firing before the crash).
+
+A tripped detector recovers after ``clear_after`` consecutive in-band
+samples (hysteresis: one outlier does not flap health). Non-finite
+samples trip immediately regardless of warmup — a NaN needs no
+baseline to be wrong.
+
+Call sites go through ``observe.anomaly(signal, value)`` (gated on the
+telemetry switch); the trainer feeds ``loss`` and ``step_time`` every
+resolve. Feed extra signals (e.g. a fetched gradient global-norm) from
+an event handler with the same call.
+"""
+
+import math
+import threading
+
+__all__ = ['EwmaDetector', 'AnomalyMonitor', 'DEFAULT_SIGNALS',
+           'NONFINITE_SCORE']
+
+# score assigned to NaN/Inf samples: huge but finite, so snapshots and
+# the Prometheus exposition stay strictly valid JSON/text
+NONFINITE_SCORE = 1e9
+
+# per-signal tuning for the conventional trainer signals; unlisted
+# signals get the defaults. step_time is noisy (GC, checkpoint stalls),
+# so it smooths slower and trips wider than loss/grad_norm.
+DEFAULT_SIGNALS = {
+    'loss': dict(alpha=0.05, z_threshold=8.0),
+    'grad_norm': dict(alpha=0.05, z_threshold=8.0),
+    'step_time': dict(alpha=0.1, z_threshold=12.0),
+}
+
+
+class EwmaDetector(object):
+    """One signal's streaming baseline + trip state."""
+
+    def __init__(self, alpha=0.05, z_threshold=8.0, min_samples=20,
+                 clear_after=10):
+        self.alpha = float(alpha)
+        self.z_threshold = float(z_threshold)
+        self.min_samples = int(min_samples)
+        self.clear_after = int(clear_after)
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+        self.tripped = False
+        self.last_score = 0.0
+        self.last_value = None
+        self.trips = 0
+        self._clear_run = 0
+
+    def observe(self, value):
+        """Score one sample against the baseline, update the baseline,
+        update trip state. Returns (score, transitioned) where
+        `transitioned` is True when the tripped flag just flipped."""
+        x = float(value)
+        finite = math.isfinite(x)
+        if not finite:
+            score = NONFINITE_SCORE
+        elif self.count < self.min_samples:
+            score = 0.0         # no baseline yet
+        else:
+            # denominator floor: a near-constant signal (var -> 0) must
+            # not turn ordinary training drift into million-sigma trips
+            denom = math.sqrt(max(self.var, 0.0)) \
+                + 1e-3 * abs(self.mean) + 1e-9
+            score = abs(x - self.mean) / denom
+        if finite:
+            # EWMA mean/variance (West's recurrence): the baseline keeps
+            # moving even through an anomaly, so a level shift becomes
+            # the new normal instead of tripping forever
+            diff = x - self.mean
+            self.mean += self.alpha * diff
+            self.var = (1.0 - self.alpha) * (
+                self.var + self.alpha * diff * diff)
+            self.count += 1
+        self.last_score = score
+        self.last_value = x
+        transitioned = False
+        if score >= self.z_threshold:
+            self._clear_run = 0
+            if not self.tripped:
+                self.tripped = True
+                self.trips += 1
+                transitioned = True
+        elif self.tripped:
+            self._clear_run += 1
+            if self._clear_run >= self.clear_after:
+                self.tripped = False
+                self._clear_run = 0
+                transitioned = True
+        return score, transitioned
+
+    def state(self):
+        return {'score': self.last_score, 'tripped': self.tripped,
+                'mean': self.mean, 'std': math.sqrt(max(self.var, 0.0)),
+                'count': self.count, 'trips': self.trips,
+                'last_value': self.last_value
+                if self.last_value is None
+                or math.isfinite(self.last_value)
+                else repr(self.last_value)}
+
+
+class AnomalyMonitor(object):
+    """Detector-per-signal registry; detectors materialize lazily with
+    DEFAULT_SIGNALS tuning (or the defaults for unlisted signals)."""
+
+    def __init__(self, signal_config=None):
+        self._lock = threading.Lock()
+        self._detectors = {}
+        self._config = dict(DEFAULT_SIGNALS)
+        if signal_config:
+            self._config.update(signal_config)
+
+    def detector(self, signal):
+        with self._lock:
+            d = self._detectors.get(signal)
+            if d is None:
+                d = self._detectors[signal] = EwmaDetector(
+                    **self._config.get(signal, {}))
+            return d
+
+    def observe(self, signal, value):
+        """-> (score, transitioned, tripped) for this sample."""
+        d = self.detector(signal)
+        with self._lock:
+            score, transitioned = d.observe(value)
+            return score, transitioned, d.tripped
+
+    def tripped(self):
+        """Sorted names of currently-tripped signals."""
+        with self._lock:
+            return sorted(n for n, d in self._detectors.items()
+                          if d.tripped)
+
+    def state(self):
+        """{signal: detector state dict} — /statusz and postmortems."""
+        with self._lock:
+            return {n: d.state() for n, d in
+                    sorted(self._detectors.items())}
+
+    def reset(self):
+        with self._lock:
+            self._detectors = {}

@@ -1,0 +1,289 @@
+"""Live diagnostics HTTP server: scrape a running process instead of
+waiting for its JSONL.
+
+Stdlib-only (``http.server`` in a daemon thread), off by default, and
+started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
+(picked up by ``observe.enable_from_env()``). Routes:
+
+    /metrics   Prometheus text exposition of the whole registry
+               (counters, gauges, histogram count/sum + quantiles)
+    /varz      the observe.snapshot() dict as JSON (exact values,
+               host/pid tagged — the JSONL line shape, live)
+    /statusz   run headline JSON: uptime, process_index, executor
+               compile-cache per-key hit/miss/compile-seconds, trainer
+               in-flight pipeline depth, MFU/goodput, anomaly state,
+               flight-recorder occupancy, health results
+    /tracez    last N completed spans as JSON (?n=200)
+    /healthz   200 ok / 503 degraded from the liveness health checks
+               plus the anomaly monitor (degraded while any detector
+               is tripped)
+    /readyz    same, but ALL checks including readiness-only ones
+               (ServingEngine registers its ready() here on start())
+
+Health checks are pluggable: ``observe.register_health_check(name, fn)``
+where ``fn()`` returns truthy/falsy or ``(ok, detail)``. Checks
+registered with ``readiness_only=True`` gate /readyz but not /healthz
+(an engine that has not warmed up yet is unready, not unhealthy).
+
+The server only reads shared state under the registry's own locks; it
+adds zero work to instrumented call sites — the hot-path contract
+stays one ``enabled()`` boolean read, server or no server.
+"""
+
+import http.server
+import json
+import threading
+import time
+
+from .registry import parse_rendered, prometheus_exposition
+
+__all__ = ['DiagnosticsServer', 'start', 'stop', 'active',
+           'register_health_check', 'unregister_health_check',
+           'run_health_checks']
+
+_lock = threading.Lock()
+_server = None          # the active DiagnosticsServer, if any
+
+_checks_lock = threading.Lock()
+_checks = {}            # name -> (fn, readiness_only)
+
+
+# ------------------------------------------------------- health checks
+def register_health_check(name, fn, readiness_only=False):
+    """Register ``fn`` under ``name``. ``fn()`` returns truthy/falsy or
+    ``(ok, detail)``; raising counts as failing. ``readiness_only``
+    checks gate /readyz but not /healthz. Re-registering a name
+    replaces it."""
+    if not callable(fn):
+        raise TypeError('health check %r is not callable' % name)
+    with _checks_lock:
+        _checks[str(name)] = (fn, bool(readiness_only))
+
+
+def unregister_health_check(name):
+    with _checks_lock:
+        _checks.pop(str(name), None)
+
+
+def run_health_checks(include_readiness=False):
+    """(all_ok, {name: {'ok', 'detail'}}) — always includes the built-in
+    ``anomaly`` pseudo-check (degraded while any detector is tripped)."""
+    from . import anomaly_tripped
+    with _checks_lock:
+        items = sorted(_checks.items())
+    results = {}
+    all_ok = True
+    for name, (fn, readiness_only) in items:
+        if readiness_only and not include_readiness:
+            continue
+        try:
+            r = fn()
+            if isinstance(r, tuple):
+                ok, detail = bool(r[0]), r[1]
+            else:
+                ok, detail = bool(r), None
+        except Exception as e:
+            ok, detail = False, '%s: %s' % (type(e).__name__, e)
+        results[name] = {'ok': ok, 'detail': detail}
+        all_ok = all_ok and ok
+    tripped = anomaly_tripped()
+    results['anomaly'] = {
+        'ok': not tripped,
+        'detail': ('tripped: %s' % ', '.join(tripped)) if tripped
+        else None}
+    return all_ok and not tripped, results
+
+
+# ------------------------------------------------------------- payloads
+def _executor_cache_table(snap):
+    """Per-compile-cache-key hit/miss/seconds table from the registry's
+    executor.* metrics (key = observe.key_id of the full cache key)."""
+    table = {}
+
+    def ent(key):
+        return table.setdefault(key or '', {
+            'kind': None, 'hits': 0, 'misses': 0,
+            'trace_seconds': None, 'compile_seconds': None,
+            'first_dispatch_seconds': None})
+
+    for rendered, v in snap.get('counters', {}).items():
+        name, labels = parse_rendered(rendered)
+        if name == 'executor.cache_hit_total':
+            e = ent(labels.get('key'))
+            e['hits'] += v
+            e['kind'] = labels.get('kind', e['kind'])
+        elif name == 'executor.cache_miss_total':
+            e = ent(labels.get('key'))
+            e['misses'] += v
+            e['kind'] = labels.get('kind', e['kind'])
+    for rendered, st in snap.get('histograms', {}).items():
+        name, labels = parse_rendered(rendered)
+        if name in ('executor.trace_seconds', 'executor.compile_seconds',
+                    'executor.first_dispatch_seconds'):
+            key = labels.get('key')
+            if key in table:
+                table[key][name.split('.', 1)[1]] = st.get('sum')
+    return table
+
+
+def _statusz_doc():
+    from . import (anomaly_state, enabled, flight_dump_path,
+                   flight_recorder, goodput, snapshot)
+    snap = snapshot()
+    gauges = snap.get('gauges', {})
+    fr = flight_recorder()
+    total, evicted = fr.counts()
+    with _lock:
+        srv = _server
+    ok, checks = run_health_checks(include_readiness=True)
+    return {
+        'uptime_seconds': round(time.time() - fr.started_at, 3),
+        'pid': snap.get('pid'),
+        'process_index': snap.get('host'),
+        'telemetry_enabled': enabled(),
+        'server': ({'host': srv.host, 'port': srv.port}
+                   if srv is not None else None),
+        'goodput': goodput(),
+        'mfu': gauges.get('trainer.mfu'),
+        'steps_per_sec_ema': gauges.get('trainer.steps_per_sec_ema'),
+        'steps_total': snap.get('counters', {}).get('trainer.steps_total'),
+        'inflight_depth': gauges.get('trainer.inflight_depth'),
+        'prefetch_queue_depth':
+            gauges.get('trainer.prefetch_queue_depth'),
+        'executor_cache': _executor_cache_table(snap),
+        'anomalies': anomaly_state(),
+        'flight': {'events': total, 'evicted': evicted,
+                   'capacity': fr.capacity,
+                   'dump_path': flight_dump_path()},
+        'healthy': ok,
+        'health': checks,
+    }
+
+
+def _tracez_doc(query):
+    from . import spans
+    try:
+        n = int(dict(p.split('=', 1) for p in query.split('&')
+                     if '=' in p).get('n', 200))
+    except Exception:
+        n = 200
+    rec = spans()
+    evs = rec.events()
+    return {'spans': evs[-max(1, n):], 'recorded': len(evs),
+            'dropped': getattr(rec, '_dropped', 0)}
+
+
+_INDEX = """paddle_tpu diagnostics server
+/metrics   Prometheus exposition of the metrics registry
+/varz      observe.snapshot() as JSON
+/statusz   run headline: uptime, cache keys, pipeline depth, MFU/goodput
+/tracez    last completed spans (?n=200)
+/healthz   liveness (503 while degraded / anomaly tripped)
+/readyz    readiness (all checks incl. readiness-only)
+"""
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = 'paddle-tpu-diagnostics'
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, fmt, *args):   # stay silent on stderr
+        pass
+
+    def _send(self, code, body, ctype='application/json'):
+        data = body.encode('utf-8')
+        self.send_response(code)
+        self.send_header('Content-Type', ctype + '; charset=utf-8')
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        from . import snapshot
+        path, _, query = self.path.partition('?')
+        try:
+            if path in ('/', '/help'):
+                self._send(200, _INDEX, ctype='text/plain')
+            elif path == '/metrics':
+                self._send(200, prometheus_exposition(snapshot()),
+                           ctype='text/plain; version=0.0.4')
+            elif path == '/varz':
+                self._send(200, json.dumps(snapshot(), sort_keys=True,
+                                           default=str))
+            elif path == '/statusz':
+                self._send(200, json.dumps(_statusz_doc(),
+                                           sort_keys=True, default=str))
+            elif path == '/tracez':
+                self._send(200, json.dumps(_tracez_doc(query),
+                                           default=str))
+            elif path in ('/healthz', '/readyz'):
+                ok, checks = run_health_checks(
+                    include_readiness=(path == '/readyz'))
+                self._send(200 if ok else 503, json.dumps(
+                    {'status': 'ok' if ok else 'degraded',
+                     'checks': checks}, sort_keys=True, default=str))
+            else:
+                self._send(404, json.dumps({'error': 'no route %s' % path,
+                                            'routes': ['/metrics', '/varz',
+                                                       '/statusz',
+                                                       '/tracez',
+                                                       '/healthz',
+                                                       '/readyz']}))
+        except Exception as e:   # never kill the serving thread
+            try:
+                self._send(500, json.dumps(
+                    {'error': '%s: %s' % (type(e).__name__, e)}))
+            except Exception:
+                pass
+
+
+class _ThreadingServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class DiagnosticsServer(object):
+    """Handle on the running server: .host/.port/.url, close()."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+        self.url = 'http://%s:%d' % (self.host, self.port)
+
+    def close(self):
+        stop()
+
+
+def start(host='127.0.0.1', port=0):
+    """Start the server (idempotent: a second call returns the running
+    instance). port=0 binds an ephemeral port — read it back from the
+    returned object's .port."""
+    global _server
+    with _lock:
+        if _server is not None:
+            return _server
+        httpd = _ThreadingServer((host, int(port)), _Handler)
+        t = threading.Thread(target=httpd.serve_forever,
+                             kwargs={'poll_interval': 0.2},
+                             daemon=True,
+                             name='paddle_tpu_diagnostics')
+        t.start()
+        _server = DiagnosticsServer(httpd, t)
+        return _server
+
+
+def stop():
+    """Shut the server down and release the port (no-op when stopped)."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv._httpd.shutdown()
+        srv._httpd.server_close()
+        srv._thread.join(timeout=5)
+
+
+def active():
+    with _lock:
+        return _server

@@ -23,10 +23,13 @@ Design points:
 """
 
 import json
+import math
 import random
+import re
 import threading
 
-__all__ = ['Counter', 'Gauge', 'Histogram', 'Registry', 'RESERVOIR_CAP']
+__all__ = ['Counter', 'Gauge', 'Histogram', 'Registry', 'RESERVOIR_CAP',
+           'parse_rendered', 'prometheus_exposition']
 
 RESERVOIR_CAP = 4096
 
@@ -40,6 +43,109 @@ def _render(name, label_key):
         return name
     return '%s{%s}' % (name, ','.join('%s=%s' % (k, v)
                                       for k, v in label_key))
+
+
+def parse_rendered(rendered):
+    """Inverse of the snapshot naming: ``name{k=v,k2=v2}`` ->
+    ``(name, {k: v})`` (label values come back as strings)."""
+    if '{' not in rendered:
+        return rendered, {}
+    name, _, rest = rendered.partition('{')
+    labels = {}
+    for part in rest.rstrip('}').split(','):
+        if not part:
+            continue
+        k, _, v = part.partition('=')
+        labels[k] = v
+    return name, labels
+
+
+# ------------------------------------------- Prometheus text exposition
+# Pure functions over the snapshot() dict shape, so the same renderer
+# serves the live /metrics endpoint AND tools/metrics_report.py --prom
+# converting an on-disk JSONL record (which is the same shape).
+_PROM_BAD = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _prom_name(name):
+    n = _PROM_BAD.sub('_', name)
+    if n and n[0].isdigit():
+        n = '_' + n
+    return n
+
+
+def _prom_labels(labels):
+    if not labels:
+        return ''
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace('\\', '\\\\').replace('"', '\\"') \
+            .replace('\n', '\\n')
+        parts.append('%s="%s"' % (_prom_name(k), v))
+    return '{%s}' % ','.join(parts)
+
+
+def _prom_num(v):
+    if isinstance(v, bool):
+        return '1' if v else '0'
+    if isinstance(v, int):
+        return str(v)
+    v = float(v)
+    if math.isnan(v):
+        return 'NaN'
+    if math.isinf(v):
+        return '+Inf' if v > 0 else '-Inf'
+    return format(v, '.10g')
+
+
+def prometheus_exposition(snapshot):
+    """Render a Registry.snapshot()-shaped dict as Prometheus text
+    exposition (format 0.0.4). Counters and gauges map directly (metric
+    names mangled to the legal charset: dots become underscores);
+    histograms render as summaries — ``{quantile="0.5|0.9|0.95|0.99"}``
+    series from the reservoir plus exact ``_sum``/``_count``. Extra
+    snapshot keys (ts/pid/host/kind) are ignored."""
+    lines = []
+    for kind, prom_type in (('counters', 'counter'), ('gauges', 'gauge')):
+        grouped = {}
+        for rendered, v in snapshot.get(kind, {}).items():
+            if not isinstance(v, (int, float)):
+                continue
+            name, labels = parse_rendered(rendered)
+            grouped.setdefault(name, []).append((labels, v))
+        for name in sorted(grouped):
+            pn = _prom_name(name)
+            lines.append('# TYPE %s %s' % (pn, prom_type))
+            for labels, v in sorted(grouped[name],
+                                    key=lambda lv: sorted(lv[0].items())):
+                lines.append('%s%s %s'
+                             % (pn, _prom_labels(labels), _prom_num(v)))
+    grouped = {}
+    for rendered, st in snapshot.get('histograms', {}).items():
+        if not isinstance(st, dict):
+            continue
+        name, labels = parse_rendered(rendered)
+        grouped.setdefault(name, []).append((labels, st))
+    for name in sorted(grouped):
+        pn = _prom_name(name)
+        lines.append('# TYPE %s summary' % pn)
+        for labels, st in sorted(grouped[name],
+                                 key=lambda lv: sorted(lv[0].items())):
+            for q, key in (('0.5', 'p50'), ('0.9', 'p90'),
+                           ('0.95', 'p95'), ('0.99', 'p99')):
+                v = st.get(key)
+                if v is None:
+                    continue
+                ql = dict(labels)
+                ql['quantile'] = q
+                lines.append('%s%s %s'
+                             % (pn, _prom_labels(ql), _prom_num(v)))
+            lines.append('%s_sum%s %s' % (pn, _prom_labels(labels),
+                                          _prom_num(st.get('sum') or 0.0)))
+            lines.append('%s_count%s %s'
+                         % (pn, _prom_labels(labels),
+                            _prom_num(int(st.get('count') or 0))))
+    return '\n'.join(lines) + '\n'
 
 
 class _Metric(object):

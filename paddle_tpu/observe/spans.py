@@ -42,6 +42,10 @@ class SpanRecorder(object):
         self._lock = threading.Lock()
         self._events = []
         self._dropped = 0
+        # observe.__init__ points this at the registry's
+        # spans_dropped_total counter, so a truncated trace is visible
+        # from /metrics alone (not just the trace-file metadata)
+        self.on_drop = None
         self._tls = threading.local()
         # one zero point for the whole recorder: perf_counter deltas
         # anchored to an epoch timestamp so ts is meaningful across
@@ -90,8 +94,15 @@ class SpanRecorder(object):
         with self._lock:
             if len(self._events) < MAX_EVENTS:
                 self._events.append(ev)
+                cb = None
             else:
                 self._dropped += 1
+                cb = self.on_drop
+        if cb is not None:
+            try:
+                cb(1)
+            except Exception:
+                pass
 
     def depth(self):
         return len(getattr(self._tls, 'stack', ()) or ())

@@ -143,6 +143,8 @@ def barrier(tag, timeout=None):
         t.join(timeout)
     if t.is_alive():
         _obs.inc('multihost.barrier_timeout_total', tag=tag)
+        _obs.flight_event('barrier_timeout', tag=tag,
+                          timeout_seconds=timeout)
         raise TimeoutError(
             'barrier %r: pod sync did not complete within %.0fs — a peer '
             'host likely died or was preempted mid-checkpoint; restart '

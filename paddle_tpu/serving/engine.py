@@ -26,6 +26,7 @@ the compile-signature set as much as to raise throughput.
 """
 
 import collections
+import itertools
 import queue as _queue
 import threading
 import time
@@ -38,6 +39,8 @@ from .. import observe as _obs
 from .buckets import BucketLadder
 
 __all__ = ['ServingEngine', 'QueueFullError', 'EngineClosedError']
+
+_ENGINE_IDS = itertools.count(1)   # unique /readyz check name per engine
 
 
 class QueueFullError(RuntimeError):
@@ -113,7 +116,9 @@ class ServingEngine(object):
         self._closed = False
         self._draining = False
         self._started = False
+        self._warmed = False
         self._threads = []
+        self._health_name = None
         self.warmup_signatures = 0
 
     # ------------------------------------------------------------ intake
@@ -159,6 +164,9 @@ class ServingEngine(object):
                 if len(self._pending) >= self.max_queue_depth:
                     _obs.inc('serving.rejected_total',
                              reason='queue_full')
+                    _obs.flight_event('serving_rejected',
+                                      reason='queue_full',
+                                      queue_depth=len(self._pending))
                     raise QueueFullError(
                         'serving queue full (%d waiting >= '
                         'max_queue_depth=%d)'
@@ -178,8 +186,19 @@ class ServingEngine(object):
         return self.submit(feed).result(timeout)
 
     # ---------------------------------------------------------- lifecycle
+    def ready(self):
+        """Load-balancer readiness: True only once start() ran AND
+        warmup() completed (every live request is a guaranteed cache
+        hit), and False again the moment shutdown/drain begins — a
+        balancer honoring this never routes to an engine that would
+        pay an XLA compile or drop the request on the floor."""
+        return bool(self._started and self._warmed
+                    and not self._closed and not self._draining)
+
     def start(self):
-        """Launch the batcher and dispatch threads (idempotent)."""
+        """Launch the batcher and dispatch threads (idempotent).
+        Registers ready() as a /readyz check on the diagnostics server's
+        health registry (observe.serve exposes it)."""
         with self._mu:
             if self._closed:
                 raise EngineClosedError('ServingEngine is shut down')
@@ -192,7 +211,20 @@ class ServingEngine(object):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
+        self._health_name = 'serving.engine%d' % next(_ENGINE_IDS)
+        _obs.register_health_check(self._health_name, self._ready_check,
+                                   readiness_only=True)
         return self
+
+    def _ready_check(self):
+        r = self.ready()
+        if r:
+            return True, None
+        if not self._warmed:
+            return False, 'not warmed up'
+        if self._closed or self._draining:
+            return False, 'shutting down'
+        return False, 'not started'
 
     def warmup(self, example=None):
         """AOT-precompile EVERY ladder signature by dispatching one
@@ -223,6 +255,7 @@ class ServingEngine(object):
                         time.perf_counter() - t0, batch=b,
                         seq=s if s is not None else '')
         self.warmup_signatures = len(sigs)
+        self._warmed = True
         _obs.set_gauge('serving.warmup_signatures', len(sigs))
         _obs.set_gauge('serving.warmup_total_seconds',
                        time.perf_counter() - t_all)
@@ -273,6 +306,9 @@ class ServingEngine(object):
             self._closed = True
             self._draining = drain
             self._mu.notify_all()
+        if self._health_name is not None:
+            _obs.unregister_health_check(self._health_name)
+            self._health_name = None
         if not drain or not self._started:
             self._fail_pending(EngineClosedError(
                 'ServingEngine shut down without draining'))

@@ -196,7 +196,25 @@ class Trainer(object):
         event_handler = event_handler or (lambda e: None)
         self._event_handler = event_handler
         _inject.install_from_env()
+        # crash forensics: PADDLE_TPU_FLIGHT_DUMP arms the flight
+        # recorder (and a SIGTERM postmortem) even with metrics off, so
+        # a preempted run leaves its last seconds behind
+        _obs.arm_flight_from_env()
         _obs.run_begin()
+        try:
+            self._train_impl(num_epochs, event_handler, reader,
+                             feed_order, feeder, steps_per_dispatch,
+                             pipeline_depth, host_prefetch,
+                             stacked_windows)
+        except BaseException as e:
+            _obs.flight_event('train_exception', error=type(e).__name__,
+                              step=self._step)
+            _obs.flight_dump('trainer_exception', exc=e)
+            raise
+
+    def _train_impl(self, num_epochs, event_handler, reader, feed_order,
+                    feeder, steps_per_dispatch, pipeline_depth,
+                    host_prefetch, stacked_windows):
         from .reader.state import CheckpointableReader
         self._ckpt_reader = (reader if isinstance(reader,
                                                   CheckpointableReader)
@@ -471,6 +489,17 @@ class Trainer(object):
                 # the host sat here waiting on the device
                 _obs.add_gauge('trainer.device_blocked_seconds', r1 - r0)
         self._step += ent.steps
+        loss_val = None
+        if _obs.enabled():
+            # leading indicator: z-score the fetched loss against its
+            # EWMA baseline BEFORE the guard's NaN postcondition runs
+            try:
+                loss_val = float(np.mean(
+                    np.asarray(metrics[0], dtype=np.float64)))
+            except (TypeError, ValueError):
+                pass
+            if loss_val is not None:
+                _obs.anomaly('loss', loss_val)
         g = self._guard
         verdict = 'ok'
         if g is not None:
@@ -498,6 +527,15 @@ class Trainer(object):
         self._record_step(wall, ent.t1 - ent.t0, r1 - r0, verdict,
                           steps=ent.steps,
                           cache_miss=ent.handle.cache_miss)
+        if loss_val is not None:
+            _obs.flight_event('step_end', step=self._step,
+                              epoch=ent.epoch, steps=ent.steps,
+                              verdict=verdict, wall=round(wall, 6),
+                              loss=loss_val)
+        else:
+            _obs.flight_event('step_end', step=self._step,
+                              epoch=ent.epoch, steps=ent.steps,
+                              verdict=verdict, wall=round(wall, 6))
         telemetry = _obs.step_telemetry() if _obs.enabled() else None
         if ent.steps == 1:
             handler(EndStepEvent(ent.epoch, ent.step0, metrics,
@@ -618,8 +656,10 @@ class Trainer(object):
                         phase='compute')
             _obs.record('trainer.phase_seconds', fetch_s, phase='fetch')
         per_step = wall / steps
+        _obs.inc('trainer.steps_total', steps)
         _obs.record('trainer.step_seconds', per_step)
         _obs.set_gauge('trainer.step_seconds_last', per_step)
+        _obs.anomaly('step_time', per_step)
         rate = steps / wall if wall > 0 else 0.0
         prev = _obs.get_gauge('trainer.steps_per_sec_ema')
         _obs.set_gauge('trainer.steps_per_sec_ema',

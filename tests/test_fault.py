@@ -4,6 +4,7 @@ fallback, NaN-policy matrix, reader.retry, and the subprocess
 crash/resume e2e proving bit-identical final params (reference analog:
 go/master/service.go's etcd task-queue recovery, rebuilt masterless)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -307,7 +308,7 @@ def test_resume_noop_on_empty_tree(tmp_path):
 def _run_child(tmp, tag, extra_env, reuse_ckpt=None):
     env = dict(os.environ)
     for k in ('PADDLE_TPU_FI_KILL_AT_STEP', 'PADDLE_TPU_FI_CORRUPT_CKPT_AT',
-              'XLA_FLAGS'):
+              'PADDLE_TPU_FLIGHT_DUMP', 'XLA_FLAGS'):
         env.pop(k, None)
     env['JAX_PLATFORMS'] = 'cpu'
     env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
@@ -338,12 +339,33 @@ def _assert_bit_identical(a, b):
 
 
 def test_e2e_kill_and_resume_bit_identical(tmp_path, clean_run):
-    # run killed mid-epoch at injected step 7 (12 steps/epoch)
+    # run killed mid-epoch at injected step 7 (12 steps/epoch); the
+    # armed flight recorder must leave a postmortem behind
+    pm = os.path.join(str(tmp_path), 'postmortem.json')
     p, ckpt, out = _run_child(tmp_path, 'killed',
-                              {'PADDLE_TPU_FI_KILL_AT_STEP': '7'})
+                              {'PADDLE_TPU_FI_KILL_AT_STEP': '7',
+                               'PADDLE_TPU_FLIGHT_DUMP': pm})
     assert p.returncode == inject.KILL_EXIT_CODE, (p.returncode, p.stderr)
     assert not os.path.exists(out)      # died before finishing
     assert os.path.isdir(ckpt)          # ...but left checkpoints behind
+    # kill-mid-step postmortem: exists, parses, explains the death, and
+    # every recorded step end precedes (or is) the kill step
+    with open(pm) as f:
+        doc = json.load(f)
+    assert doc['kind'] == 'paddle_tpu_postmortem' and doc['schema'] == 1
+    assert doc['reason'] == 'fault_injection_kill'
+    evs = doc['events']
+    assert evs and evs[-1]['kind'] == 'kill'
+    assert evs[-1]['data']['kill_at_step'] == 7
+    steps = [e['data']['step'] for e in evs if e['kind'] == 'step_end']
+    assert steps and max(steps) <= 7
+    assert any(e['kind'] == 'checkpoint_save' for e in evs)
+    # ...and tools/flight_report.py renders it without error
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'flight_report.py'),
+         pm], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert 'fault_injection_kill' in r.stdout
     # restart WITHOUT the fault env: resume=True picks up the newest
     # complete checkpoint and finishes the job
     p, _, out = _run_child(tmp_path, 'resumed', {}, reuse_ckpt=ckpt)

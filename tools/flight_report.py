@@ -1,0 +1,175 @@
+"""Render a paddle_tpu flight-recorder postmortem as a timeline.
+
+Reads the JSON written by the flight recorder on the way down
+(``observe.flight_dump`` — wired into the trainer's exception path, the
+bad-step guards, SIGTERM, and the fault-injection kill; arm it with
+``PADDLE_TPU_FLIGHT_DUMP=/path/postmortem.json``) and prints what the
+process was doing in its final seconds: the event timeline with
+inter-event deltas, loss deltas between consecutive step ends, the
+anomaly-detector state at death, and the final metrics headline.
+
+    python tools/flight_report.py postmortem.json
+    python tools/flight_report.py postmortem.json --events 30
+    python tools/flight_report.py postmortem.json --json | jq .reason
+
+Companion of ``tools/metrics_report.py`` (the whole-run metrics JSONL
+view); the postmortem's ``metrics`` field is one snapshot of the same
+registry shape, frozen at death.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get('kind') != 'paddle_tpu_postmortem':
+        raise ValueError('%s is not a paddle_tpu postmortem (kind=%r)'
+                         % (path, doc.get('kind')))
+    return doc
+
+
+def _fmt_data(data):
+    if not data:
+        return ''
+    return ' '.join('%s=%s' % (k, data[k]) for k in sorted(data))
+
+
+def _event_lines(events, limit):
+    """Timeline rows: relative/delta timestamps plus Δloss between
+    consecutive step_end events (the dying run's trajectory)."""
+    shown = events[-limit:] if limit else list(events)
+    lines = []
+    t_first = shown[0]['ts'] if shown else 0.0
+    prev_ts = None
+    prev_loss = None
+    for ev in shown:
+        dt = '' if prev_ts is None else '(+%.3fs)' % (ev['ts'] - prev_ts)
+        prev_ts = ev['ts']
+        data = dict(ev.get('data') or {})
+        extra = ''
+        if ev.get('kind') == 'step_end':
+            loss = data.get('loss')
+            if isinstance(loss, (int, float)):
+                if isinstance(prev_loss, (int, float)):
+                    extra = '  Δloss=%+.4g' % (loss - prev_loss)
+                prev_loss = loss
+        lines.append('  %+9.3fs %-10s %-18s %s%s'
+                     % (ev['ts'] - t_first, dt, ev.get('kind', '?'),
+                        _fmt_data(data), extra))
+    return lines
+
+
+def _headline_metrics(metrics):
+    g = metrics.get('gauges', {})
+    c = metrics.get('counters', {})
+    parts = []
+    for label, val, fmt in (
+            ('steps', c.get('trainer.steps_total'), '%d'),
+            ('goodput', g.get('run.goodput'), '%.2f'),
+            ('mfu', g.get('trainer.mfu'), '%.2f'),
+            ('steps/s', g.get('trainer.steps_per_sec_ema'), '%.4g'),
+            ('bad_steps', c.get('fault.bad_steps_total'), '%d'),
+            ('saves', c.get('fault.checkpoint_saves_total'), '%d')):
+        if val is not None:
+            try:
+                parts.append('%s %s' % (label, fmt % val))
+            except TypeError:
+                parts.append('%s %s' % (label, val))
+    return ', '.join(parts)
+
+
+def render(doc, limit=40):
+    lines = []
+    lines.append('== paddle_tpu postmortem — reason: %s (pid %s, host %s)'
+                 % (doc.get('reason'), doc.get('pid'), doc.get('host')))
+    lines.append('   dumped at ts %s after %.3fs up; schema %s'
+                 % (doc.get('ts'), doc.get('uptime_seconds') or 0.0,
+                    doc.get('schema')))
+    exc = doc.get('exception')
+    if exc:
+        lines.append('   exception: %s: %s'
+                     % (exc.get('type'), exc.get('message')))
+    head = _headline_metrics(doc.get('metrics') or {})
+    if head:
+        lines.append('   final metrics: %s' % head)
+    anomalies = doc.get('anomalies') or {}
+    if anomalies:
+        lines.append('anomaly state at death:')
+        for sig in sorted(anomalies):
+            st = anomalies[sig]
+            lines.append('  %-12s score %-10.4g %s (mean %.4g, n=%s)'
+                         % (sig, st.get('score') or 0.0,
+                            'TRIPPED' if st.get('tripped') else 'ok',
+                            st.get('mean') or 0.0, st.get('count')))
+    events = doc.get('events') or []
+    total = doc.get('events_total', len(events))
+    evicted = doc.get('evicted_events', 0)
+    shown = min(limit or len(events), len(events))
+    lines.append('timeline (last %d of %d events%s):'
+                 % (shown, total,
+                    ', %d evicted from the ring' % evicted
+                    if evicted else ''))
+    if events:
+        lines.extend(_event_lines(events, limit))
+    else:
+        lines.append('  (no events recorded)')
+    return '\n'.join(lines)
+
+
+def summarize(doc):
+    """Machine-readable --json view."""
+    events = doc.get('events') or []
+    anomalies = doc.get('anomalies') or {}
+    exc = doc.get('exception') or {}
+    return {
+        'reason': doc.get('reason'),
+        'pid': doc.get('pid'),
+        'host': doc.get('host'),
+        'ts': doc.get('ts'),
+        'uptime_seconds': doc.get('uptime_seconds'),
+        'exception_type': exc.get('type'),
+        'exception_message': exc.get('message'),
+        'events_total': doc.get('events_total', len(events)),
+        'evicted_events': doc.get('evicted_events', 0),
+        'last_event': events[-1] if events else None,
+        'last_step': max(
+            [e['data']['step'] for e in events
+             if e.get('kind') == 'step_end'
+             and isinstance((e.get('data') or {}).get('step'), int)]
+            or [None], key=lambda v: -1 if v is None else v),
+        'tripped': sorted(s for s, st in anomalies.items()
+                          if st.get('tripped')),
+        'metrics': doc.get('metrics') or {},
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Render a paddle_tpu flight-recorder postmortem '
+                    'JSON as a timeline of the final events.',
+        epilog='See tools/metrics_report.py for the whole-run metrics '
+               'JSONL view.')
+    p.add_argument('path', help='postmortem JSON '
+                               '(observe.flight_dump output)')
+    p.add_argument('--events', type=int, default=40, metavar='N',
+                   help='show the last N events (default 40; 0 = all)')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON object')
+    args = p.parse_args(argv)
+    try:
+        doc = load(args.path)
+    except (OSError, ValueError) as e:
+        sys.stderr.write('flight_report: %s\n' % e)
+        return 1
+    if args.json:
+        print(json.dumps(summarize(doc), sort_keys=True, default=str))
+    else:
+        print(render(doc, limit=args.events))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -10,14 +10,36 @@ histogram, final counter/gauge values, and the MFU/goodput headline.
 
 By default the newest ``kind: "summary"`` line is reported (the
 end-of-run state); ``--all-pids`` reports the newest summary per pid,
-``--snapshot`` takes the newest line of any kind. ``--json`` emits one
-machine-readable object for scripting — a fast test exercises both
-paths so this tool cannot bit-rot.
+``--per-host`` per host (merged multihost JSONLs — records carry a
+``host`` = jax.process_index() field), ``--snapshot`` takes the newest
+line of any kind. ``--json`` emits one machine-readable object for
+scripting, and ``--prom`` converts the chosen record to Prometheus
+text exposition (drop it in a node_exporter textfile-collector dir and
+offline runs feed the same dashboards as live ``/metrics`` scrapes) —
+fast tests exercise all three paths so this tool cannot bit-rot.
+
+See ``tools/flight_report.py`` for the crash-forensics companion (the
+flight recorder's postmortem JSON).
 """
 
 import argparse
 import json
+import os
 import sys
+
+
+def _registry_mod():
+    """paddle_tpu/observe/registry.py loaded standalone (stdlib-only
+    module; importing it via the package would drag in jax)."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'paddle_tpu', 'observe', 'registry.py')
+    spec = importlib.util.spec_from_file_location(
+        '_paddle_tpu_observe_registry', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load_records(path):
@@ -52,6 +74,7 @@ def derive(rec):
     out = {
         'ts': rec.get('ts'),
         'pid': rec.get('pid'),
+        'host': rec.get('host', 0),
         'kind': rec.get('kind'),
         'counters': rec.get('counters', {}),
         'gauges': gauges,
@@ -99,8 +122,8 @@ def render(rec):
         head.append('overlap %.2f%%' % (100.0 * d['overlap_fraction']))
     if d['step_flops'] is not None:
         head.append('%.4g FLOPs/step' % d['step_flops'])
-    lines.append('== %s (pid %s, ts %s) %s' % (
-        d['kind'] or 'record', d['pid'], d['ts'],
+    lines.append('== %s (host %s, pid %s, ts %s) %s' % (
+        d['kind'] or 'record', d['host'], d['pid'], d['ts'],
         ('— ' + ', '.join(head)) if head else ''))
     hists = d['histograms']
     if hists:
@@ -136,19 +159,32 @@ def main(argv=None):
     p.add_argument('--all-pids', action='store_true',
                    help='report the newest record per pid (multi-child '
                         'bench runs)')
+    p.add_argument('--per-host', action='store_true',
+                   help='report the newest record per host '
+                        '(jax.process_index() — merged multihost '
+                        'JSONLs)')
+    p.add_argument('--prom', action='store_true',
+                   help='emit the chosen record(s) as Prometheus text '
+                        'exposition (textfile-collector format)')
     args = p.parse_args(argv)
+    if args.json and args.prom:
+        sys.stderr.write('metrics_report: --json and --prom are '
+                         'mutually exclusive\n')
+        return 2
 
     records = load_records(args.path)
     if not records:
         sys.stderr.write('metrics_report: no parseable records in %s\n'
                          % args.path)
         return 1
-    if args.all_pids:
-        by_pid = {}
+    if args.all_pids or args.per_host:
+        group_key = (lambda r: r.get('host', 0)) if args.per_host \
+            else (lambda r: r.get('pid'))
+        by_key = {}
         for r in records:
             if args.snapshot or r.get('kind') == 'summary':
-                by_pid[r.get('pid')] = r
-        chosen = [by_pid[k] for k in sorted(by_pid, key=str)] \
+                by_key[group_key(r)] = r
+        chosen = [by_key[k] for k in sorted(by_key, key=str)] \
             or [records[-1]]
     else:
         chosen = [pick(records, any_kind=args.snapshot)]
@@ -157,6 +193,9 @@ def main(argv=None):
         if args.json:
             docs = [derive(r) for r in chosen]
             print(json.dumps(docs[0] if len(docs) == 1 else docs))
+        elif args.prom:
+            expo = _registry_mod().prometheus_exposition
+            sys.stdout.write(''.join(expo(r) for r in chosen))
         else:
             print('\n\n'.join(render(r) for r in chosen))
     except BrokenPipeError:      # `... | head` is a normal way to use this
