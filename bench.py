@@ -454,6 +454,84 @@ def bench_pipeline_ablation(model='transformer', steps=20, batch=None,
     return out
 
 
+def bench_decode(duration=8.0, clients=8, max_batch=16, block_size=32,
+                 num_blocks=512, pages_per_seq=16, vocab=8000, n_layer=4,
+                 n_head=8, d_model=256, d_inner=512, prompt_lo=16,
+                 prompt_hi=64, max_new=64):
+    """Decode-serving scenario: continuous batching + paged KV cache
+    (serving/decode) under closed-loop streaming clients. Reports
+    tokens/sec and inter-token latency; decode.* histograms (and a
+    decode.bench_tokens_per_s gauge) land in the metrics JSONL beside
+    the results store."""
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.serving.decode import DecodeEngine, LMSpec
+    from paddle_tpu.serving.loadgen import Stats, closed_loop, percentiles
+
+    d_head = max(8, d_model // n_head)
+    spec = LMSpec(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                  d_key=d_head, d_value=d_head, d_model=d_model,
+                  d_inner=d_inner)
+    engine = DecodeEngine(spec, max_batch=max_batch,
+                          block_size=block_size, num_blocks=num_blocks,
+                          pages_per_seq=pages_per_seq,
+                          max_queue_depth=4 * clients)
+    prompt_hi = min(prompt_hi, engine.capacity - max_new)
+    t_w0 = time.time()
+    signatures = engine.warmup()
+    warmup_s = time.time() - t_w0
+    engine.start()
+
+    stats = Stats()
+    gaps, tokens = [], [0]
+    mu = threading.Lock()
+
+    def do_request(rng):
+        plen = int(rng.randint(prompt_lo, prompt_hi + 1))
+        stream = engine.submit(rng.randint(0, vocab, plen).tolist(),
+                               max_new_tokens=max_new)
+        n, t_prev, local = 0, None, []
+        for _tok in stream:
+            now = time.perf_counter()
+            if t_prev is not None:
+                local.append(now - t_prev)
+            t_prev = now
+            n += 1
+        with mu:
+            gaps.extend(local)
+            tokens[0] += n
+        return n
+
+    t0 = time.perf_counter()
+    closed_loop(do_request, stats, t0 + duration, clients)
+    engine.shutdown(drain=True)
+    wall = time.perf_counter() - t0
+    snap = observe.snapshot()
+    occ = snap['histograms'].get('decode.batch_occupancy', {})
+    tps = tokens[0] / wall if wall else 0.0
+    observe.set_gauge('decode.bench_tokens_per_s', tps)
+    return {
+        'workload': 'decode_transformer',
+        'tokens_per_s': round(tps, 2),
+        'tokens': tokens[0],
+        'requests_ok': stats.ok,
+        'duration_s': round(wall, 3),
+        'inter_token_ms': percentiles(gaps),
+        'request_ms': percentiles(stats.latencies),
+        'batch_occupancy_mean': occ.get('mean'),
+        'preemptions': snap['counters'].get(
+            'decode.preemptions_total', 0),
+        'warmup': {'signatures': signatures,
+                   'seconds': round(warmup_s, 3)},
+        'engine': {'max_batch': max_batch, 'block_size': block_size,
+                   'num_blocks': num_blocks,
+                   'pages_per_seq': pages_per_seq},
+        'model': {'vocab': vocab, 'n_layer': n_layer, 'n_head': n_head,
+                  'd_model': d_model},
+    }
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -697,6 +775,14 @@ def _run_workload_child(workload, backend, reduced):
             kw = {}
         print('RESULT_JSON %s'
               % json.dumps(bench_pipeline_ablation(model, **kw)),
+              flush=True)
+        return
+    if workload == 'decode_transformer':
+        kw = dict(duration=2.0, clients=3, max_batch=4, block_size=8,
+                  num_blocks=64, pages_per_seq=8, vocab=512, n_layer=2,
+                  n_head=2, d_model=32, d_inner=64, prompt_lo=2,
+                  prompt_hi=16, max_new=16) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_decode(**kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':
@@ -1238,7 +1324,8 @@ if __name__ == '__main__':
                                 'pallas_parity', 'moe_cap1.0',
                                 'moe_cap1.25', 'moe_cap2.0',
                                 'pipeline_transformer',
-                                'pipeline_resnet50'])
+                                'pipeline_resnet50',
+                                'decode_transformer'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

@@ -11,7 +11,9 @@ started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
                host/pid tagged — the JSONL line shape, live)
     /statusz   run headline JSON: uptime, process_index, executor
                compile-cache per-key hit/miss/compile-seconds, trainer
-               in-flight pipeline depth, MFU/goodput, anomaly state,
+               in-flight pipeline depth, MFU/goodput, the decode-engine
+               panel (running/waiting sequences, KV-page occupancy,
+               preemption/token counters), anomaly state,
                flight-recorder occupancy, health results
     /tracez    last N completed spans as JSON (?n=200)
     /healthz   200 ok / 503 degraded from the liveness health checks
@@ -126,6 +128,36 @@ def _executor_cache_table(snap):
     return table
 
 
+def _decode_status(snap):
+    """Decode-engine panel (None when no decode.* metric exists):
+    running/waiting sequences, KV-page occupancy, preemption and token
+    counters — the live view of serving/decode's scheduler + pool."""
+    gauges = snap.get('gauges', {})
+    counters = snap.get('counters', {})
+    if not any(k.startswith('decode.')
+               for k in list(gauges) + list(counters)):
+        return None
+    finished = {}
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'decode.finished_total':
+            finished[labels.get('reason', '?')] = v
+    return {
+        'running_seqs': gauges.get('decode.running_seqs'),
+        'waiting_seqs': gauges.get('decode.waiting_seqs'),
+        'kv_blocks_free': gauges.get('decode.kv_blocks_free'),
+        'kv_blocks_total': gauges.get('decode.kv_blocks_total'),
+        'kv_block_occupancy': gauges.get('decode.kv_block_occupancy'),
+        'tokens_total': counters.get('decode.tokens_total'),
+        'steps_total': counters.get('decode.steps_total'),
+        'prefills_total': counters.get('decode.prefills_total'),
+        'preemptions_total': counters.get('decode.preemptions_total'),
+        'pool_exhausted_total':
+            counters.get('decode.pool_exhausted_total'),
+        'finished_total': finished,
+    }
+
+
 def _statusz_doc():
     from . import (anomaly_state, enabled, flight_dump_path,
                    flight_recorder, goodput, snapshot)
@@ -151,6 +183,7 @@ def _statusz_doc():
         'prefetch_queue_depth':
             gauges.get('trainer.prefetch_queue_depth'),
         'executor_cache': _executor_cache_table(snap),
+        'decode': _decode_status(snap),
         'anomalies': anomaly_state(),
         'flight': {'events': total, 'evicted': evicted,
                    'capacity': fr.capacity,

@@ -1,0 +1,187 @@
+"""Paged incremental-decode ops for the decode-serving engine.
+
+Two IR ops over a decoder-only (GPT-block) transformer whose KV cache
+lives in a paged pool (ops/pallas/paged_attention.py layouts):
+
+- ``paged_prefill`` — run ONE padded prompt [1, S] densely through the
+  stack (causal attention, fp32 softmax), write each position's K/V
+  into the sequence's pages through its block table, and emit the
+  first generated token. S is bucketed by the engine so the signature
+  set is small and warmable.
+- ``paged_decode_step`` — one token for EVERY slot of a fixed-size
+  decode batch [B]: append each sequence's K/V at its own position
+  (scatter through the block table; rows whose table entry is >= NB
+  drop their write, which is how empty slots ride along for free),
+  ragged paged attention at per-sequence true lengths, then greedy or
+  temperature sampling per row. ONE feed signature regardless of which
+  sequences occupy which slots — the continuous-batching scheduler
+  swaps sequences in and out without ever producing a new XLA
+  signature (zero steady-state cache misses).
+
+Per-row math mirrors the incremental-decode path in
+transformer_ops.py (``_incremental_layer_scan``): the layer stack is
+one ``lax.scan`` over [L, ...]-stacked weights, residual+LN via
+``fused_layer_norm``. Every per-row computation is independent of the
+other rows, so a sequence's token stream is bit-identical whether it
+decodes alone or packed into a full batch — the invariant
+tests/test_decode_serving.py's continuous-batching e2e asserts.
+
+Sampling: token at position i draws from
+``categorical(fold_in(PRNGKey(seed), i), logits / temp)`` (greedy at
+temp == 0), so a request's stream depends only on (seed, positions),
+never on batch composition or a global step counter.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .transformer_ops import ENC_SLOTS, _slot_to_input
+
+LM_SLOTS = ENC_SLOTS   # decoder-only block reuses the encoder slot layout
+
+_NEG_INF = -1e9
+
+
+def _split_heads(x, n_head):
+    """[..., H*D] -> [..., H, D]."""
+    return x.reshape(x.shape[:-1] + (n_head, x.shape[-1] // n_head))
+
+
+def _ln(h, p, slot):
+    from .pallas.layer_norm import fused_layer_norm
+    return fused_layer_norm(h, p[slot + '_w'], p[slot + '_b'], eps=1e-5,
+                            begin_norm_axis=-1)
+
+
+def _ffn(h, p):
+    return jax.nn.relu(h @ p['ffn_w1'] + p['ffn_b1']) @ p['ffn_w2'] + \
+        p['ffn_b2']
+
+
+def _write_positions(pages, new, phys, off):
+    """Scatter per-position K/V rows into the page arena.
+    pages [NB, H, bs, D]; new [N, H, D]; phys/off [N] int32 — rows with
+    phys >= NB are dropped (empty batch slots / padded prompt tail)."""
+    n_head = new.shape[1]
+    return pages.at[phys[:, None], jnp.arange(n_head)[None, :],
+                    off[:, None]].set(new, mode='drop')
+
+
+def _sample_token(logits, seed, pos, temp):
+    """logits [V] fp32 -> int32 token. temp == 0 is greedy; otherwise
+    categorical at temperature with a (seed, position)-derived key."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+    t = jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(key, logits / t).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
+def _lm_inputs(ctx):
+    emb = ctx.input('Emb')
+    pos_enc = ctx.input('PosEnc')
+    wout = ctx.input('OutProj')
+    params = {s: ctx.env[ctx.op.input(_slot_to_input(s))]
+              for s in LM_SLOTS}
+    kc = ctx.input('KCache')            # [L, NB, H, bs, dk]
+    vc = ctx.input('VCache')
+    return emb, pos_enc, wout, params, kc, vc
+
+
+@register('paged_decode_step')
+def _paged_decode_step(ctx):
+    from .pallas.paged_attention import paged_attention
+
+    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    n_head = ctx.attr('n_head', 1)
+    bs = kcs.shape[3]
+    d_model = emb.shape[-1]
+
+    tokens = ctx.input('Tokens').reshape(-1).astype(jnp.int32)     # [B]
+    lens = ctx.input('SeqLens').reshape(-1).astype(jnp.int32)      # [B]
+    tables = ctx.input('BlockTables').astype(jnp.int32)            # [B, P]
+    temps = ctx.input('Temps').reshape(-1).astype(jnp.float32)
+    seeds = ctx.input('Seeds').reshape(-1).astype(jnp.int32)
+
+    # this token's page: logical block lens // bs through the table
+    # (empty slots feed all->NB tables, so phys lands out of bounds and
+    # every write below drops)
+    logical = jnp.clip(lens // bs, 0, tables.shape[1] - 1)
+    phys = jnp.take_along_axis(tables, logical[:, None], axis=1)[:, 0]
+    off = lens % bs
+
+    x = jnp.take(emb, tokens, axis=0) * (d_model ** 0.5) + \
+        jnp.take(pos_enc, lens, axis=0)
+
+    def body(h, sl):
+        p, kc, vc = sl
+        k_new = _split_heads(h @ p['slf_k'], n_head)       # [B, H, dk]
+        v_new = _split_heads(h @ p['slf_v'], n_head)
+        kc = _write_positions(kc, k_new, phys, off)
+        vc = _write_positions(vc, v_new, phys, off)
+        q = _split_heads(h @ p['slf_q'], n_head)
+        attn = paged_attention(q, kc, vc, tables, lens + 1)
+        h = _ln(h + attn.reshape(h.shape[0], -1) @ p['slf_o'], p, 'ln1')
+        h = _ln(h + _ffn(h, p), p, 'ln2')
+        return h, (kc, vc)
+
+    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
+    logits = (h @ wout).astype(jnp.float32)                # [B, V]
+    nxt = jax.vmap(_sample_token)(logits, seeds, lens + 1, temps)
+    ctx.set_output('NextTokens',
+                   nxt.astype(ctx.out_dtype('NextTokens', 'int64')))
+    ctx.set_output('KCacheOut', kcs)
+    ctx.set_output('VCacheOut', vcs)
+
+
+@register('paged_prefill')
+def _paged_prefill(ctx):
+    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    n_head = ctx.attr('n_head', 1)
+    bs = kcs.shape[3]
+    nb = kcs.shape[1]
+    d_model = emb.shape[-1]
+    dk = params['slf_q'].shape[-1] // n_head
+
+    ids = ctx.input('Ids').reshape(-1).astype(jnp.int32)   # [S] (padded)
+    length = ctx.input('Len').reshape(()).astype(jnp.int32)
+    table = ctx.input('BlockTable').astype(jnp.int32).reshape(-1)  # [P]
+    temp = ctx.input('Temp').reshape(()).astype(jnp.float32)
+    seed = ctx.input('Seed').reshape(()).astype(jnp.int32)
+    s = ids.shape[0]
+
+    t_idx = jnp.arange(s, dtype=jnp.int32)
+    logical = jnp.clip(t_idx // bs, 0, table.shape[0] - 1)
+    phys = jnp.where(t_idx < length, table[logical], nb)   # nb => drop
+    off = t_idx % bs
+
+    x = jnp.take(emb, ids, axis=0) * (d_model ** 0.5) + pos_enc[:s]
+
+    causal = t_idx[:, None] >= t_idx[None, :]              # [S, S]
+
+    def body(h, sl):
+        p, kc, vc = sl
+        k3 = _split_heads(h @ p['slf_k'], n_head)          # [S, H, dk]
+        v3 = _split_heads(h @ p['slf_v'], n_head)
+        kc = _write_positions(kc, k3, phys, off)
+        vc = _write_positions(vc, v3, phys, off)
+        q3 = _split_heads(h @ p['slf_q'], n_head)
+        logits = jnp.einsum('qhd,khd->hqk', q3 * (dk ** -0.5), k3)
+        logits = jnp.where(causal[None], logits, _NEG_INF)
+        w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum('hqk,khd->qhd', w.astype(v3.dtype), v3)
+        h = _ln(h + attn.reshape(s, -1) @ p['slf_o'], p, 'ln1')
+        h = _ln(h + _ffn(h, p), p, 'ln2')
+        return h, (kc, vc)
+
+    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
+    h_last = jax.lax.dynamic_index_in_dim(
+        h, jnp.maximum(length - 1, 0), keepdims=False)
+    logits = (h_last @ wout).astype(jnp.float32)           # [V]
+    nxt = _sample_token(logits, seed, length, temp)
+    ctx.set_output('NextToken',
+                   nxt.reshape(1).astype(ctx.out_dtype('NextToken',
+                                                       'int64')))
+    ctx.set_output('KCacheOut', kcs)
+    ctx.set_output('VCacheOut', vcs)

@@ -1,0 +1,187 @@
+"""Ragged paged attention for autoregressive decode serving.
+
+One decode step attends one query token per sequence against that
+sequence's KV cache, which lives in a pool of fixed-size blocks
+("pages") in HBM — the paged-KV design from PAPERS "Ragged Paged
+Attention". Each sequence owns a *block table* (logical page i ->
+physical page id) and a true length; batches are ragged (every row has
+a different live length) so a dense [B, Tmax] cache would pay padding
+FLOPs and, worse, padding HBM. Pages decouple cache capacity from
+per-sequence reservation: a 17-token sequence holds ceil(17/bs) pages,
+not Tmax slots.
+
+Two backends, selected like ops/pallas/flash_attention.py:
+
+- **XLA gather path** (default, and the CPU/tier-1 path): gather the
+  per-sequence pages through the block table into [B, H, P*bs, D],
+  mask columns >= seq_len, fp32 softmax. XLA fuses the gather into the
+  attention chain; on small decode shapes this is already near-optimal.
+- **Pallas kernel** (PADDLE_TPU_USE_PALLAS=1): the block table rides
+  scalar prefetch (pltpu.PrefetchScalarGridSpec) so each grid step's
+  page index map reads table[b, page] — the kernel DMAs exactly the
+  pages a sequence owns, pages past seq_len are skipped entirely
+  (ragged: short sequences cost proportionally less), and the online-
+  softmax recurrence matches the flash kernel's.
+
+Parity across mixed sequence lengths vs a dense masked reference is
+asserted in tests/test_decode_serving.py (XLA path) and
+tests/test_pallas_kernels.py (kernel, interpret mode).
+
+Layouts:
+    q            [B, H, D]      one query token per sequence
+    k/v_pages    [NB, H, bs, D] the pooled page arena (one layer)
+    block_tables [B, P] int32   physical page ids; >= NB means "no page"
+    seq_lens     [B]  int32     live tokens (this token included)
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from . import interpret_mode
+from . import pallas_enabled
+from . import tpu_compiler_params
+
+_NEG_INF = -1e9
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                              sm_scale=None):
+    """XLA gather path. Bit-stable contract with the Pallas kernel's
+    masking: columns >= seq_lens[b] contribute exactly 0 (exp of a
+    large-negative underflows), so the result is independent of the
+    garbage content of unowned/partial pages."""
+    nb, h, bs, d = k_pages.shape
+    b, p = block_tables.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0, nb - 1)
+    # [B, P, H, bs, D] -> [B, H, P*bs, D]
+    k = jnp.transpose(k_pages[tables], (0, 2, 1, 3, 4)) \
+        .reshape(b, h, p * bs, d)
+    v = jnp.transpose(v_pages[tables], (0, 2, 1, 3, 4)) \
+        .reshape(b, h, p * bs, v_pages.shape[-1])
+    logits = jnp.einsum('bhd,bhkd->bhk', (q * scale), k)
+    mask = jnp.arange(p * bs)[None, :] < seq_lens.reshape(-1, 1)
+    logits = jnp.where(mask[:, None, :], logits, _NEG_INF)
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum('bhk,bhkd->bhd', w.astype(v.dtype), v)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, bs, num_pages, sm_scale):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    pi = pl.program_id(2)
+    seq_len = len_ref[b]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(pi * bs < seq_len)
+    def _body():
+        q = q_ref[0]                                   # [1, d]
+        k = k_ref[0, 0]                                # [bs, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [1, bs]
+        cols = pi * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(cols < seq_len, s, _NEG_INF)
+
+        m_prev = m_scr[:]                              # [1, 128]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)      # [1, 1]
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])                 # [1, bs] f32
+        l_cur = jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_next
+        l_scr[:] = alpha * l_prev + l_cur
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [1, d]
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(pi == num_pages - 1)
+    def _finish():
+        denom = l_scr[:][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pages, v_pages, block_tables, seq_lens, sm_scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, h, bs, d = k_pages.shape
+    b, p = block_tables.shape
+    dv = v_pages.shape[-1]
+    tables = jnp.clip(block_tables.astype(jnp.int32), 0, nb - 1)
+    lens = seq_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,        # block tables, lengths
+        grid=(b, h, p),
+        in_specs=[
+            # q [B, H, D]: one (1, d) row per (b, h); page axis constant
+            pl.BlockSpec((1, 1, d),
+                         lambda bi, hi, pi, bt, ln: (bi, hi, 0)),
+            # pages: the physical page id comes from the prefetched
+            # block table — the ragged gather IS the index map
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda bi, hi, pi, bt, ln: (bt[bi, pi], hi, 0, 0)),
+            pl.BlockSpec((1, 1, bs, dv),
+                         lambda bi, hi, pi, bt, ln: (bt[bi, pi], hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, dv),
+                               lambda bi, hi, pi, bt, ln: (bi, hi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, dv), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, bs=bs, num_pages=p,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, dv), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret_mode(),
+    )(tables, lens, q, k_pages, v_pages)
+
+
+def _use_pallas(bs, d):
+    """The kernel wants lane-aligned page tiles; anything else takes the
+    gather path (which handles every shape). PADDLE_TPU_PAGED_PALLAS
+    overrides the shared PADDLE_TPU_USE_PALLAS gate in either
+    direction."""
+    env = os.environ.get('PADDLE_TPU_PAGED_PALLAS')
+    if env is not None:
+        enabled = env not in ('0', 'false', 'False')
+    else:
+        enabled = pallas_enabled()
+    return enabled and bs % 8 == 0 and d % 8 == 0
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                    sm_scale=None):
+    """Ragged paged attention: one query per sequence against its paged
+    KV cache. q [B, H, D]; pages [NB, H, bs, D*]; block_tables [B, P]
+    int32 (entries >= NB mean "no page" and are never read); seq_lens
+    [B] int32. Returns [B, H, Dv]."""
+    nb, h, bs, d = k_pages.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if _use_pallas(bs, d):
+        return _paged_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                             scale)
+    return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                     seq_lens, sm_scale=scale)

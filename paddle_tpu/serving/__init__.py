@@ -13,3 +13,7 @@ tools/serving_bench.py.
 from .buckets import BatchInfo, BucketLadder, pow2_ladder  # noqa: F401
 from .engine import (EngineClosedError, QueueFullError,  # noqa: F401
                      ServingEngine)
+
+# The decode subpackage (continuous batching + paged KV cache) imports
+# lazily via `from paddle_tpu.serving import decode` /
+# `from paddle_tpu.serving.decode import DecodeEngine`.

@@ -1,0 +1,16 @@
+"""paddle_tpu.serving.decode — autoregressive decode serving.
+
+Continuous batching + paged KV cache + ragged paged attention over a
+decoder-only LM: `DecodeEngine` admits requests into a fixed-shape
+decode batch as others finish, KV pages come from a shared HBM pool
+(`KVPool`) addressed through per-sequence block tables, and the
+attention kernel (ops/pallas/paged_attention.py) reads exactly the
+pages each sequence owns at its true length. See docs/serving.md
+(decode engine section); load-test with tools/decode_bench.py.
+"""
+
+from .engine import DecodeEngine  # noqa: F401
+from .kv_pool import BlockTable, KVPool  # noqa: F401
+from .model import LMSpec, build_lm_programs, random_weights  # noqa: F401
+from .scheduler import (GenerationStream, Scheduler,  # noqa: F401
+                        Sequence)

@@ -1,0 +1,437 @@
+"""DecodeEngine: continuous-batching autoregressive decode serving.
+
+The bucket `ServingEngine` serves single-shot forward passes; this
+engine serves token-by-token generation — the dominant TPU serving
+workload — over a decoder-only LM with a paged KV cache:
+
+- **submit()** (any thread) validates a prompt against the page budget
+  and returns a `GenerationStream` immediately: iterate it for tokens
+  as they are generated, or `.result()` for the full sequence.
+- **one worker thread** runs the prefill/decode loop: admit waiting
+  requests into free batch slots (one `paged_prefill` dispatch per
+  admission, bucketed prompt lengths), then one `paged_decode_step`
+  for the whole running batch. Sequences enter and leave the running
+  batch continuously; the batch never waits for its slowest member.
+- **fixed decode signature**: the decode step always runs at
+  [max_batch] with per-slot block tables — scheduling churn never
+  creates a new XLA signature, so after `warmup()` (prefill buckets +
+  the one decode key) live traffic is 100% executor cache hits: the
+  contract tests/test_decode_serving.py asserts, same as the bucket
+  engine's.
+- **pool exhaustion** preempts the youngest running sequence
+  (recompute-style requeue, scheduler.py) rather than failing it;
+  flight events + counters make the resulting latency spikes
+  explainable post-hoc (tools/flight_report.py).
+
+Per-row device math is batch-composition-independent, so each
+request's token stream is bit-identical to running it alone —
+continuous batching is a pure throughput win, never a correctness
+trade.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ... import observe as _obs
+from ...core.executor import Executor
+from ...core.place import TPUPlace
+from ...core.scope import Scope, scope_guard
+from ..buckets import pow2_ladder
+from ..engine import EngineClosedError, QueueFullError
+from .kv_pool import KVPool
+from .model import LMSpec, build_lm_programs
+from .scheduler import RUNNING, Scheduler, Sequence
+
+__all__ = ['DecodeEngine', 'LMSpec']
+
+_ENGINE_IDS = itertools.count(1)
+
+
+class DecodeEngine(object):
+    """Continuous-batching decode server over a paged KV cache.
+
+    ::
+
+        spec = LMSpec(vocab_size=1000, n_layer=2, ...)
+        eng = DecodeEngine(spec, max_batch=8, block_size=16,
+                           num_blocks=128, pages_per_seq=8)
+        eng.warmup()                    # AOT: prefill buckets + decode
+        eng.start()
+        stream = eng.submit([1, 5, 7], max_new_tokens=32)
+        for tok in stream: ...          # tokens as they generate
+        eng.shutdown()
+
+    ``pages_per_seq * block_size`` caps prompt_len + max_new_tokens of
+    a single request; ``num_blocks`` is the shared HBM page budget that
+    continuous batching packs.
+    """
+
+    def __init__(self, spec, max_batch=8, block_size=16, num_blocks=64,
+                 pages_per_seq=8, max_queue_depth=64, max_prompt_len=None,
+                 place=None, weights=None):
+        self.spec = spec
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.pages_per_seq = int(pages_per_seq)
+        self.max_queue_depth = int(max_queue_depth)
+        self._progs = build_lm_programs(spec, self.max_batch,
+                                        self.block_size, self.num_blocks,
+                                        self.pages_per_seq)
+        self.capacity = self._progs.capacity
+        self.max_prompt_len = int(max_prompt_len) if max_prompt_len \
+            else self.capacity - 1
+        self.prompt_buckets = pow2_ladder(self.max_prompt_len)
+
+        self._scope = Scope()
+        self._exe = Executor(place if place is not None else TPUPlace(0))
+        with scope_guard(self._scope):
+            self._exe.run(program=self._progs.startup)
+        if weights:
+            self.load_weights(weights)
+
+        self.pool = KVPool(self.num_blocks, self.block_size)
+        self._sched = Scheduler(self.pool, self.max_batch)
+        self._mu = threading.Condition(threading.Lock())
+        self._done_cv = threading.Condition(threading.Lock())
+        self._unfinished = 0
+        self._ids = itertools.count(1)
+        self._closed = False
+        self._draining = False
+        self._started = False
+        self._warmed = False
+        self._broken = None
+        self._thread = None
+        self._health_name = None
+        self.warmup_signatures = 0
+
+    # ----------------------------------------------------------- weights
+    def load_weights(self, weights):
+        """Install a {param name: array} dict (names per
+        model.DecodePrograms.param_names)."""
+        unknown = sorted(set(weights) - set(self._progs.param_names))
+        if unknown:
+            raise ValueError('unknown param names %s (expected a subset '
+                             'of %s)' % (unknown, self._progs.param_names))
+        for name, arr in weights.items():
+            self._scope.set(name, np.asarray(arr, dtype='float32'))
+
+    def export_weights(self):
+        return {n: self._scope.numpy(n) for n in self._progs.param_names}
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
+               seed=0, eos_id=None):
+        """Enqueue one generation request; returns a GenerationStream.
+        Raises QueueFullError past max_queue_depth, EngineClosedError
+        after shutdown, ValueError for prompts the page budget can
+        never hold."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        max_new = int(max_new_tokens)
+        if not prompt:
+            raise ValueError('submit: empty prompt')
+        if max_new < 1:
+            raise ValueError('submit: max_new_tokens must be >= 1')
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError('prompt of %d tokens exceeds max_prompt_len'
+                             '=%d' % (len(prompt), self.max_prompt_len))
+        total = len(prompt) + max_new
+        if total > self.capacity:
+            raise ValueError(
+                'prompt+max_new_tokens=%d exceeds per-sequence capacity '
+                '%d (pages_per_seq=%d x block_size=%d)'
+                % (total, self.capacity, self.pages_per_seq,
+                   self.block_size))
+        if self.pool.blocks_for(total) > self.num_blocks:
+            raise ValueError(
+                'request needs %d KV pages but the pool only has %d'
+                % (self.pool.blocks_for(total), self.num_blocks))
+        with self._mu:
+            if self._closed:
+                raise EngineClosedError('DecodeEngine is shut down')
+            if self._broken is not None:
+                raise EngineClosedError(
+                    'DecodeEngine worker died: %r' % self._broken)
+            waiting, _ = self._sched.counts()
+            if waiting >= self.max_queue_depth:
+                _obs.inc('decode.rejected_total', reason='queue_full')
+                _obs.flight_event('decode_rejected', reason='queue_full',
+                                  queue_depth=waiting)
+                raise QueueFullError(
+                    'decode queue full (%d waiting >= max_queue_depth='
+                    '%d)' % (waiting, self.max_queue_depth))
+            seq = Sequence(next(self._ids), prompt, max_new, temperature,
+                           seed, eos_id)
+            with self._done_cv:
+                self._unfinished += 1
+            self._sched.add(seq)
+            self._mu.notify_all()
+        _obs.inc('decode.requests_total')
+        return seq.stream
+
+    def generate(self, prompt_ids, **kwargs):
+        """submit() + wait: returns the generated token list."""
+        timeout = kwargs.pop('timeout', None)
+        return self.submit(prompt_ids, **kwargs).result(timeout)
+
+    # ---------------------------------------------------------- lifecycle
+    def ready(self):
+        return bool(self._started and self._warmed and not self._closed
+                    and self._broken is None)
+
+    def start(self):
+        with self._mu:
+            if self._closed:
+                raise EngineClosedError('DecodeEngine is shut down')
+            if self._started:
+                return self
+            self._started = True
+        self._thread = threading.Thread(
+            target=self._worker, name='paddle_tpu_decode_worker',
+            daemon=True)
+        self._thread.start()
+        self._health_name = 'decode.engine%d' % next(_ENGINE_IDS)
+        _obs.register_health_check(self._health_name, self._ready_check,
+                                   readiness_only=True)
+        return self
+
+    def _ready_check(self):
+        if self.ready():
+            return True, None
+        if self._broken is not None:
+            return False, 'worker died: %r' % self._broken
+        if not self._warmed:
+            return False, 'not warmed up'
+        return False, 'shutting down' if self._closed else 'not started'
+
+    def warmup(self):
+        """AOT-compile every signature live traffic can produce: one
+        prefill per prompt bucket plus the single decode-step key.
+        Warmup feeds point every block-table entry past the pool (all
+        writes drop), so device state is untouched. Returns the
+        signature count."""
+        t_all = time.perf_counter()
+        nb = self.num_blocks
+        for b in self.prompt_buckets:
+            t0 = time.perf_counter()
+            self._run_prefill(np.zeros((1, b), 'int64'), 1,
+                              np.full((1, self.pages_per_seq), nb, 'int32'),
+                              0.0, 0)
+            _obs.record('decode.warmup_seconds',
+                        time.perf_counter() - t0, kind='prefill', bucket=b)
+        t0 = time.perf_counter()
+        self._run_decode(
+            np.zeros((self.max_batch,), 'int64'),
+            np.zeros((self.max_batch,), 'int32'),
+            np.full((self.max_batch, self.pages_per_seq), nb, 'int32'),
+            np.zeros((self.max_batch,), 'float32'),
+            np.zeros((self.max_batch,), 'int32'))
+        _obs.record('decode.warmup_seconds', time.perf_counter() - t0,
+                    kind='decode', bucket='')
+        self.warmup_signatures = len(self.prompt_buckets) + 1
+        self._warmed = True
+        _obs.set_gauge('decode.warmup_signatures', self.warmup_signatures)
+        _obs.set_gauge('decode.warmup_total_seconds',
+                       time.perf_counter() - t_all)
+        return self.warmup_signatures
+
+    def drain(self, timeout=None):
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
+        with self._done_cv:
+            while self._unfinished > 0:
+                wait = None if deadline is None else \
+                    deadline - time.perf_counter()
+                if wait is not None and wait <= 0:
+                    return False
+                self._done_cv.wait(wait)
+        return True
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop accepting requests; drain=True finishes everything
+        already accepted, drain=False fails queued-and-running requests
+        with EngineClosedError."""
+        with self._mu:
+            if self._closed and self._thread is None:
+                return
+            self._closed = True
+            self._draining = bool(drain)
+            self._mu.notify_all()
+        if self._health_name is not None:
+            _obs.unregister_health_check(self._health_name)
+            self._health_name = None
+        if drain and self._started and self._broken is None:
+            self.drain(timeout)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if not drain or not self._started:
+            self._fail_remaining(EngineClosedError(
+                'DecodeEngine shut down without draining'))
+
+    def close(self):
+        self.shutdown(drain=True)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+        return False
+
+    def _request_done(self, n=1):
+        with self._done_cv:
+            self._unfinished -= n
+            if self._unfinished <= 0:
+                self._done_cv.notify_all()
+
+    def _fail_remaining(self, exc):
+        n = self._sched.fail_all(exc)
+        if n:
+            self._request_done(n)
+
+    # ------------------------------------------------------------ worker
+    def _worker(self):
+        try:
+            while True:
+                with self._mu:
+                    while not self._closed and \
+                            self._sched.counts() == (0, 0):
+                        self._mu.wait()
+                    waiting, running = self._sched.counts()
+                    if self._closed and (
+                            not self._draining or
+                            (waiting == 0 and running == 0)):
+                        return
+                self._admit()
+                if self._sched.running:
+                    self._decode_step()
+                elif self._sched.waiting:
+                    # head-of-line blocked on pages with nothing running
+                    # to free them — only another submit/shutdown can
+                    # change that; avoid a hot spin
+                    with self._mu:
+                        if not self._closed:
+                            self._mu.wait(0.05)
+        except BaseException as e:  # fail fast, loudly, and visibly
+            self._broken = e
+            _obs.inc('decode.worker_errors_total')
+            _obs.flight_event('decode_worker_died', error=repr(e))
+            self._fail_remaining(e)
+
+    def _admit(self):
+        while True:
+            seq = self._sched.pop_admittable()
+            if seq is None:
+                return
+            _obs.record('decode.queue_seconds',
+                        seq.t_admit - seq.t_submit)
+            self._prefill(seq)
+
+    # ----------------------------------------------------------- dispatch
+    def _run_prefill(self, ids, length, table, temp, seed):
+        with scope_guard(self._scope):
+            out = self._exe.run(
+                program=self._progs.prefill,
+                feed={'pf_ids': ids,
+                      'pf_len': np.asarray([length], 'int32'),
+                      'pf_table': table,
+                      'pf_temp': np.asarray([temp], 'float32'),
+                      'pf_seed': np.asarray([seed], 'int32')},
+                fetch_list=[self._progs.prefill_fetch])
+        return int(np.asarray(out[0]).reshape(-1)[0])
+
+    def _run_decode(self, tokens, lens, tables, temps, seeds):
+        with scope_guard(self._scope):
+            out = self._exe.run(
+                program=self._progs.decode,
+                feed={'dec_tokens': tokens, 'dec_lens': lens,
+                      'dec_tables': tables, 'dec_temps': temps,
+                      'dec_seeds': seeds},
+                fetch_list=[self._progs.decode_fetch])
+        return np.asarray(out[0]).reshape(-1)
+
+    def _bucket(self, n):
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise ValueError('prefix of %d tokens exceeds the top prompt '
+                         'bucket %d' % (n, self.prompt_buckets[-1]))
+
+    def _table_row(self, seq):
+        row = np.full((self.pages_per_seq,), self.num_blocks, 'int32')
+        ids = seq.table.block_ids
+        row[:len(ids)] = ids
+        return row
+
+    def _prefill(self, seq):
+        prefix = seq.prefix()
+        s = len(prefix)
+        bucket = self._bucket(s)
+        ids = np.zeros((1, bucket), 'int64')
+        ids[0, :s] = prefix
+        t0 = time.perf_counter()
+        tok = self._run_prefill(ids, s, self._table_row(seq)[None, :],
+                                seq.temperature, seq.seed)
+        _obs.record('decode.prefill_seconds', time.perf_counter() - t0,
+                    bucket=bucket)
+        _obs.inc('decode.prefills_total')
+        seq.cache_len = s
+        self._emit(seq, tok, time.perf_counter())
+        reason = seq.finished()
+        if reason:
+            self._finish(seq, reason)
+
+    def _decode_step(self):
+        for seq in list(self._sched.running):
+            if seq.state is not RUNNING:
+                continue   # preempted as a victim earlier in this pass
+            self._sched.ensure_growth(seq)
+        batch = list(self._sched.running)
+        if not batch:
+            return
+        mb, pps, nb = self.max_batch, self.pages_per_seq, self.num_blocks
+        tokens = np.zeros((mb,), 'int64')
+        lens = np.zeros((mb,), 'int32')
+        tables = np.full((mb, pps), nb, 'int32')
+        temps = np.zeros((mb,), 'float32')
+        seeds = np.zeros((mb,), 'int32')
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.pending_token
+            lens[i] = seq.cache_len
+            tables[i] = self._table_row(seq)
+            temps[i] = seq.temperature
+            seeds[i] = seq.seed
+        t0 = time.perf_counter()
+        nxt = self._run_decode(tokens, lens, tables, temps, seeds)
+        now = time.perf_counter()
+        _obs.record('decode.step_seconds', now - t0)
+        _obs.record('decode.batch_occupancy', len(batch) / float(mb))
+        _obs.inc('decode.steps_total')
+        for i, seq in enumerate(batch):
+            seq.cache_len += 1
+            self._emit(seq, int(nxt[i]), now)
+            reason = seq.finished()
+            if reason:
+                self._finish(seq, reason)
+
+    def _emit(self, seq, token, now):
+        seq.generated.append(token)
+        seq.pending_token = token
+        if seq.t_last_token is not None:
+            _obs.record('decode.inter_token_seconds',
+                        now - seq.t_last_token)
+        seq.t_last_token = now
+        seq.stream._put(token)
+        seq.streamed += 1
+        _obs.inc('decode.tokens_total')
+
+    def _finish(self, seq, reason):
+        self._sched.finish(seq, reason)
+        _obs.record('decode.request_seconds',
+                    time.perf_counter() - seq.t_submit)
+        _obs.record('decode.request_tokens', len(seq.generated))
+        self._request_done()

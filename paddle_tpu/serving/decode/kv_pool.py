@@ -1,0 +1,146 @@
+"""Paged KV-cache pool: host-side bookkeeping for the HBM page arena.
+
+The device side is a preallocated arena ``[L, NB, H, bs, D]`` (one
+fixed tensor per K and V, living in the engine's scope and updated in
+place through executor donation). This module owns the *map* of that
+arena: which physical pages are free, which sequence holds which pages
+in which logical order (its block table), and how many owners each
+page has. Pure host Python — no jax — so it is trivially testable and
+adds zero work to the device step.
+
+Reference counting: pages default to one owner, but ``fork()`` lets a
+new sequence share a prefix's pages (prefix caching / beam-style
+branching), bumping refcounts; ``free`` only returns a page to the
+free list when its count hits zero. The free list is LIFO so recently
+touched pages are reused first (warm in cache).
+
+Exhaustion is a normal state, not an error: ``alloc`` returns None and
+the continuous-batching scheduler reacts by preempting a victim
+sequence (freeing its pages, requeueing it) — see scheduler.py.
+"""
+
+import threading
+
+from ... import observe as _obs
+
+__all__ = ['KVPool', 'BlockTable']
+
+
+class BlockTable(object):
+    """One sequence's logical->physical page map."""
+
+    __slots__ = ('block_ids',)
+
+    def __init__(self):
+        self.block_ids = []
+
+    def __len__(self):
+        return len(self.block_ids)
+
+    def capacity(self, block_size):
+        return len(self.block_ids) * block_size
+
+
+class KVPool(object):
+    """Free-list allocator over ``num_blocks`` physical pages of
+    ``block_size`` token slots each."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError('KVPool: need num_blocks >= 1 and '
+                             'block_size >= 1, got %d / %d'
+                             % (num_blocks, block_size))
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._mu = threading.Lock()
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._refs = [0] * self.num_blocks
+        self._publish()
+
+    # ------------------------------------------------------------ stats
+    def free_blocks(self):
+        with self._mu:
+            return len(self._free)
+
+    def used_blocks(self):
+        with self._mu:
+            return self.num_blocks - len(self._free)
+
+    def occupancy(self):
+        with self._mu:
+            return 1.0 - len(self._free) / float(self.num_blocks)
+
+    def _publish(self):
+        if _obs.enabled():
+            free = len(self._free)
+            _obs.set_gauge('decode.kv_blocks_free', free)
+            _obs.set_gauge('decode.kv_blocks_total', self.num_blocks)
+            _obs.set_gauge('decode.kv_block_occupancy',
+                           1.0 - free / float(self.num_blocks))
+
+    def blocks_for(self, n_tokens):
+        """Pages needed to hold n_tokens positions."""
+        return max(0, (int(n_tokens) + self.block_size - 1)
+                   // self.block_size)
+
+    # ------------------------------------------------------- alloc/free
+    def alloc(self, n):
+        """Claim ``n`` pages (refcount 1 each). Returns the page-id list,
+        or None when fewer than ``n`` are free — the caller decides
+        whether that means preempt, wait, or reject."""
+        n = int(n)
+        with self._mu:
+            if n > len(self._free):
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            for i in ids:
+                self._refs[i] = 1
+            self._publish()
+            return ids
+
+    def grow(self, table, n_tokens):
+        """Ensure ``table`` covers ``n_tokens`` positions, allocating
+        pages as needed. True on success; False (table unchanged) when
+        the pool cannot supply them."""
+        need = self.blocks_for(n_tokens) - len(table.block_ids)
+        if need <= 0:
+            return True
+        ids = self.alloc(need)
+        if ids is None:
+            return False
+        table.block_ids.extend(ids)
+        return True
+
+    def incref(self, ids):
+        with self._mu:
+            for i in ids:
+                if self._refs[i] <= 0:
+                    raise ValueError('incref of free page %d' % i)
+                self._refs[i] += 1
+
+    def free(self, ids):
+        """Drop one reference from each page; pages reaching zero return
+        to the free list."""
+        with self._mu:
+            for i in ids:
+                if self._refs[i] <= 0:
+                    raise ValueError('double free of page %d' % i)
+                self._refs[i] -= 1
+                if self._refs[i] == 0:
+                    self._free.append(i)
+            self._publish()
+
+    def release(self, table):
+        """Free a sequence's whole table."""
+        ids, table.block_ids = table.block_ids, []
+        self.free(ids)
+
+    def fork(self, table):
+        """A new BlockTable sharing ``table``'s pages (copy-on-nothing:
+        pages are append-only per position, so sharing a frozen prefix
+        is safe; the new sequence must grow into fresh pages before
+        writing past the shared prefix)."""
+        self.incref(table.block_ids)
+        t = BlockTable()
+        t.block_ids = list(table.block_ids)
+        return t

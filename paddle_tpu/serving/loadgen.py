@@ -1,0 +1,129 @@
+"""Shared load-generator driver for the serving benches.
+
+tools/serving_bench.py (micro-batch engine) and tools/decode_bench.py
+(decode engine) drive different request shapes through the same two
+loop disciplines, so the loop logic lives here once:
+
+- **closed loop** — ``clients`` threads each keep exactly one request
+  in flight (latency under a fixed concurrency).
+- **open loop** — one pacer submits at ``qps`` with Poisson arrivals
+  regardless of completions (latency under offered load; overload
+  surfaces as rejects via the engines' QueueFullError backpressure).
+
+The bench adapts its engine through two callables:
+
+    do_request(rng) -> rows          # closed loop: submit AND wait
+    submit_request(rng) -> (future, rows) | None   # open loop
+
+Both raise/return-None on QueueFullError (counted as a reject) and
+raise anything else as an error. ``Stats`` is the thread-safe ledger;
+``percentiles`` renders it.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ['Stats', 'percentiles', 'closed_loop', 'open_loop']
+
+
+class Stats(object):
+    """Thread-safe request ledger."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.latencies = []
+        self.rows = 0
+        self.ok = 0
+        self.rejected = 0
+        self.errors = 0
+
+    def done(self, seconds, rows):
+        with self.mu:
+            self.latencies.append(seconds)
+            self.ok += 1
+            self.rows += rows
+
+    def reject(self):
+        with self.mu:
+            self.rejected += 1
+
+    def error(self):
+        with self.mu:
+            self.errors += 1
+
+
+def percentiles(latencies):
+    """{'p50','p95','p99','mean','max'} in milliseconds (None-filled
+    when empty)."""
+    if not latencies:
+        return {'p50': None, 'p95': None, 'p99': None, 'mean': None,
+                'max': None}
+    arr = np.sort(np.asarray(latencies, dtype=np.float64)) * 1000.0
+    pick = lambda q: float(arr[min(len(arr) - 1, int(q * len(arr)))])  # noqa
+    return {'p50': pick(0.50), 'p95': pick(0.95), 'p99': pick(0.99),
+            'mean': float(arr.mean()), 'max': float(arr[-1])}
+
+
+def closed_loop(do_request, stats, deadline, clients):
+    """``clients`` threads each loop: one request in flight at a time.
+    ``do_request(rng)`` submits, waits, and returns the request's row
+    count; QueueFullError counts as a reject, anything else an error."""
+    from . import QueueFullError
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            try:
+                rows = do_request(rng)
+            except QueueFullError:
+                stats.reject()
+                continue
+            except Exception:
+                stats.error()
+                continue
+            stats.done(time.perf_counter() - t0, rows)
+
+    threads = [threading.Thread(target=client, args=(1000 + i,),
+                                daemon=True) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def open_loop(submit_request, stats, deadline, qps, seed=7):
+    """One pacer submits at ``qps`` (Poisson arrivals) regardless of
+    completions. ``submit_request(rng)`` returns (future, rows) or
+    None on a reject; latency is clocked at future resolution (the
+    dispatcher thread), not at a late collection point. The caller's
+    engine.shutdown(drain=True) is the completion barrier."""
+    from . import QueueFullError
+    rng = np.random.RandomState(seed)
+    period = 1.0 / qps
+    next_t = time.perf_counter()
+    while time.perf_counter() < deadline:
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        next_t += period * float(rng.exponential(1.0))
+        t0 = time.perf_counter()
+        try:
+            handed = submit_request(rng)
+        except QueueFullError:
+            handed = None
+        if handed is None:
+            stats.reject()
+            continue
+        fut, rows = handed
+
+        def _cb(f, t0=t0, rows=rows):
+            try:
+                f.result()
+                stats.done(time.perf_counter() - t0, rows)
+            except Exception:
+                stats.error()
+        fut.add_done_callback(_cb)

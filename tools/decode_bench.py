@@ -1,0 +1,184 @@
+"""Load generator for the decode engine — tokens/sec and inter-token
+latency under continuous batching.
+
+Drives a `DecodeEngine` (tiny built-in decoder-only LM by default) with
+closed-loop clients streaming generations, and reports decode
+throughput plus the latency numbers that matter for token streaming:
+
+    python tools/decode_bench.py --duration 3 --clients 8
+    python tools/decode_bench.py --json | jq .inter_token_ms.p99
+
+The loop discipline comes from paddle_tpu.serving.loadgen (shared with
+tools/serving_bench.py); each client iterates its GenerationStream and
+records per-token gaps, so `inter_token_ms` measures what a streaming
+caller actually sees — including stalls from prefill insertions and
+pool-exhaustion preemptions (visible as p99 spikes; cross-check the
+flight recorder / decode.preemptions_total).
+
+Metrics land in the standard observe pipeline (--metrics-jsonl /
+PADDLE_TPU_METRICS_JSONL -> tools/metrics_report.py). --json emits one
+machine-readable object; its schema is asserted by
+tests/test_decode_serving.py so this tool cannot rot.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='paddle_tpu.serving.decode load generator')
+    p.add_argument('--duration', type=float, default=3.0,
+                   help='seconds of load after warmup')
+    p.add_argument('--clients', type=int, default=4,
+                   help='closed-loop concurrent streaming clients')
+    p.add_argument('--max-batch', type=int, default=8)
+    p.add_argument('--block-size', type=int, default=16)
+    p.add_argument('--num-blocks', type=int, default=256)
+    p.add_argument('--pages-per-seq', type=int, default=8)
+    p.add_argument('--max-queue-depth', type=int, default=64)
+    p.add_argument('--prompt-lo', type=int, default=4)
+    p.add_argument('--prompt-hi', type=int, default=32)
+    p.add_argument('--max-new', type=int, default=32,
+                   help='max generated tokens per request')
+    p.add_argument('--temperature', type=float, default=0.0)
+    p.add_argument('--vocab', type=int, default=1000)
+    p.add_argument('--n-layer', type=int, default=2)
+    p.add_argument('--n-head', type=int, default=4)
+    p.add_argument('--d-model', type=int, default=128)
+    p.add_argument('--d-inner', type=int, default=256)
+    p.add_argument('--no-warmup', action='store_true',
+                   help='skip AOT warmup (shows live-compile cost)')
+    p.add_argument('--metrics-jsonl', default=None,
+                   help='observe JSONL path (or set '
+                        'PADDLE_TPU_METRICS_JSONL)')
+    p.add_argument('--json', action='store_true',
+                   help='emit one machine-readable JSON object')
+    args = p.parse_args(argv)
+
+    from paddle_tpu import observe
+    from paddle_tpu.serving.decode import DecodeEngine, LMSpec
+    from paddle_tpu.serving.loadgen import Stats, closed_loop, percentiles
+
+    jsonl = args.metrics_jsonl or os.environ.get(
+        'PADDLE_TPU_METRICS_JSONL')
+    observe.enable(jsonl=jsonl)
+
+    d_head = max(8, args.d_model // args.n_head)
+    spec = LMSpec(vocab_size=args.vocab, n_layer=args.n_layer,
+                  n_head=args.n_head, d_key=d_head, d_value=d_head,
+                  d_model=args.d_model, d_inner=args.d_inner)
+    engine = DecodeEngine(spec, max_batch=args.max_batch,
+                          block_size=args.block_size,
+                          num_blocks=args.num_blocks,
+                          pages_per_seq=args.pages_per_seq,
+                          max_queue_depth=args.max_queue_depth)
+    capacity = engine.capacity
+    prompt_hi = min(args.prompt_hi, max(args.prompt_lo,
+                                        capacity - args.max_new))
+
+    t_w0 = time.perf_counter()
+    signatures = 0 if args.no_warmup else engine.warmup()
+    warmup_s = time.perf_counter() - t_w0
+    engine.start()
+
+    stats = Stats()
+    gaps = []
+    gaps_mu = __import__('threading').Lock()
+    token_count = [0]
+
+    def do_request(rng):
+        plen = int(rng.randint(args.prompt_lo, prompt_hi + 1))
+        prompt = rng.randint(0, args.vocab, plen).tolist()
+        stream = engine.submit(prompt, max_new_tokens=args.max_new,
+                               temperature=args.temperature,
+                               seed=int(rng.randint(1 << 30)))
+        n, t_prev, local_gaps = 0, None, []
+        for _tok in stream:
+            now = time.perf_counter()
+            if t_prev is not None:
+                local_gaps.append(now - t_prev)
+            t_prev = now
+            n += 1
+        with gaps_mu:
+            gaps.extend(local_gaps)
+            token_count[0] += n
+        return n
+
+    t0 = time.perf_counter()
+    closed_loop(do_request, stats, t0 + args.duration, args.clients)
+    engine.shutdown(drain=True)
+    wall = time.perf_counter() - t0
+
+    snap = observe.snapshot()
+    counters = snap['counters']
+    misses = sum(v for k, v in counters.items()
+                 if k.startswith('executor.cache_miss_total'))
+    occ = snap['histograms'].get('decode.batch_occupancy', {})
+
+    report = {
+        'duration_s': round(wall, 4),
+        'clients': args.clients,
+        'requests_ok': stats.ok,
+        'requests_rejected': stats.rejected,
+        'requests_errored': stats.errors,
+        'tokens': token_count[0],
+        'tokens_per_s': round(token_count[0] / wall, 2) if wall else None,
+        'requests_per_s': round(stats.ok / wall, 2) if wall else None,
+        'request_ms': percentiles(stats.latencies),
+        'inter_token_ms': percentiles(gaps),
+        'batch_occupancy_mean': occ.get('mean'),
+        'preemptions': counters.get('decode.preemptions_total', 0),
+        'pool_exhausted': counters.get('decode.pool_exhausted_total', 0),
+        'kv_blocks_free_end': engine.pool.free_blocks(),
+        'warmup': {'signatures': signatures,
+                   'seconds': round(warmup_s, 4)},
+        'executor': {'cache_misses': misses},
+        'engine': {'max_batch': args.max_batch,
+                   'block_size': args.block_size,
+                   'num_blocks': args.num_blocks,
+                   'pages_per_seq': args.pages_per_seq,
+                   'capacity_tokens': capacity,
+                   'prompt_buckets': engine.prompt_buckets},
+        'model': {'vocab': args.vocab, 'n_layer': args.n_layer,
+                  'n_head': args.n_head, 'd_model': args.d_model},
+    }
+    observe.disable()
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        it = report['inter_token_ms']
+        rq = report['request_ms']
+        print('decode_bench: %d clients, %.2fs' % (args.clients, wall))
+        print('  requests   ok=%d rejected=%d errored=%d (%.1f req/s)'
+              % (stats.ok, stats.rejected, stats.errors,
+                 report['requests_per_s'] or 0.0))
+        print('  tokens     %d (%.1f tok/s), mean batch occupancy %.2f'
+              % (token_count[0], report['tokens_per_s'] or 0.0,
+                 occ.get('mean') or 0.0))
+        if it['p50'] is not None:
+            print('  inter-token ms p50=%.2f p95=%.2f p99=%.2f max=%.2f'
+                  % (it['p50'], it['p95'], it['p99'], it['max']))
+        if rq['p50'] is not None:
+            print('  request ms  p50=%.2f p95=%.2f p99=%.2f'
+                  % (rq['p50'], rq['p95'], rq['p99']))
+        print('  pool       preemptions=%d exhaustion-events=%d '
+              'free-at-end=%d/%d'
+              % (report['preemptions'], report['pool_exhausted'],
+                 engine.pool.free_blocks(), args.num_blocks))
+        print('  compiles   %d warmup signatures in %.2fs; %d total '
+              'misses' % (signatures, warmup_s, misses))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -72,6 +72,10 @@ QUEUE = [
     # lands in the metrics JSONL beside the throughput rows)
     ('pipeline_transformer', 'pipeline_transformer', None, 700),
     ('pipeline_resnet50', 'pipeline_resnet50', None, 700),
+    # decode serving: continuous batching + paged KV cache tokens/sec
+    # (PR 6); inter-token percentiles + decode.* metrics land in the
+    # shared metrics JSONL
+    ('decode_transformer', 'decode_transformer', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),

@@ -27,7 +27,6 @@ import json
 import os
 import sys
 import tempfile
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -50,97 +49,25 @@ def build_tiny_model(dirname, in_dim=8, hidden=16, classes=4):
     return dirname
 
 
-class _Stats(object):
-    """Thread-safe request ledger."""
-
-    def __init__(self):
-        self.mu = threading.Lock()
-        self.latencies = []
-        self.rows = 0
-        self.ok = 0
-        self.rejected = 0
-        self.errors = 0
-
-    def done(self, seconds, rows):
-        with self.mu:
-            self.latencies.append(seconds)
-            self.ok += 1
-            self.rows += rows
-
-    def reject(self):
-        with self.mu:
-            self.rejected += 1
-
-    def error(self):
-        with self.mu:
-            self.errors += 1
-
-
-def _percentiles(latencies):
-    if not latencies:
-        return {'p50': None, 'p95': None, 'p99': None, 'mean': None,
-                'max': None}
-    arr = np.sort(np.asarray(latencies, dtype=np.float64)) * 1000.0
-    pick = lambda q: float(arr[min(len(arr) - 1, int(q * len(arr)))])  # noqa
-    return {'p50': pick(0.50), 'p95': pick(0.95), 'p99': pick(0.99),
-            'mean': float(arr.mean()), 'max': float(arr[-1])}
-
-
 def _closed_loop(engine, make_feed, stats, deadline, clients):
-    from paddle_tpu.serving import QueueFullError
+    from paddle_tpu.serving.loadgen import closed_loop
 
-    def client(seed):
-        rng = np.random.RandomState(seed)
-        while time.perf_counter() < deadline:
-            feed, rows = make_feed(rng)
-            t0 = time.perf_counter()
-            try:
-                engine.predict(feed, timeout=60)
-            except QueueFullError:
-                stats.reject()
-                continue
-            except Exception:
-                stats.error()
-                continue
-            stats.done(time.perf_counter() - t0, rows)
+    def do_request(rng):
+        feed, rows = make_feed(rng)
+        engine.predict(feed, timeout=60)
+        return rows
 
-    threads = [threading.Thread(target=client, args=(1000 + i,),
-                                daemon=True) for i in range(clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    closed_loop(do_request, stats, deadline, clients)
 
 
 def _open_loop(engine, make_feed, stats, deadline, qps, seed=7):
-    from paddle_tpu.serving import QueueFullError
-    rng = np.random.RandomState(seed)
-    period = 1.0 / qps
-    next_t = time.perf_counter()
-    while time.perf_counter() < deadline:
-        now = time.perf_counter()
-        if now < next_t:
-            time.sleep(min(next_t - now, 0.005))
-            continue
-        next_t += period * float(rng.exponential(1.0))  # Poisson arrivals
-        feed, rows = make_feed(rng)
-        t0 = time.perf_counter()
-        try:
-            fut = engine.submit(feed)
-        except QueueFullError:
-            stats.reject()
-            continue
+    from paddle_tpu.serving.loadgen import open_loop
 
-        def _cb(f, t0=t0, rows=rows):
-            # latency clocked at resolution (dispatcher thread), not at
-            # a late collection point — open-loop p99 must not include
-            # generator bookkeeping
-            try:
-                f.result()
-                stats.done(time.perf_counter() - t0, rows)
-            except Exception:
-                stats.error()
-        fut.add_done_callback(_cb)
+    def submit_request(rng):
+        feed, rows = make_feed(rng)
+        return engine.submit(feed), rows
+
+    open_loop(submit_request, stats, deadline, qps, seed=seed)
     # engine.shutdown(drain=True) in main() is the completion barrier
 
 
@@ -176,6 +103,7 @@ def main(argv=None):
     from paddle_tpu import observe
     from paddle_tpu.inference import create_predictor
     from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.loadgen import Stats, percentiles
 
     model_dir = args.model_dir or build_tiny_model(
         os.path.join(tempfile.mkdtemp(prefix='serving_bench_'), 'model'))
@@ -219,7 +147,7 @@ def main(argv=None):
     warmup_s = time.perf_counter() - t_w0
     engine.start()
 
-    stats = _Stats()
+    stats = Stats()
     t0 = time.perf_counter()
     deadline = t0 + args.duration
     if args.mode == 'closed':
@@ -250,7 +178,7 @@ def main(argv=None):
         'throughput_rps': round(stats.ok / wall, 2) if wall else None,
         'throughput_rows_per_s': round(stats.rows / wall, 2)
         if wall else None,
-        'latency_ms': _percentiles(stats.latencies),
+        'latency_ms': percentiles(stats.latencies),
         'batch_size_mean': bsz.get('mean'),
         'padding_waste_mean': waste.get('mean'),
         'warmup': {'signatures': signatures,
