@@ -13,18 +13,26 @@ local pieces wired through the Trainer:
   (`CheckpointConfig(dirname, resume=True)`),
 - bad-step guards: a NaN/Inf sentinel on the fetched loss with a
   configurable policy (`guards.BadStepGuard`) and `reader.retry` for
-  transient input errors.
+  transient input errors,
+- ELASTIC resume: checkpoints are topology-neutral (io.py records the
+  writing mesh + per-var logical sharding specs), so a run preempted
+  on one slice restores on whatever slice comes back — params and
+  optimizer state reshard onto the new mesh, and the reader position
+  (kept in global stream units) replays exactly the untrained
+  remainder at the new dp width.
 
 `inject` is the deterministic fault-injection harness that proves the
-above end-to-end: kill at step k, truncate a checkpoint mid-write,
-poison batch k with NaNs, make a reader raise transiently.
+above end-to-end: kill or SIGTERM-preempt at step k, truncate a
+checkpoint mid-write, poison batch k with NaNs, make a reader raise
+transiently.
 """
 
 from .config import CheckpointConfig  # noqa: F401
-from .manager import CheckpointManager, LATEST_FILE  # noqa: F401
+from .manager import (CheckpointManager, LATEST_FILE,  # noqa: F401
+                      NoUsableCheckpointError)
 from .guards import BadStepError, BadStepGuard, NAN_POLICIES, is_bad  # noqa
 from . import inject  # noqa: F401
 
 __all__ = ['CheckpointConfig', 'CheckpointManager', 'LATEST_FILE',
-           'BadStepError', 'BadStepGuard', 'NAN_POLICIES', 'is_bad',
-           'inject']
+           'NoUsableCheckpointError', 'BadStepError', 'BadStepGuard',
+           'NAN_POLICIES', 'is_bad', 'inject']

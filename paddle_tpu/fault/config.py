@@ -20,7 +20,15 @@ class CheckpointConfig(object):
     resume: at train() start, restore params/optimizer state/global
         step/epoch/reader position from the newest COMPLETE checkpoint
         (sha1-verified; falls back to older ones on corruption) and
-        continue mid-epoch. A no-op when the tree is empty.
+        continue mid-epoch. A no-op when the tree is empty; raises
+        NoUsableCheckpointError when checkpoints exist but every one is
+        torn/incompatible (keep-last exhaustion is surfaced, never
+        silently retrained from scratch). Resume is ELASTIC: a
+        format-v2 checkpoint written on one mesh/host topology restores
+        on a different one — arrays reshard onto the restoring
+        program's mesh and the reader replays exactly the untrained
+        remainder at the new dp width (pre-elastic checkpoints are only
+        accepted on an unsharded single-host topology).
     async_save: device->host snapshot synchronously, serialize + write
         on a background thread (io.save_checkpoint's async path).
     epoch_end: also checkpoint at every epoch boundary (the legacy

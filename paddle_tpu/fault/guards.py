@@ -147,7 +147,13 @@ class BadStepGuard(object):
         # rollback
         meta = None
         if self._manager is not None:
-            meta = self._manager.restore(self._executor, self._program)
+            from .manager import NoUsableCheckpointError
+            try:
+                meta = self._manager.restore(self._executor, self._program)
+            except NoUsableCheckpointError:
+                # keep-last-K exhaustion: same terminal state as an
+                # empty tree for this policy — nothing to roll back to
+                meta = None
         if meta is None:
             err = BadStepError(
                 head + " — nan_policy='rollback' but no complete "

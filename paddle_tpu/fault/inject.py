@@ -1,10 +1,15 @@
 """Deterministic fault injection: the harness that PROVES the
 fault-tolerance layer instead of trusting it.
 
-Four injectable faults, each deterministic (fixed step index, no
+Five injectable faults, each deterministic (fixed step index, no
 randomness — reruns reproduce exactly):
 
-- kill the process once the global step reaches k (a preemption),
+- kill the process once the global step reaches k (os._exit — the
+  abrupt end of a preemption's grace window),
+- SIGTERM the process at step k (the preemption NOTICE itself: the
+  flight-recorder SIGTERM handler gets to dump a postmortem before the
+  default action terminates — what a real TPU preemption looks like
+  from inside),
 - truncate a checkpoint file right after it commits (a write torn by
   preemption, or bit-rot/partial copy that survived the atomic rename),
 - poison batch k's float arrays with NaNs (corrupt input),
@@ -15,9 +20,13 @@ step, the CheckpointManager calls fire('checkpoint_saved', ...) after
 each commit. Both are no-ops without an installed plan.
 
 Env contract (for subprocess crash/resume drills — the resumed run must
-NOT set these again or it re-dies at the same step):
+NOT set these again or it re-dies at the same step; an elastic-resume
+drill relaunches on a DIFFERENT mesh, see tests/fault_injection_child.py
+FT_MESH_DP):
 
     PADDLE_TPU_FI_KILL_AT_STEP=k     os._exit(42) at global step >= k
+    PADDLE_TPU_FI_PREEMPT_AT_STEP=k  SIGTERM self at global step >= k
+                                     (subprocess exit code -SIGTERM)
     PADDLE_TPU_FI_CORRUPT_CKPT_AT=k  truncate params.npz of the
                                      checkpoint committed at step k
 """
@@ -30,6 +39,7 @@ __all__ = ['KILL_EXIT_CODE', 'FaultPlan', 'TransientReaderError',
 
 KILL_EXIT_CODE = 42
 _ENV_KILL = 'PADDLE_TPU_FI_KILL_AT_STEP'
+_ENV_PREEMPT = 'PADDLE_TPU_FI_PREEMPT_AT_STEP'
 _ENV_CORRUPT = 'PADDLE_TPU_FI_CORRUPT_CKPT_AT'
 
 
@@ -38,9 +48,11 @@ class TransientReaderError(IOError):
 
 
 class FaultPlan(object):
-    def __init__(self, kill_at_step=None, corrupt_checkpoint_at_step=None):
+    def __init__(self, kill_at_step=None, corrupt_checkpoint_at_step=None,
+                 preempt_at_step=None):
         self.kill_at_step = kill_at_step
         self.corrupt_checkpoint_at_step = corrupt_checkpoint_at_step
+        self.preempt_at_step = preempt_at_step
 
 
 _active = None
@@ -67,12 +79,14 @@ def install_from_env(environ=None):
     if _active is not None:
         return _active
     kill = env.get(_ENV_KILL)
+    preempt = env.get(_ENV_PREEMPT)
     corrupt = env.get(_ENV_CORRUPT)
-    if kill is None and corrupt is None:
+    if kill is None and corrupt is None and preempt is None:
         return None
     plan = FaultPlan(
         kill_at_step=int(kill) if kill else None,
-        corrupt_checkpoint_at_step=int(corrupt) if corrupt else None)
+        corrupt_checkpoint_at_step=int(corrupt) if corrupt else None,
+        preempt_at_step=int(preempt) if preempt else None)
     install(plan)
     return plan
 
@@ -80,6 +94,25 @@ def install_from_env(environ=None):
 def fire(point, step=None, dirname=None):
     plan = _active
     if plan is None:
+        return
+    if (point == 'step_end' and plan.preempt_at_step is not None
+            and step is not None and step >= plan.preempt_at_step):
+        import signal
+        # one-shot: if a handler absorbs the signal (a unit test, or a
+        # grace-window drain), training continues instead of re-dying
+        # on every subsequent step — matching a real preemption notice,
+        # which is delivered once
+        plan.preempt_at_step = None
+        try:
+            from .. import observe as _obs
+            _obs.flight_event('preempt', step=step)
+        except Exception:
+            pass
+        # SIGTERM, not a hard kill: the armed flight-recorder handler
+        # (observe._install_sigterm_handler) dumps its postmortem, then
+        # chains to the default action, which terminates the process —
+        # exactly the shape of a cloud preemption notice
+        os.kill(os.getpid(), signal.SIGTERM)
         return
     if (point == 'step_end' and plan.kill_at_step is not None
             and step is not None and step >= plan.kill_at_step):

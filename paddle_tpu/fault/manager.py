@@ -30,11 +30,20 @@ from .. import io as _io
 from .. import observe as _obs
 from . import inject
 
-__all__ = ['CheckpointManager', 'LATEST_FILE', 'STEP_DIR_FMT']
+__all__ = ['CheckpointManager', 'NoUsableCheckpointError', 'LATEST_FILE',
+           'STEP_DIR_FMT']
 
 LATEST_FILE = 'LATEST'
 STEP_DIR_FMT = 'step_%08d'
 _STEP_RE = re.compile(r'^step_(\d{8,})$')
+
+
+class NoUsableCheckpointError(RuntimeError):
+    """restore() found checkpoint candidates but every one was torn,
+    corrupt, or incompatible with the restoring topology (keep-last-K
+    exhaustion). Distinct from an EMPTY tree, which restores nothing
+    and returns None — exhaustion means training state EXISTED and was
+    lost, so silently starting from scratch would be data loss."""
 
 
 class CheckpointManager(object):
@@ -165,13 +174,28 @@ class CheckpointManager(object):
     def restore(self, executor, main_program=None, reader=None):
         """Restore from the newest complete checkpoint; on a load
         failure (corruption the sha1 pass could not see) fall back to
-        the next older one. Returns the checkpoint meta dict (step /
-        reader / trainer keys) or None when no usable checkpoint
-        exists."""
+        the next older one. Detects an elastic-topology resume — the
+        recorded mesh/host count differs from the restoring program's —
+        and lets io.load_checkpoint reshard, emitting an
+        `elastic_reshard` flight event + `fault.reshard_total` counter;
+        candidates whose format predates the sharding specs are skipped
+        on a changed topology (they cannot be proven compatible).
+        Returns the checkpoint meta dict (step / reader / trainer keys),
+        None when the tree holds no checkpoints at all, or raises
+        NoUsableCheckpointError when candidates existed but every one
+        was unusable (keep-last-K exhaustion)."""
+        failures = []
         for step, path in self._candidates():
             try:
                 t0 = time.monotonic()
                 meta = _io.verify_checkpoint(path)
+                reshard = _io.topology_changed(meta, main_program)
+                if reshard and not meta.get('format_version'):
+                    raise ValueError(
+                        'predates the elastic checkpoint format (no '
+                        'per-variable sharding specs recorded) and the '
+                        'restoring topology differs from the unsharded '
+                        'legacy contract')
                 _io.load_checkpoint(
                     executor, path, main_program,
                     reader=reader if (reader is not None and
@@ -181,10 +205,30 @@ class CheckpointManager(object):
                 _obs.inc('fault.resume_total')
                 _obs.flight_event('checkpoint_restore', step=int(step),
                                   path=os.path.basename(path))
+                if reshard:
+                    rec = _io.checkpoint_topology(meta) or (1, {})
+                    cur = _io.current_topology(main_program)
+                    _obs.inc('fault.reshard_total')
+                    _obs.flight_event(
+                        'elastic_reshard', step=int(step),
+                        from_topology=_io.topology_str(*rec),
+                        to_topology=_io.topology_str(*cur))
                 return meta
             except Exception as e:
                 _obs.inc('fault.checkpoint_unusable_total')
+                failures.append('%s: %s: %s'
+                                % (os.path.basename(path),
+                                   type(e).__name__, e))
                 warnings.warn('CheckpointManager: checkpoint %r unusable '
                               '(%s: %s); falling back to the previous one'
                               % (path, type(e).__name__, e))
+        if failures:
+            raise NoUsableCheckpointError(
+                'CheckpointManager: %d checkpoint candidate(s) under %r '
+                'and NONE is usable — keep-last-%d retention is '
+                'exhausted:\n  %s\nTraining state existed here; starting '
+                'from scratch silently would be data loss. Repair or '
+                'remove the tree (or raise keep_last) and rerun.'
+                % (len(failures), self.dirname, self.config.keep_last,
+                   '\n  '.join(failures)))
         return None

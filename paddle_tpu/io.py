@@ -3,6 +3,18 @@
 Persistables (params + optimizer state + BN stats) are serialized from the
 Scope to an .npz bundle plus a JSON manifest — a single-file, orbax-free
 checkpoint format that round-trips bf16 via uint16 views.
+
+Checkpoints are TOPOLOGY-NEUTRAL (format_version 2): save gathers every
+var to its global host value and records the writing mesh (dp/pp/sp/tp/
+ep sizes + host count) in checkpoint.json plus each var's LOGICAL
+sharding spec (PartitionSpec axis names, never device positions) in the
+manifest. load_checkpoint compares the recorded topology against the
+restoring program's mesh and, when they differ, reshards every restored
+array onto the new mesh's NamedSharding — a run preempted on one slice
+resumes on whatever slice comes back (SNIPPETS [2]'s NamedSharding/
+GSPMD pattern: the checkpoint is independent of the mesh that wrote
+it). Pre-elastic checkpoints (no format_version) keep working on the
+same topology and fail with an actionable error on a different one.
 """
 
 import hashlib
@@ -10,19 +22,30 @@ import json
 import os
 import tempfile
 import threading
+import warnings
 
 import numpy as np
 
+from . import observe as _obs
 from .core.program import Parameter, default_main_program
 from .core.scope import global_scope
 
 __all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
            'load_params', 'load_persistables', 'save_inference_model',
            'load_inference_model', 'get_inference_program',
-           'save_checkpoint', 'load_checkpoint', 'verify_checkpoint']
+           'save_checkpoint', 'load_checkpoint', 'verify_checkpoint',
+           'checkpoint_topology', 'current_topology', 'topology_changed',
+           'topology_str', 'CHECKPOINT_FORMAT_VERSION']
 
 _PARAMS_FILE = 'params.npz'
 _MANIFEST_FILE = 'manifest.json'
+
+# 2: checkpoint.json records format_version / mesh / hosts, the manifest
+# records per-var logical sharding specs, and the reader state carries
+# its positional-shard width — together they make restore elastic.
+# Absent (format 1): the pre-elastic layout; valid only on the topology
+# that wrote it.
+CHECKPOINT_FORMAT_VERSION = 2
 
 
 def _is_parameter(var):
@@ -47,6 +70,79 @@ def _from_numpy(arr, dtype_name):
     return arr
 
 
+# ----------------------------------------------------- elastic topology
+def _spec_to_json(spec):
+    """PartitionSpec -> JSON list of axis names (None = replicated dim,
+    nested list = multi-axis dim). Logical names only — nothing about
+    device positions survives, which is what makes the record valid on
+    any future mesh."""
+    if spec is None:
+        return []
+    return [list(e) if isinstance(e, (tuple, list)) else e for e in spec]
+
+
+def _spec_from_json(entries, axis_names):
+    """Rebuild a PartitionSpec from its JSON form, dropping axis names
+    the restoring mesh does not have (a tp-split var written on a
+    dp x tp mesh restores replicated on a pure-dp mesh; GSPMD re-derives
+    the layout from whatever the new program's transpile says)."""
+    from jax.sharding import PartitionSpec
+    parts = []
+    for e in (entries or []):
+        if isinstance(e, (list, tuple)):
+            kept = [a for a in e if a in axis_names]
+            parts.append(tuple(kept) if len(kept) > 1
+                         else (kept[0] if kept else None))
+        else:
+            parts.append(e if (e is None or e in axis_names) else None)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def checkpoint_topology(meta):
+    """(hosts, {axis: size}) recorded in a checkpoint meta dict, or None
+    for a pre-elastic checkpoint (format_version absent)."""
+    if not meta or not meta.get('format_version'):
+        return None
+    sizes = {str(k): int(v) for k, v in (meta.get('mesh') or {}).items()}
+    return int(meta.get('hosts', 1)), sizes
+
+
+def current_topology(main_program=None):
+    """(hosts, {axis: size}) the restoring side runs on — process count
+    plus the program's mesh axis sizes (all ones when unsharded)."""
+    import jax
+    from .parallel.mesh import axis_sizes
+    main = main_program or default_main_program()
+    return jax.process_count(), axis_sizes(getattr(main, 'mesh', None))
+
+
+def topology_str(hosts, sizes):
+    """Compact human form: 'hosts=2 dp4xtp2', or 'single' when trivial."""
+    axes = 'x'.join('%s%d' % (a, s) for a, s in sorted(sizes.items())
+                    if int(s) > 1)
+    if hosts <= 1 and not axes:
+        return 'single'
+    return ('hosts=%d %s' % (hosts, axes or 'unsharded')).strip()
+
+
+def topology_changed(meta, main_program=None):
+    """True when the topology recorded in `meta` differs from the one
+    `main_program` restores on. A pre-elastic meta (None / no
+    format_version) recorded nothing, so it counts as changed whenever
+    the restoring topology is non-trivial — the caller cannot prove the
+    layouts line up."""
+    hosts, sizes = current_topology(main_program)
+    rec = checkpoint_topology(meta)
+    if rec is None:
+        return hosts > 1 or any(int(v) > 1 for v in sizes.values())
+    rhosts, rsizes = rec
+    axes = set(sizes) | set(rsizes)
+    return rhosts != hosts or any(
+        int(sizes.get(a, 1)) != int(rsizes.get(a, 1)) for a in axes)
+
+
 def _gather_to_host(value):
     """Multihost-sharded arrays are not fully addressable from one
     process; allgather the global value before serializing (the
@@ -67,6 +163,12 @@ def _snapshot_vars(main_program, vars=None, predicate=None):
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
     scope = global_scope()
+    # logical shardings travel with the manifest: the spec names mesh
+    # AXES, not devices, so a restore on any other mesh can rebuild the
+    # layout (or fall back to the new program's own transpile)
+    specs = (main_program.var_shardings
+             if main_program is not None and
+             getattr(main_program, 'mesh', None) is not None else None)
     arrays, manifest = {}, {}
     for v in vars:
         value = scope.find(v.name)
@@ -74,8 +176,11 @@ def _snapshot_vars(main_program, vars=None, predicate=None):
             continue
         arr, dtype_name = _to_numpy(_gather_to_host(value))
         arrays[v.name] = arr
-        manifest[v.name] = {'dtype': dtype_name,
-                            'shape': list(np.asarray(arr).shape)}
+        entry = {'dtype': dtype_name,
+                 'shape': list(np.asarray(arr).shape)}
+        if specs is not None:
+            entry['spec'] = _spec_to_json(specs.get(v.name))
+        manifest[v.name] = entry
     return arrays, manifest
 
 
@@ -288,24 +393,35 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     sha1: a crash between the renames leaves a pairing load_checkpoint
     detects and refuses instead of silently resuming the wrong step."""
     import jax
+    main = main_program or default_main_program()
     meta = {}
     if step is not None:
         meta['step'] = int(step)
     if reader is not None:
         # reader_pending: items pulled into a not-yet-run dispatch
-        # window — recorded as unconsumed so resume replays them
+        # window — recorded as unconsumed so resume replays them (the
+        # reader state converts per-host pending into global stream
+        # units via its positional-shard width; see reader/state.py)
         meta['reader'] = reader.state_dict(pending=reader_pending)
     if trainer_state is not None:
         meta['trainer'] = dict(trainer_state)
+    # elastic format: the writing topology rides in the meta so restore
+    # can tell whether it is coming back on a different slice
+    from .parallel.mesh import axis_sizes
+    meta['format_version'] = CHECKPOINT_FORMAT_VERSION
+    meta['mesh'] = axis_sizes(getattr(main, 'mesh', None))
+    meta['hosts'] = jax.process_count()
 
     def _install(arrays, manifest):
         # snapshot AND meta land under ONE lock acquisition: with the
         # meta write outside it, two overlapping saves could install
         # params from one and checkpoint.json from the other, tripping
         # the torn check on a healthy directory. Single writer, like
-        # save_persistables; positional sharding advances every host's
-        # reader identically, so process 0's (epoch, offset) is valid
-        # for all shards.
+        # save_persistables; the reader state is recorded in GLOBAL
+        # stream units (the positional shard advances every host's
+        # underlying reader identically and state_dict scales pending
+        # by the shard width), so process 0's (epoch, offset) is valid
+        # for all shards — at the writing host count or any other.
         with _SAVE_LOCK:
             man_sha, params_sha = _write_snapshot_locked(
                 dirname, arrays, manifest)
@@ -316,7 +432,6 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
                           lambda f: f.write(json.dumps(meta).encode()))
 
     if async_save and jax.process_count() == 1:
-        main = main_program or default_main_program()
         arrays, manifest = _snapshot_vars(main, predicate=_is_persistable)
         errbox = []
 
@@ -331,7 +446,6 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
         t.start()
         return AsyncSaveHandle(t, errbox)
 
-    main = main_program or default_main_program()
     arrays, manifest = _snapshot_vars(main, predicate=_is_persistable)
     if jax.process_index() == 0:
         _install(arrays, manifest)
@@ -390,21 +504,95 @@ def verify_checkpoint(dirname):
     return recorded
 
 
+def _reshard_restored(main, dirname):
+    """Eagerly rebuild every restored array under the restoring mesh's
+    NamedSharding (jax.device_put) instead of assuming the written
+    layout still applies. Spec priority: the new program's transpiled
+    var_shardings, then the manifest's recorded logical spec filtered
+    to the new mesh's axes, then replicated. Single-process only — on a
+    pod every host holds the full gathered value and the executor's
+    dispatch-time sharding path owns cross-host placement. Returns the
+    number of arrays placed."""
+    import jax
+    if jax.process_count() > 1:
+        return 0
+    from jax.sharding import NamedSharding
+    mesh = main.mesh
+    axis_names = set(str(a) for a in mesh.axis_names)
+    try:
+        with open(os.path.join(dirname, _MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    scope = global_scope()
+    n = 0
+    for name, entry in manifest.items():
+        value = scope.find(name)
+        if value is None:
+            continue
+        spec = main.var_shardings.get(name)
+        if spec is None:
+            spec = _spec_from_json(entry.get('spec'), axis_names)
+        try:
+            scope.set(name, jax.device_put(value,
+                                           NamedSharding(mesh, spec)))
+            n += 1
+        except Exception as e:
+            # an indivisible dim under the new mesh: leave the host
+            # array; the executor's with_sharding_constraint path pads
+            # inside the jitted step where uneven shards are legal
+            warnings.warn('load_checkpoint: could not reshard %r onto '
+                          'the restoring mesh (%s: %s); leaving it for '
+                          'dispatch-time placement' % (name,
+                                                       type(e).__name__,
+                                                       e))
+    return n
+
+
 def load_checkpoint(executor, dirname, main_program=None, reader=None):
+    """Restore a checkpoint, elastically: when the recorded topology
+    (mesh axis sizes + host count) differs from the restoring
+    program's, every array is re-placed under the new mesh's
+    NamedSharding and the reader state — kept in global stream units —
+    replays exactly the untrained remainder at the new dp width.
+    Pre-elastic checkpoints (no format_version) restore unchanged on
+    the same topology and are refused on a different one."""
+    main = main_program or default_main_program()
     path = os.path.join(dirname, 'checkpoint.json')
+    meta = None
     if os.path.exists(path):
-        verify_checkpoint(dirname)
-    load_persistables(executor, dirname, main_program)
-    if not os.path.exists(path):
-        if reader is not None:
-            raise ValueError(
-                'load_checkpoint: a reader was passed but %r holds no '
-                'checkpoint.json — resuming would silently re-consume '
-                'already-trained data (was save_checkpoint called with '
-                'reader=...?)' % dirname)
+        meta = verify_checkpoint(dirname)
+    elif reader is not None:
+        raise ValueError(
+            'load_checkpoint: a reader was passed but %r holds no '
+            'checkpoint.json — resuming would silently re-consume '
+            'already-trained data (was save_checkpoint called with '
+            'reader=...?)' % dirname)
+    changed = topology_changed(meta, main)
+    if changed and not (meta and meta.get('format_version')):
+        cur = topology_str(*current_topology(main))
+        raise ValueError(
+            'load_checkpoint: %r predates the elastic checkpoint format '
+            '(no format_version and no per-variable sharding specs '
+            'recorded) but the restoring topology is %s — the layouts '
+            'cannot be verified to line up. Restore it on an unsharded '
+            'single-host program (and re-save to upgrade it to format '
+            'version %d), or retrain.'
+            % (dirname, cur, CHECKPOINT_FORMAT_VERSION))
+    if meta is None:
+        # legacy save_persistables layout: restorable, but with zero
+        # integrity guarantees — make that visible in postmortems
+        warnings.warn(
+            'load_checkpoint: %r holds no checkpoint.json — restoring '
+            'WITHOUT sha1 verification; a torn write or partial copy '
+            'would go undetected here' % dirname)
+        _obs.flight_event('ckpt_unverified_restore', dirname=dirname)
+        _obs.inc('fault.unverified_restores_total')
+    load_persistables(executor, dirname, main)
+    if changed and getattr(main, 'mesh', None) is not None:
+        _reshard_restored(main, dirname)
+    if meta is None:
         return None
-    with open(path) as f:
-        meta = json.load(f)
     if reader is not None:
         state = meta.get('reader')
         if state is None:

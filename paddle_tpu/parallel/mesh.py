@@ -27,6 +27,29 @@ class MeshConfig(object):
     def active_axes(self):
         return [a for a in AXES if self.sizes[a] > 1]
 
+    def to_dict(self):
+        """JSON-able {axis: size} — the form checkpoints record."""
+        return {a: int(self.sizes[a]) for a in AXES}
+
+    @classmethod
+    def from_mesh(cls, mesh):
+        """MeshConfig describing a jax Mesh's canonical axes (a mesh of
+        None or without an axis means size 1 there)."""
+        sizes = axis_sizes(mesh)
+        return cls(**{a: sizes[a] for a in AXES})
+
+
+def axis_sizes(mesh):
+    """Canonical {axis: size} of a jax Mesh: every AXES entry present
+    (missing -> 1), extra axis names preserved. None -> the unsharded
+    all-ones topology. This is the topology signature checkpoints
+    record and elastic restore compares."""
+    sizes = {a: 1 for a in AXES}
+    if mesh is not None:
+        for a, s in dict(mesh.shape).items():
+            sizes[str(a)] = int(s)
+    return sizes
+
 
 def make_mesh(dp=None, pp=1, sp=1, tp=1, ep=1, devices=None):
     """Build a jax Mesh. dp=None means 'use all remaining devices'."""

@@ -166,5 +166,12 @@ def shard_reader(reader, drop_uneven=True):
     n = jax.process_count()
     if n == 1:
         return reader
+    from ..reader.state import CheckpointableReader
+    if isinstance(reader, CheckpointableReader):
+        # the wrapper pulls n global items per per-host yield: record
+        # the width so a checkpoint's (offset, pending) pair stays in
+        # global stream units — valid at this host count or, after an
+        # elastic resume, any other (reader/state.py state_dict)
+        reader.shard_width = n
     from ..reader.decorator import shard
     return shard(reader, n, jax.process_index(), drop_uneven=drop_uneven)

@@ -42,6 +42,14 @@ class CheckpointableReader(object):
         self._seed = int(seed)
         self.epoch = 0
         self.offset = 0
+        # Positional-shard width (parallel.multihost.shard_reader sets
+        # this to the host count when it wraps us). The shard wrapper
+        # sits OUTSIDE, so `offset` always counts GLOBAL stream items —
+        # width items advance here per one per-host yield. The Trainer's
+        # pending ledger counts PER-HOST yields; state_dict converts
+        # with this width, which is what keeps a checkpointed position
+        # valid when the run resumes at a different host count.
+        self.shard_width = 1
 
     def _epoch_stream(self):
         if not self._buf:
@@ -78,16 +86,27 @@ class CheckpointableReader(object):
         trained on (the Trainer's partially-filled dispatch window) —
         subtracted from offset so resume replays them. Callers must not
         pass a pending that spans an epoch boundary (offset resets to 0
-        there; the Trainer defers the save instead)."""
-        pending = int(pending)
+        there; the Trainer defers the save instead).
+
+        pending is in PER-HOST yield units while offset is in GLOBAL
+        stream units: under positional sharding one per-host yield
+        advances the underlying stream by shard_width items, so pending
+        is scaled before subtracting. The recorded offset is therefore
+        topology-neutral — a resume at any other host count replays
+        exactly the untrained global remainder. `hosts` records the
+        writing width for tooling/postmortems."""
+        width = max(1, int(self.shard_width))
+        pending = int(pending) * width
         if pending < 0 or pending > self.offset:
             raise ValueError(
-                'state_dict: pending=%d not in [0, offset=%d] — pulled-'
-                'but-untrained items cannot span an epoch boundary'
-                % (pending, self.offset))
+                'state_dict: pending=%d global items (pending x '
+                'shard_width=%d) not in [0, offset=%d] — pulled-but-'
+                'untrained items cannot span an epoch boundary'
+                % (pending, width, self.offset))
         return {'epoch': int(self.epoch),
                 'offset': int(self.offset) - pending,
-                'seed': self._seed, 'shuffle_buf': self._buf}
+                'seed': self._seed, 'shuffle_buf': self._buf,
+                'hosts': width}
 
     def load_state_dict(self, state):
         if int(state.get('seed', self._seed)) != self._seed or \
@@ -98,6 +117,10 @@ class CheckpointableReader(object):
                 'epoch order would differ from the trained one'
                 % (state.get('seed'), state.get('shuffle_buf'),
                    self._seed, self._buf))
+        # offset is global-stream units — no remap needed across a
+        # changed dp width (state['hosts'] is the WRITING width, kept
+        # for inspection; this reader's own shard_width is whatever the
+        # restoring topology set)
         self.epoch = int(state['epoch'])
         self.offset = int(state['offset'])
 

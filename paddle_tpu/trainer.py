@@ -234,6 +234,16 @@ class Trainer(object):
             if meta is not None:
                 # restart recovery is run overhead, not training time
                 _obs.overhead('restore', time.monotonic() - t_restore)
+                if meta.get('reader') and self._ckpt_reader is None \
+                        and reader is not None:
+                    import warnings
+                    warnings.warn(
+                        'resume: the checkpoint records a reader '
+                        'position but the passed reader is not a '
+                        'CheckpointableReader — the resumed stream '
+                        'will REPLAY already-trained items. Wrap it in '
+                        'reader.checkpointable(...) to resume '
+                        'mid-epoch.')
                 self._step = int(meta.get('step') or 0)
                 # RNG stream continuity (dropout masks): the executor's
                 # step key counter sits one ahead of the trainer's step

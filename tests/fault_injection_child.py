@@ -1,29 +1,50 @@
 """Child process for the crash/resume e2e (driven by tests/test_fault.py
 — NOT a test module itself).
 
-Trains a deterministic 2-layer model with mid-epoch checkpointing and
-writes the final parameters to an .npz. Environment contract:
+Trains a deterministic model with mid-epoch checkpointing and writes
+the final parameters to an .npz. Environment contract:
 
     FT_CKPT_DIR                  checkpoint tree root (required)
     FT_OUT                       final-params .npz path (required)
     FT_SYNC_SAVE                 optional: synchronous saves (so commit
                                  order is deterministic vs the kill step)
+    FT_MESH_DP=k                 optional: ELASTIC mode — transpile onto
+                                 a dp=k data-parallel mesh over 8 virtual
+                                 CPU devices and train the dyadic-exact
+                                 linear model (see below) at a fixed
+                                 global batch; resume runs may pass a
+                                 DIFFERENT k to exercise mesh resharding
+    FT_METRICS                   optional: observe JSONL snapshot path
+                                 (the driver asserts fault.reshard_total
+                                 appears after an elastic resume)
     PADDLE_TPU_FI_KILL_AT_STEP   optional: die (exit 42) at global step k
+    PADDLE_TPU_FI_PREEMPT_AT_STEP  optional: SIGTERM self at step k (the
+                                 preemption notice; exit code -SIGTERM)
     PADDLE_TPU_FI_CORRUPT_CKPT_AT  optional: truncate the checkpoint
                                  committed at step k
 
-Run once clean to get the reference params; run with the kill var to
-simulate preemption; run again WITHOUT it (resume=True picks up the
+Run once clean to get the reference params; run with a kill/preempt var
+to simulate preemption; run again WITHOUT it (resume=True picks up the
 newest complete checkpoint) and the final params must be bit-identical
 to the clean run — init, shuffle order, and updates are all
 deterministic, so any divergence is a checkpoint/replay bug.
+
+The elastic model keeps EVERY quantity an exactly-representable dyadic
+rational: integer data, zero init, L1 loss (each item's gradient
+contribution is ±x/8), lr = 2^-3. All cross-item sums are then exact in
+ANY association, so the update stream — and therefore the final params
+— is bitwise identical at ANY dp width, and the e2e's bit-identity
+assertion survives the reduction-order changes a different mesh shape
+introduces.
 """
 
 import os
 
 from paddle_tpu.core.platform_boot import force_host_cpu
 
-force_host_cpu()
+_MESH_DP = int(os.environ.get('FT_MESH_DP', '0') or 0)
+
+force_host_cpu(8 if _MESH_DP else None)
 
 import numpy as np  # noqa: E402
 
@@ -49,17 +70,52 @@ def batches():
         yield {'x': xs, 'y': (xs @ w).astype('float32')}
 
 
+def elastic_train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(
+        input=x, size=1,
+        param_attr=fluid.ParamAttr(
+            name='ew', initializer=fluid.initializer.Constant(0.0)),
+        bias_attr=fluid.ParamAttr(
+            name='eb', initializer=fluid.initializer.Constant(0.0)))
+    return [fluid.layers.mean(fluid.layers.abs(
+        fluid.layers.elementwise_sub(pred, y)))]
+
+
+def elastic_batches():
+    # integer data at a FIXED global batch of 8 (divisible by every dp
+    # width the drill uses: 2, 4, 8)
+    rng = np.random.RandomState(5)
+    w = rng.randint(-3, 4, (4, 1)).astype('float32')
+    for _ in range(12):
+        xs = rng.randint(-4, 5, (8, 4)).astype('float32')
+        yield {'x': xs, 'y': (xs @ w).astype('float32')}
+
+
 def main():
     ckpt_dir = os.environ['FT_CKPT_DIR']
     out = os.environ['FT_OUT']
-    reader = R.CheckpointableReader(batches, shuffle_buf=4, seed=11)
+    if os.environ.get('FT_METRICS'):
+        from paddle_tpu import observe
+        observe.enable(jsonl=os.environ['FT_METRICS'])
+    elastic = _MESH_DP > 0
+    reader = R.CheckpointableReader(
+        elastic_batches if elastic else batches, shuffle_buf=4, seed=11)
     cfg = CheckpointConfig(ckpt_dir, save_every_steps=3, keep_last=3,
                            resume=True,
                            async_save=not os.environ.get('FT_SYNC_SAVE'))
     trainer = fluid.Trainer(
-        train_func=train_func,
-        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        train_func=elastic_train_func if elastic else train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(
+            learning_rate=0.125 if elastic else 0.05),
         place=fluid.CPUPlace(), checkpoint_config=cfg)
+    if elastic:
+        from paddle_tpu.parallel.mesh import make_mesh
+        from paddle_tpu.parallel.transpiler import (ParallelStrategy,
+                                                    transpile)
+        transpile(fluid.default_main_program(), make_mesh(dp=_MESH_DP),
+                  ParallelStrategy(data_parallel=True))
     trainer.train(num_epochs=2, reader=reader)
     arrays, _ = pio._snapshot_vars(fluid.default_main_program(),
                                    predicate=pio._is_parameter)

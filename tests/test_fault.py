@@ -16,7 +16,8 @@ import paddle_tpu as fluid
 from paddle_tpu import io as pio
 from paddle_tpu import reader as R
 from paddle_tpu.fault import (BadStepError, CheckpointConfig,
-                              CheckpointManager, inject)
+                              CheckpointManager, NoUsableCheckpointError,
+                              inject)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -154,6 +155,238 @@ def test_find_latest_skips_torn_dir_without_meta(tmp_path):
     with pytest.warns(UserWarning, match='skipping'):
         found = mgr.find_latest()
     assert found[0] == 1
+
+
+def test_restore_exhaustion_raises_clear_error(tmp_path):
+    """Keep-last-K exhaustion (satellite): LATEST torn AND the older
+    candidate torn — restore must surface a clear NoUsableCheckpointError
+    naming the candidates, never an arbitrary FileNotFoundError and
+    never a silent from-scratch restart."""
+    d = str(tmp_path)
+    exe, step = _build_exe_model()
+    mgr = CheckpointManager(CheckpointConfig(d, keep_last=2,
+                                             async_save=False))
+    for s in (1, 2):
+        step()
+        mgr.save(exe, fluid.default_main_program(), step=s)
+    for s in (1, 2):
+        inject.truncate_file(os.path.join(mgr.step_dir(s), 'params.npz'))
+    with pytest.warns(UserWarning, match='unusable'):
+        with pytest.raises(NoUsableCheckpointError,
+                           match='NONE is usable') as ei:
+            mgr.restore(exe, fluid.default_main_program())
+    msg = str(ei.value)
+    assert 'step_00000002' in msg and 'step_00000001' in msg
+    assert not isinstance(ei.value, FileNotFoundError)
+
+
+# --------------------------------------------------- elastic topology
+def _build_meshed_model(dp, steps=2):
+    """MLP + Adam transpiled onto a dp mesh, trained `steps` steps on a
+    fixed batch; returns (exe, run_one_step)."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name='w'))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.default_main_program().random_seed = 7
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    transpile(fluid.default_main_program(), make_mesh(dp=dp),
+              ParallelStrategy(data_parallel=True))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.rand(8, 4).astype('f'),
+            'y': rng.rand(8, 1).astype('f')}
+    run = lambda: exe.run(feed=feed, fetch_list=[loss])  # noqa: E731
+    for _ in range(steps):
+        run()
+    return exe, run
+
+
+def test_restore_topology_change_reshards_and_counts(tmp_path):
+    """CheckpointManager.restore across a mesh change: params AND
+    optimizer state come back under the new mesh's NamedSharding, the
+    fault.reshard_total counter increments, and an elastic_reshard
+    flight event lands in the ring."""
+    import jax
+    from paddle_tpu import observe
+    d = str(tmp_path)
+    exe, _ = _build_meshed_model(dp=4)
+    mgr = CheckpointManager(CheckpointConfig(d, async_save=False))
+    mgr.save(exe, fluid.default_main_program(), step=2)
+
+    exe2, run2 = _build_meshed_model(dp=2, steps=0)
+    observe.enable()
+    try:
+        observe.flight_recorder().clear()
+        before = observe.get_counter('fault.reshard_total') or 0
+        meta = CheckpointManager(CheckpointConfig(d)).restore(
+            exe2, fluid.default_main_program())
+        assert meta['step'] == 2
+        assert meta['mesh']['dp'] == 4      # the WRITING topology
+        assert (observe.get_counter('fault.reshard_total')
+                or 0) == before + 1
+        evs = [e for e in observe.flight_recorder().events()
+               if e['kind'] == 'elastic_reshard']
+        assert evs and evs[-1]['data']['from_topology'] == 'hosts=1 dp4'
+        assert evs[-1]['data']['to_topology'] == 'hosts=1 dp2'
+    finally:
+        observe.flight_recorder().clear()
+        observe.disable()
+        observe.reset()
+    w = fluid.global_scope().find('w')
+    assert isinstance(w, jax.Array)
+    assert len(w.sharding.device_set) == 2  # placed on the dp=2 mesh
+    moment = next(n for n in fluid.global_scope().keys() if 'moment' in n)
+    assert isinstance(fluid.global_scope().find(moment), jax.Array)
+    run2()                                  # trains on the new mesh
+
+
+def test_restore_falls_back_past_pre_elastic_on_topology_change(tmp_path):
+    """A newer checkpoint whose format predates the sharding specs is
+    skipped (with a warning) when the topology changed; the older
+    format-v2 one restores instead."""
+    import json
+    d = str(tmp_path)
+    exe, run = _build_meshed_model(dp=4)
+    mgr = CheckpointManager(CheckpointConfig(d, async_save=False))
+    mgr.save(exe, fluid.default_main_program(), step=1)
+    run()
+    mgr.save(exe, fluid.default_main_program(), step=2)
+    # doctor the NEWEST checkpoint into the pre-elastic shape
+    meta_path = os.path.join(mgr.step_dir(2), 'checkpoint.json')
+    with open(meta_path) as f:
+        meta = json.load(f)
+    for key in ('format_version', 'mesh', 'hosts'):
+        meta.pop(key, None)
+    with open(meta_path, 'w') as f:
+        f.write(json.dumps(meta))
+
+    exe2, _ = _build_meshed_model(dp=2, steps=0)
+    with pytest.warns(UserWarning, match='elastic'):
+        got = CheckpointManager(CheckpointConfig(d)).restore(
+            exe2, fluid.default_main_program())
+    assert got['step'] == 1
+
+
+def test_preempt_at_step_sends_sigterm():
+    """inject preempt_at_step: a SIGTERM (the preemption notice), not a
+    hard kill — and one-shot, like the real notice."""
+    import signal
+    import time
+    received = []
+    prev = signal.signal(signal.SIGTERM,
+                         lambda signum, frame: received.append(signum))
+    try:
+        inject.install(inject.FaultPlan(preempt_at_step=5))
+        inject.fire('step_end', step=4)
+        assert not received
+        inject.fire('step_end', step=5)
+        for _ in range(200):            # delivery is async-signal-safe
+            if received:
+                break
+            time.sleep(0.005)
+        assert received == [signal.SIGTERM]
+        inject.fire('step_end', step=6)     # disarmed after firing
+        time.sleep(0.02)
+        assert received == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_preempt_env_contract(monkeypatch):
+    inject.clear()
+    plan = inject.install_from_env(
+        {'PADDLE_TPU_FI_PREEMPT_AT_STEP': '9'})
+    assert plan.preempt_at_step == 9 and plan.kill_at_step is None
+
+
+def test_reader_offset_stays_global_under_sharding():
+    """The io.py positional-sharding invariant, fixed: offset counts
+    GLOBAL stream items, pending is scaled by the shard width, and a
+    resume at a DIFFERENT width covers exactly the untrained remainder
+    — no item skipped, none double-trained."""
+    from paddle_tpu.reader.decorator import shard
+    items = list(range(24))
+    r = R.CheckpointableReader(lambda: iter(items))
+    r.shard_width = 4                       # what shard_reader sets
+    gen = shard(r, 4, 0)()
+    trained = [next(gen) for _ in range(3)]  # 3 per-host yields
+    gen.close()
+    assert r.offset == 12                   # 4 global pulls per yield
+    state = r.state_dict(pending=1)         # 1 pulled-but-untrained
+    assert state['offset'] == 8             # ...scaled to 4 global items
+    assert state['hosts'] == 4
+    assert trained[0] in items[:4]
+
+    # resume as dp=2: the two hosts' shards are disjoint and together
+    # cover exactly global items 8..23
+    streams = []
+    for host in range(2):
+        r2 = R.CheckpointableReader(lambda: iter(items))
+        r2.load_state_dict(state)
+        streams.append(list(shard(r2, 2, host)()))
+    assert sorted(streams[0] + streams[1]) == items[8:]
+    assert not set(streams[0]) & set(streams[1])
+
+
+def test_reader_pending_exceeding_offset_raises_in_global_units():
+    r = R.CheckpointableReader(lambda: iter(range(10)))
+    r.shard_width = 4
+    gen = r()
+    for _ in range(4):
+        next(gen)
+    gen.close()
+    with pytest.raises(ValueError, match='pending'):
+        r.state_dict(pending=2)             # 8 global > offset 4
+
+
+# -------------------------------------------------- ckpt_inspect tool
+def test_ckpt_inspect_cli_json_schema(tmp_path):
+    """tools/ckpt_inspect.py --json on a real (meshed) checkpoint tree:
+    step, mesh, specs, reader state, and sha1 verification status."""
+    d = str(tmp_path)
+    exe, _ = _build_meshed_model(dp=4)
+    reader = R.CheckpointableReader(lambda: iter(_batches(6)))
+    gen = reader()
+    next(gen)
+    gen.close()
+    mgr = CheckpointManager(CheckpointConfig(d, async_save=False))
+    mgr.save(exe, fluid.default_main_program(), step=2, reader=reader,
+             trainer_state={'epoch': 0, 'epoch_step': 2})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'ckpt_inspect.py'),
+         d, '--json'], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc['kind'] == 'paddle_tpu_checkpoint'
+    assert doc['step'] == 2
+    assert doc['format_version'] == 2
+    assert doc['mesh']['dp'] == 4
+    assert doc['verification'] == 'ok'
+    assert doc['reader']['offset'] == 1
+    assert doc['trainer'] == {'epoch': 0, 'epoch_step': 2}
+    assert doc['n_vars'] == len(doc['vars']) and doc['n_vars'] > 0
+    assert all('spec' in e for e in doc['vars'].values())
+    # text mode renders without error
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'ckpt_inspect.py'),
+         d], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert 'verification    ok' in r.stdout
+    # a torn checkpoint is reported as torn, not a traceback
+    inject.truncate_file(os.path.join(mgr.step_dir(2), 'params.npz'))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'ckpt_inspect.py'),
+         mgr.step_dir(2), '--json'], capture_output=True, text=True,
+        timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)['verification'].startswith('torn')
 
 
 # ----------------------------------------------------- NaN-policy matrix
@@ -308,7 +541,8 @@ def test_resume_noop_on_empty_tree(tmp_path):
 def _run_child(tmp, tag, extra_env, reuse_ckpt=None):
     env = dict(os.environ)
     for k in ('PADDLE_TPU_FI_KILL_AT_STEP', 'PADDLE_TPU_FI_CORRUPT_CKPT_AT',
-              'PADDLE_TPU_FLIGHT_DUMP', 'XLA_FLAGS'):
+              'PADDLE_TPU_FI_PREEMPT_AT_STEP', 'PADDLE_TPU_FLIGHT_DUMP',
+              'FT_MESH_DP', 'FT_METRICS', 'XLA_FLAGS'):
         env.pop(k, None)
     env['JAX_PLATFORMS'] = 'cpu'
     env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
@@ -394,6 +628,96 @@ def test_e2e_corrupt_newest_checkpoint_falls_back(tmp_path, clean_run):
     assert p.returncode == 0, p.stderr
     assert 'unusable' in p.stderr or 'falling back' in p.stderr
     _assert_bit_identical(clean_run, np.load(out))
+
+
+# -------------------------------- elastic-topology crash/resume e2e
+# Train on a dp=4 CPU mesh, preempt (SIGTERM) mid-epoch, resume on a
+# DIFFERENT dp width at the same global batch: final params must be
+# bit-identical to the uninterrupted dp=4 run. The child's elastic
+# model keeps every quantity an exact dyadic rational (integer data, L1
+# loss, 2^-k learning rate), so cross-item sums are exact in any
+# association and bit-identity genuinely survives the reduction-order
+# changes a different mesh shape introduces.
+
+@pytest.fixture(scope='module')
+def elastic_clean_run(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('ft_elastic_clean')
+    p, _, out = _run_child(tmp, 'clean', {'FT_MESH_DP': '4'})
+    assert p.returncode == 0, p.stderr
+    return np.load(out)
+
+
+def _sigterm_rc():
+    import signal
+    return -int(signal.SIGTERM)
+
+
+def test_e2e_preempt_dp4_resume_dp2_bit_identical(tmp_path,
+                                                  elastic_clean_run):
+    # preemption notice at step 7: SIGTERM, so the armed flight
+    # recorder's handler writes the postmortem before the default
+    # action terminates the process
+    pm = os.path.join(str(tmp_path), 'postmortem.json')
+    p, ckpt, out = _run_child(tmp_path, 'preempted',
+                              {'FT_MESH_DP': '4',
+                               'PADDLE_TPU_FI_PREEMPT_AT_STEP': '7',
+                               'PADDLE_TPU_FLIGHT_DUMP': pm})
+    assert p.returncode == _sigterm_rc(), (p.returncode, p.stderr)
+    assert not os.path.exists(out)
+    with open(pm) as f:
+        doc = json.load(f)
+    assert doc['reason'] == 'sigterm'
+    kinds = [e['kind'] for e in doc['events']]
+    assert 'preempt' in kinds and 'checkpoint_save' in kinds
+
+    # come back on HALF the slice: mesh {dp:4} -> {dp:2}, same global
+    # batch — restore reshards, the reader replays the exact remainder
+    metrics = os.path.join(str(tmp_path), 'metrics.jsonl')
+    p, _, out = _run_child(tmp_path, 'resumed_dp2',
+                           {'FT_MESH_DP': '2', 'FT_METRICS': metrics},
+                           reuse_ckpt=ckpt)
+    assert p.returncode == 0, p.stderr
+    _assert_bit_identical(elastic_clean_run, np.load(out))
+    # the reshard is visible in the metrics snapshot
+    with open(metrics) as f:
+        snaps = [json.loads(line) for line in f if line.strip()]
+    counters = snaps[-1]['counters']
+    assert counters.get('fault.reshard_total') == 1
+    assert counters.get('fault.resume_total') == 1
+
+
+@pytest.mark.slow
+def test_e2e_elastic_sweep_dp2_and_dp8(tmp_path, elastic_clean_run):
+    """Full dp in {2, 8} sweep: dp=4 preempted -> dp=2 resumes and is
+    preempted AGAIN (its postmortem must carry the elastic_reshard
+    event) -> dp=8 finishes; final params bit-identical to the
+    uninterrupted dp=4 run."""
+    p, ckpt, out = _run_child(tmp_path, 'sweep',
+                              {'FT_MESH_DP': '4',
+                               'PADDLE_TPU_FI_PREEMPT_AT_STEP': '7'})
+    assert p.returncode == _sigterm_rc(), (p.returncode, p.stderr)
+
+    pm2 = os.path.join(str(tmp_path), 'postmortem_dp2.json')
+    p, _, out = _run_child(tmp_path, 'sweep_dp2',
+                           {'FT_MESH_DP': '2',
+                            'PADDLE_TPU_FI_PREEMPT_AT_STEP': '16',
+                            'PADDLE_TPU_FLIGHT_DUMP': pm2},
+                           reuse_ckpt=ckpt)
+    assert p.returncode == _sigterm_rc(), (p.returncode, p.stderr)
+    with open(pm2) as f:
+        doc = json.load(f)
+    kinds = [e['kind'] for e in doc['events']]
+    assert 'elastic_reshard' in kinds    # the dp4 -> dp2 restore
+    assert 'preempt' in kinds
+    ev = next(e for e in doc['events'] if e['kind'] == 'elastic_reshard')
+    assert ev['data']['from_topology'] == 'hosts=1 dp4'
+    assert ev['data']['to_topology'] == 'hosts=1 dp2'
+
+    # second elastic hop: dp2's checkpoints resume on dp=8 and finish
+    p, _, out = _run_child(tmp_path, 'sweep_dp8', {'FT_MESH_DP': '8'},
+                           reuse_ckpt=ckpt)
+    assert p.returncode == 0, p.stderr
+    _assert_bit_identical(elastic_clean_run, np.load(out))
 
 
 # --------------------------------------------------- satellite regressions

@@ -1,0 +1,197 @@
+"""Inspect a paddle_tpu checkpoint directory — stdlib only, no jax.
+
+Prints what a checkpoint actually holds before you bet a resume on it:
+the step, trainer (epoch / in-epoch step) and reader (epoch / offset /
+seed / shard width) state, the WRITING topology (format version, mesh
+axis sizes, host count), the per-variable logical sharding specs
+recorded in the manifest, and whether the recorded sha1s still match
+the installed files (the torn-checkpoint check io.verify_checkpoint
+performs — recomputed here without importing paddle_tpu, so it runs on
+a bastion host with nothing but python3).
+
+    python tools/ckpt_inspect.py /ckpt/run42                # newest step dir
+    python tools/ckpt_inspect.py /ckpt/run42/step_00000012  # one checkpoint
+    python tools/ckpt_inspect.py DIR --json | jq .verification
+    python tools/ckpt_inspect.py DIR --no-verify            # skip sha1 pass
+    python tools/ckpt_inspect.py DIR --vars 50              # longer var table
+
+Companion of ``tools/flight_report.py`` (postmortems) and
+``tools/metrics_report.py`` (metrics JSONL).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+
+_STEP_RE = re.compile(r'^step_(\d{8,})$')
+_PARAMS_FILE = 'params.npz'
+_MANIFEST_FILE = 'manifest.json'
+
+
+def _sha1_of(path):
+    h = hashlib.sha1()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def resolve_dir(dirname):
+    """Accept a checkpoint dir OR a managed tree root (pick the newest
+    step dir, the same newest-first scan CheckpointManager uses —
+    LATEST is a convenience pointer, not the source of truth)."""
+    if os.path.exists(os.path.join(dirname, 'checkpoint.json')) or \
+            os.path.exists(os.path.join(dirname, _MANIFEST_FILE)):
+        return dirname
+    steps = []
+    try:
+        for n in os.listdir(dirname):
+            m = _STEP_RE.match(n)
+            if m and os.path.isdir(os.path.join(dirname, n)):
+                steps.append((int(m.group(1)), os.path.join(dirname, n)))
+    except OSError:
+        pass
+    if not steps:
+        raise SystemExit('%s: neither a checkpoint directory nor a '
+                         'managed tree with step_* dirs' % dirname)
+    return max(steps)[1]
+
+
+def _verify(dirname, meta):
+    """'ok' | 'unverified: ...' | 'torn: ...' — mirrors
+    io.verify_checkpoint without importing it."""
+    if meta is None:
+        return 'unverified: no checkpoint.json (pre-checkpoint legacy '\
+               'layout, or the save died before the meta rename)'
+    problems = []
+    for key, fname in (('params_sha1', _PARAMS_FILE),
+                       ('manifest_sha1', _MANIFEST_FILE)):
+        want = meta.get(key)
+        if want is None:
+            problems.append('%s not recorded' % key)
+            continue
+        fpath = os.path.join(dirname, fname)
+        if not os.path.exists(fpath):
+            problems.append('%s is missing' % fname)
+        elif _sha1_of(fpath) != want:
+            problems.append('%s sha1 mismatch' % fname)
+    if problems:
+        return 'torn: ' + '; '.join(problems)
+    return 'ok'
+
+
+def inspect(dirname, verify=True):
+    dirname = resolve_dir(dirname)
+    meta = None
+    meta_path = os.path.join(dirname, 'checkpoint.json')
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except ValueError:
+            return {'kind': 'paddle_tpu_checkpoint', 'dirname': dirname,
+                    'verification': 'torn: checkpoint.json does not '
+                                    'parse'}
+    manifest = {}
+    man_path = os.path.join(dirname, _MANIFEST_FILE)
+    if os.path.exists(man_path):
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except ValueError:
+            manifest = {}
+    doc = {
+        'kind': 'paddle_tpu_checkpoint',
+        'dirname': dirname,
+        'step': (meta or {}).get('step'),
+        'format_version': (meta or {}).get('format_version'),
+        'mesh': (meta or {}).get('mesh'),
+        'hosts': (meta or {}).get('hosts'),
+        'trainer': (meta or {}).get('trainer'),
+        'reader': (meta or {}).get('reader'),
+        'verification': (_verify(dirname, meta) if verify
+                         else 'skipped (--no-verify)'),
+        'n_vars': len(manifest),
+        'vars': {name: {'dtype': e.get('dtype'),
+                        'shape': e.get('shape'),
+                        'spec': e.get('spec')}
+                 for name, e in sorted(manifest.items())},
+    }
+    doc['sharded_vars'] = sorted(
+        n for n, e in manifest.items() if e.get('spec'))
+    return doc
+
+
+def _fmt_mesh(mesh, hosts):
+    if not mesh:
+        return 'not recorded (pre-elastic format: same-topology '\
+               'restore only)'
+    active = ['%s=%d' % (a, s) for a, s in sorted(mesh.items())
+              if int(s) > 1]
+    return '%s hosts=%s' % (' '.join(active) or 'unsharded', hosts or 1)
+
+
+def render(doc, max_vars):
+    out = []
+    out.append('checkpoint  %s' % doc['dirname'])
+    out.append('  step            %s' % doc.get('step'))
+    out.append('  format_version  %s%s'
+               % (doc.get('format_version'),
+                  '' if doc.get('format_version') else
+                  '  (pre-elastic)'))
+    out.append('  mesh            %s'
+               % _fmt_mesh(doc.get('mesh'), doc.get('hosts')))
+    tr = doc.get('trainer')
+    if tr:
+        out.append('  trainer         epoch=%s epoch_step=%s'
+                   % (tr.get('epoch'), tr.get('epoch_step')))
+    rd = doc.get('reader')
+    if rd:
+        out.append('  reader          epoch=%s offset=%s seed=%s '
+                   'shuffle_buf=%s hosts=%s'
+                   % (rd.get('epoch'), rd.get('offset'), rd.get('seed'),
+                      rd.get('shuffle_buf'), rd.get('hosts', 1)))
+    out.append('  verification    %s' % doc.get('verification'))
+    out.append('  vars            %d (%d with a sharded spec)'
+               % (doc.get('n_vars', 0), len(doc.get('sharded_vars', []))))
+    shown = list(doc.get('vars', {}).items())[:max_vars]
+    if shown:
+        w = max(len(n) for n, _ in shown)
+        for name, e in shown:
+            spec = e.get('spec')
+            out.append('    %-*s  %-8s %-16s %s'
+                       % (w, name, e.get('dtype'),
+                          'x'.join(str(d) for d in (e.get('shape') or []))
+                          or 'scalar',
+                          json.dumps(spec) if spec else ''))
+        if len(doc.get('vars', {})) > max_vars:
+            out.append('    ... %d more (--vars N to widen)'
+                       % (len(doc['vars']) - max_vars))
+    return '\n'.join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Inspect a paddle_tpu checkpoint directory.')
+    ap.add_argument('dirname', help='checkpoint dir or managed tree root')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the full machine-readable document')
+    ap.add_argument('--no-verify', action='store_true',
+                    help='skip the sha1 recompute (large params.npz)')
+    ap.add_argument('--vars', type=int, default=20, metavar='N',
+                    help='max vars in the text table (default 20)')
+    args = ap.parse_args(argv)
+    doc = inspect(args.dirname, verify=not args.no_verify)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write('\n')
+    else:
+        print(render(doc, args.vars))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
