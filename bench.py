@@ -720,6 +720,150 @@ def pallas_parity():
     return out
 
 
+def bench_autotune(seqs=(1024, 4096), batch_tokens=4096, d=64, heads=8,
+                   iters=5, backend='cpu', child_timeout=240.0):
+    """ISSUE 8: the autotuner + AOT warm-start A/B. Two phases:
+
+    1. **tuned vs default-gated attention** at the BENCH_builder_r4
+       shapes (seq 1024/4096, d_head 64): a fresh tuning table is
+       measured in-process (PADDLE_TPU_AUTOTUNE=on), then the tuner's
+       pick is timed against the env-gated default (XLA, since
+       PADDLE_TPU_USE_PALLAS is unset). The r4 capture says the winner
+       FLIPS between these shapes — `winners_differ` records whether
+       this chip agrees, and the table lands beside the store for
+       tools/tuning_inspect.py.
+    2. **cold vs warm startup**: the same trainer-shaped program runs
+       in two subprocesses sharing one fresh AOT cache dir
+       (PADDLE_TPU_AOT_CACHE=1); the second should reach its first
+       step on deserialized executables. Gauges
+       aot.cold/warm_start_seconds land in the metrics JSONL so
+       tools/metrics_report.py shows the win.
+    """
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import observe, tuning
+    from paddle_tpu.ops.attention_ops import reference_attention
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    out = {}
+    tmp = tempfile.mkdtemp(prefix='paddle_tpu_autotune_')
+    table_path = os.path.join(tmp, 'tuning.json')
+    os.environ['PADDLE_TPU_TUNING_TABLE'] = table_path
+    os.environ['PADDLE_TPU_AUTOTUNE'] = 'on'
+    tuning.reset()
+    rng = np.random.RandomState(0)
+
+    def timed(fn, *args):
+        np.asarray(fn(*args))           # compile + warm (relay sync)
+        best = float('inf')
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    winners = []
+    for seq in seqs:
+        batch = max(1, batch_tokens // seq)
+        shape = (batch, heads, seq, d)
+        q, k, v = (jnp.asarray(rng.randn(*shape) * 0.1, jnp.bfloat16)
+                   for _ in range(3))
+        default_fn = jax.jit(
+            lambda q, k, v: reference_attention(q, k, v, causal=True))
+        picked = tuning.decide_attention(batch, heads, seq, seq, d,
+                                         'bfloat16', True, False) or \
+            {'impl': 'xla'}
+        if picked.get('impl') == 'pallas':
+            bq, bk = picked.get('block_q'), picked.get('block_k')
+            tuned_fn = jax.jit(
+                lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk))
+        else:
+            tuned_fn = default_fn
+        d_ms = timed(default_fn, q, k, v) * 1e3
+        t_ms = timed(tuned_fn, q, k, v) * 1e3
+        out['seq%d_default_ms' % seq] = round(d_ms, 3)
+        out['seq%d_tuned_ms' % seq] = round(t_ms, 3)
+        out['seq%d_winner' % seq] = picked.get('impl')
+        winners.append(picked.get('impl'))
+        observe.set_gauge('tuning.bench_speedup', d_ms / max(t_ms, 1e-9),
+                          seq=seq)
+    out['winners_differ'] = len(set(winners)) > 1
+    out['table_entries'] = tuning.current_table().size()
+    out['table_path'] = table_path
+
+    # ---- phase 2: cold vs warm AOT startup (subprocess pair) ----
+    cache_dir = os.path.join(tmp, 'aot_cache')
+    env = dict(os.environ)
+    env.update({'PADDLE_TPU_AOT_CACHE': '1',
+                'PADDLE_TPU_AOT_CACHE_DIR': cache_dir})
+    env.pop('PADDLE_TPU_METRICS_JSONL', None)   # children report via JSON
+    cmd = [sys.executable, os.path.abspath(__file__),
+           '--workload', 'autotune_child', '--backend', backend]
+
+    def run_child():
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=child_timeout, env=env)
+        except subprocess.TimeoutExpired:
+            return None
+        for line in reversed((r.stdout or '').splitlines()):
+            if line.startswith('RESULT_JSON '):
+                return json.loads(line[len('RESULT_JSON '):])
+        return None
+
+    cold = run_child()
+    warm = run_child()
+    if cold and warm:
+        out['cold_start_seconds'] = cold['startup_seconds']
+        out['warm_start_seconds'] = warm['startup_seconds']
+        out['warm_from_disk_keys'] = warm['aot_hits']
+        out['warm_compile_events'] = warm['compile_flight_events']
+        observe.set_gauge('aot.cold_start_seconds',
+                          cold['startup_seconds'])
+        observe.set_gauge('aot.warm_start_seconds',
+                          warm['startup_seconds'])
+        observe.set_gauge('aot.warm_from_disk_keys', warm['aot_hits'])
+    else:
+        out['startup_ab_error'] = 'child failed or timed out'
+    return out
+
+
+def _autotune_startup_child():
+    """One cold-or-warm startup measurement: build a trainer-shaped MLP
+    program, run two steps, report wall from entry to the first fetch
+    plus the executor's AOT ledger and the compile flight-event count
+    (zero on a warm run — the acceptance check)."""
+    from paddle_tpu import observe
+    observe.arm_flight()    # count 'compile' events even with metrics off
+    t0 = time.perf_counter()
+    fluid = _fresh()
+    x = fluid.layers.data(name='x', shape=[256], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = x
+    for _ in range(4):
+        h = fluid.layers.fc(input=h, size=256, act='relu')
+    pred = fluid.layers.fc(input=h, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(
+        input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    feed = {'x': np.ones((8, 256), 'float32'),
+            'y': np.ones((8, 1), 'float32')}
+    first = exe.run(feed=feed, fetch_list=[cost])
+    startup = time.perf_counter() - t0
+    np.asarray(exe.run(feed=feed, fetch_list=[cost])[0])
+    compiles = sum(1 for e in observe.flight_recorder().events()
+                   if e.get('kind') == 'compile')
+    return {'startup_seconds': round(startup, 4),
+            'first_loss': float(np.asarray(first[0]).reshape(())),
+            'aot_hits': exe.aot_stats['hits'],
+            'aot_saves': exe.aot_stats['saves'],
+            'compile_flight_events': compiles}
+
+
 def _run_workload_child(workload, backend, reduced):
     """Child-process entry: run ONE workload, print 'RESULT <number>'."""
     from paddle_tpu import observe
@@ -743,6 +887,19 @@ def _run_workload_child(workload, backend, reduced):
     arm_compile_cache()
     if workload == 'pallas_parity':
         print('RESULT_JSON %s' % json.dumps(pallas_parity()), flush=True)
+        return
+    if workload == 'autotune':
+        kw = dict(seqs=(512,), batch_tokens=512, iters=2,
+                  child_timeout=180.0) if reduced else {}
+        if backend == 'cpu':
+            os.environ.setdefault('PADDLE_TPU_PALLAS_INTERPRET', '1')
+        print('RESULT_JSON %s'
+              % json.dumps(bench_autotune(backend=backend, **kw)),
+              flush=True)
+        return
+    if workload == 'autotune_child':
+        print('RESULT_JSON %s' % json.dumps(_autotune_startup_child()),
+              flush=True)
         return
     if workload == 'resnet50_anatomy':
         kw = dict(batch=4, image=64, iters=3) if reduced else {}
@@ -1325,7 +1482,8 @@ if __name__ == '__main__':
                                 'moe_cap1.25', 'moe_cap2.0',
                                 'pipeline_transformer',
                                 'pipeline_resnet50',
-                                'decode_transformer'])
+                                'decode_transformer', 'autotune',
+                                'autotune_child'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

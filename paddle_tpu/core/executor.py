@@ -148,7 +148,8 @@ class StepHandle(object):
 
 class _Compiled(object):
     __slots__ = ('fn', 'raw_fn', 'scope_in_names', 'scope_out_names',
-                 'feed_names', 'fetch_names', 'flops')
+                 'feed_names', 'fetch_names', 'flops', 'aot_fp',
+                 'aot_state')
 
     def __init__(self, fn, raw_fn, scope_in_names, scope_out_names,
                  feed_names, fetch_names):
@@ -159,6 +160,9 @@ class _Compiled(object):
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.flops = None  # per-step XLA cost-analysis FLOPs (observe)
+        self.aot_fp = None      # aot_cache fingerprint, when cacheable
+        self.aot_state = None   # None | 'save' (serialize at dispatch)
+                                # | 'warm' (fn deserialized from disk)
 
 
 _SUB_BLOCK_ATTRS = ('sub_block', 'true_block', 'false_block')
@@ -251,6 +255,12 @@ class Executor(object):
         self._dispatch_lock = threading.Lock()
         self._tls = threading.local()
         self._step = 0
+        # AOT serialized-executable cache ledger (core/aot_cache.py):
+        # warm-start hits/misses and load seconds, read by warmup()
+        # wiring in serving/decode engines and the trainer. Mutated
+        # under self._lock.
+        self.aot_stats = {'hits': 0, 'misses': 0, 'saves': 0,
+                          'load_failures': 0, 'load_seconds': 0.0}
         from .platform_boot import arm_compile_cache
         arm_compile_cache()
 
@@ -265,6 +275,17 @@ class Executor(object):
     def last_cache_miss(self, value):
         self._tls.last_cache_miss = value
 
+    @property
+    def last_warm_from_disk(self):
+        """Whether THIS thread's most recent run()/run_steps() call
+        installed its executable from the AOT disk cache instead of
+        tracing+compiling (thread-local, like last_cache_miss)."""
+        return getattr(self._tls, 'last_warm_from_disk', False)
+
+    @last_warm_from_disk.setter
+    def last_warm_from_disk(self, value):
+        self._tls.last_warm_from_disk = value
+
     def _next_steps(self, n):
         """Atomically claim n global step indices (dropout keys fold
         the step index; two threads must never share one)."""
@@ -273,13 +294,21 @@ class Executor(object):
             self._step += n
         return np.int32(step0)
 
-    def _lookup_or_compile(self, kind, key, use_cache, compile_fn):
+    def _lookup_or_compile(self, kind, key, use_cache, compile_fn,
+                           program=None, aot_parts=None):
         """Compile-cache access, safe under concurrent serving threads:
         a hit is one locked dict read; a miss takes a per-key lock so
         two threads racing on the same (program, shapes) signature
         compile ONCE — the loser blocks, then reads the winner's entry
         as a hit. Distinct keys still compile concurrently. Returns
-        (compiled, missed)."""
+        (compiled, missed).
+
+        On a miss, the AOT serialized-executable cache is consulted
+        first (core/aot_cache.py): a disk hit installs the deserialized
+        executable — zero trace, zero XLA compile, none of the
+        cache_miss/trace/compile events — and a disk miss marks the
+        entry for serialization at its first dispatch (when the
+        concrete input avals exist)."""
         if not use_cache:
             return self._observed_compile(kind, key, compile_fn), True
         with self._lock:
@@ -293,10 +322,96 @@ class Executor(object):
                 compiled = self._cache.get(key)
             if compiled is not None:
                 return compiled, False
-            compiled = self._observed_compile(kind, key, compile_fn)
+            compiled, fp = None, None
+            if program is not None and aot_parts is not None and \
+                    program.mesh is None:
+                from . import aot_cache as _aot
+                if _aot.enabled():
+                    fp = _aot.fingerprint(program, aot_parts)
+                    compiled = self._try_warm_start(kind, key, fp,
+                                                    compile_fn)
+            if compiled is None:
+                compiled = self._observed_compile(kind, key, compile_fn)
+                if fp is not None:
+                    compiled.aot_fp = fp
+                    compiled.aot_state = 'save'
             with self._lock:
                 self._cache[key] = compiled
         return compiled, True
+
+    def _try_warm_start(self, kind, key, fp, compile_fn):
+        """Install a disk-cached executable for this key, or None. The
+        Python lowering walk (compile_fn) still runs — it supplies the
+        scope/feed name metadata — but jax never traces and XLA never
+        compiles, and none of the miss/trace/compile telemetry fires;
+        the warm path emits aot_hit/aot_load_seconds instead."""
+        from . import aot_cache as _aot
+        t0 = time.perf_counter()
+        loaded, status = _aot.load(fp)
+        if loaded is None:
+            with self._lock:
+                self.aot_stats['misses'] += 1
+                if status != 'absent':
+                    self.aot_stats['load_failures'] += 1
+            return None
+        compiled = compile_fn()
+        compiled.fn = loaded
+        compiled.aot_fp = fp
+        compiled.aot_state = 'warm'
+        # the cost probe would compile — the one thing a warm start
+        # exists to avoid; MFU for this key is forfeited, not bought
+        compiled.flops = 0.0
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.aot_stats['hits'] += 1
+            self.aot_stats['load_seconds'] += dt
+        self.last_warm_from_disk = True
+        kid = _obs.key_id(key)
+        if _obs.enabled():
+            _obs.inc('executor.aot_hit_total', kind=kind, key=kid)
+            _obs.record('executor.aot_load_seconds', dt, kind=kind,
+                        key=kid)
+        _obs.flight_event('aot_load', kind=kind, key=kid,
+                          fingerprint=fp[:12],
+                          load_seconds=round(dt, 6))
+        return compiled
+
+    def _aot_save(self, kind, key, compiled, scope_vals, feed_vals):
+        """First dispatch of a disk-missed key: AOT-compile the step at
+        the live avals, serialize it for the next process, and install
+        the compiled executable as this entry's fn (so the jit wrapper
+        never compiles a second copy). Failures leave the jit path
+        intact — the cache is an optimization, never a dependency."""
+        from . import aot_cache as _aot
+        compiled.aot_state = None
+        kid = _obs.key_id(key)
+        try:
+            t0 = time.perf_counter()
+            with _obs.span('executor.xla_compile', key=kid):
+                exe = compiled.fn.lower(scope_vals, feed_vals,
+                                        np.int32(0)).compile()
+            dt = time.perf_counter() - t0
+            if _obs.enabled():
+                _obs.record('executor.compile_seconds', dt, key=kid)
+                _obs.overhead('compile', dt)
+                if compiled.flops is None:
+                    compiled.flops = _obs.cost_analysis_flops(exe) or 0.0
+                    if compiled.flops:
+                        _obs.set_gauge('executor.step_flops',
+                                       compiled.flops)
+                        _obs.set_gauge('executor.step_flops_by_key',
+                                       compiled.flops, key=kid)
+        except Exception as e:
+            _obs.flight_event('aot_save_failed', kind=kind, key=kid,
+                              error='%s: %s' % (type(e).__name__, e))
+            return
+        if _aot.save(compiled.aot_fp, exe) is not None:
+            with self._lock:
+                self.aot_stats['saves'] += 1
+            _obs.flight_event('aot_save', kind=kind, key=kid,
+                              fingerprint=compiled.aot_fp[:12],
+                              compile_seconds=round(dt, 6))
+        compiled.fn = exe
 
     # ------------------------------------------------------------------ run
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -319,10 +434,14 @@ class Executor(object):
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names))
+        self.last_warm_from_disk = False
         compiled, missed = self._lookup_or_compile(
             'single', key, use_program_cache,
             lambda: self._compile(program, sorted(feed_vals),
-                                  fetch_names))
+                                  fetch_names),
+            program=program,
+            aot_parts=('single', program.amp, program.remat_policy,
+                       feed_sig, tuple(fetch_names)))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='single',
@@ -331,6 +450,9 @@ class Executor(object):
         with self._dispatch_lock:
             scope_vals, feed_vals = self._prepare_inputs(
                 'Executor.run', program, compiled, scope, feed_vals)
+            if compiled.aot_state == 'save':
+                self._aot_save('single', key, compiled, scope_vals,
+                               feed_vals)
             if _obs.enabled() and compiled.flops is None:
                 self._cost_account(compiled, key, scope_vals, feed_vals)
 
@@ -449,8 +571,13 @@ class Executor(object):
                              base.scope_in_names, base.scope_out_names,
                              base.feed_names, base.fetch_names)
 
+        self.last_warm_from_disk = False
         compiled, missed = self._lookup_or_compile(
-            'multi', key, True, _build_multi)
+            'multi', key, True, _build_multi,
+            program=program,
+            aot_parts=('multi', program.amp, program.remat_policy,
+                       feed_sig, tuple(fetch_names), steps,
+                       stacked_feed))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='multi',
@@ -460,6 +587,9 @@ class Executor(object):
             scope_vals, feed_vals = self._prepare_inputs(
                 'Executor.run_steps', program, compiled, scope, feed_vals,
                 feed_stack_axis=stacked_feed)
+            if compiled.aot_state == 'save':
+                self._aot_save('multi', key, compiled, scope_vals,
+                               feed_vals)
             if _obs.enabled() and compiled.flops is None:
                 one_feed = {n: v[0] for n, v in feed_vals.items()} \
                     if stacked_feed else feed_vals

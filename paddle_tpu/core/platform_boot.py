@@ -33,15 +33,37 @@ _cache_armed = False
 
 
 def arm_compile_cache():
-    """Arm jax's persistent XLA compile cache (idempotent; called at
+    """Arm jax's persistent XLA *module* cache (idempotent; called at
     Executor construction). Re-runs of any program — across processes
-    and across driver rounds — start from the cached executable instead
-    of recompiling; on the tunneled relay that also shields against
-    mid-compile hangs on re-runs. Default dir is stable per machine;
+    and across driver rounds — skip the HLO->binary compile; on the
+    tunneled relay that also shields against mid-compile hangs on
+    re-runs. Default dir is stable per machine;
     JAX_COMPILATION_CACHE_DIR overrides, PADDLE_TPU_COMPILE_CACHE=0
     disables. On this jax build the env var alone does not arm the
     cache — the explicit config call does (bench.py verified entries
-    appear)."""
+    appear).
+
+    This is ONE of THREE distinct cache layers — do not conflate them
+    when debugging cold-start behavior:
+
+    1. **XLA module cache** (this function; ``JAX_COMPILATION_CACHE_DIR``
+       / ``PADDLE_TPU_COMPILE_CACHE``): jax-internal, keyed by HLO.
+       Skips the XLA backend compile but the process still pays the
+       full Python/jax TRACE of every program before the cache is even
+       consulted.
+    2. **AOT executable cache** (``core/aot_cache.py``;
+       ``PADDLE_TPU_AOT_CACHE`` / ``PADDLE_TPU_AOT_CACHE_DIR``): the
+       Executor serializes the fully-compiled step executable keyed by
+       program CONTENT + feed signature + backend fingerprint. A warm
+       process skips trace AND compile — zero trace/compile events on
+       its hot keys (docs/performance.md "Autotuning and AOT warm
+       start").
+    3. **Kernel tuning table** (``paddle_tpu/tuning``;
+       ``PADDLE_TPU_AUTOTUNE`` / ``PADDLE_TPU_TUNING_TABLE``): which
+       kernel VARIANT (XLA vs Pallas, block sizes) each (op, shape,
+       dtype) dispatches — affects what gets compiled, not whether
+       compilation happens. Inspect with ``tools/tuning_inspect.py``.
+    """
     global _cache_armed
     if _cache_armed:
         return

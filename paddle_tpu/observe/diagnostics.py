@@ -10,11 +10,13 @@ started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
     /varz      the observe.snapshot() dict as JSON (exact values,
                host/pid tagged — the JSONL line shape, live)
     /statusz   run headline JSON: uptime, process_index, executor
-               compile-cache per-key hit/miss/compile-seconds, trainer
-               in-flight pipeline depth, MFU/goodput, the decode-engine
-               panel (running/waiting sequences, KV-page occupancy,
-               preemption/token counters), anomaly state,
-               flight-recorder occupancy, health results
+               compile-cache per-key hit/miss/compile-seconds plus
+               warm_from_disk + aot_load_seconds (AOT executable-cache
+               hits), the autotuner panel (tuning-table size, decision
+               counts), trainer in-flight pipeline depth, MFU/goodput,
+               the decode-engine panel (running/waiting sequences,
+               KV-page occupancy, preemption/token counters), anomaly
+               state, flight-recorder occupancy, health results
     /tracez    last N completed spans as JSON (?n=200)
     /healthz   200 ok / 503 degraded from the liveness health checks
                plus the anomaly monitor (degraded while any detector
@@ -104,9 +106,9 @@ def _executor_cache_table(snap):
 
     def ent(key):
         return table.setdefault(key or '', {
-            'kind': None, 'hits': 0, 'misses': 0,
+            'kind': None, 'hits': 0, 'misses': 0, 'warm_from_disk': 0,
             'trace_seconds': None, 'compile_seconds': None,
-            'first_dispatch_seconds': None})
+            'first_dispatch_seconds': None, 'aot_load_seconds': None})
 
     for rendered, v in snap.get('counters', {}).items():
         name, labels = parse_rendered(rendered)
@@ -118,14 +120,46 @@ def _executor_cache_table(snap):
             e = ent(labels.get('key'))
             e['misses'] += v
             e['kind'] = labels.get('kind', e['kind'])
+        elif name == 'executor.aot_hit_total':
+            # the key was installed from the AOT serialized-executable
+            # cache: zero trace, zero XLA compile (core/aot_cache.py)
+            e = ent(labels.get('key'))
+            e['warm_from_disk'] += v
+            e['kind'] = labels.get('kind', e['kind'])
     for rendered, st in snap.get('histograms', {}).items():
         name, labels = parse_rendered(rendered)
         if name in ('executor.trace_seconds', 'executor.compile_seconds',
-                    'executor.first_dispatch_seconds'):
+                    'executor.first_dispatch_seconds',
+                    'executor.aot_load_seconds'):
             key = labels.get('key')
             if key in table:
                 table[key][name.split('.', 1)[1]] = st.get('sum')
     return table
+
+
+def _tuning_status(snap):
+    """Autotuner panel (None when no tuning.* metric exists): table
+    size plus decision counts by (op, source) — 'table' = replayed from
+    the persisted table, 'measured' = microbenchmarked this process."""
+    gauges = snap.get('gauges', {})
+    counters = snap.get('counters', {})
+    if not any(k.startswith('tuning.')
+               for k in list(gauges) + list(counters)):
+        return None
+    decisions = {}
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'tuning.decisions_total':
+            k = '%s/%s/%s' % (labels.get('op', '?'),
+                              labels.get('source', '?'),
+                              labels.get('impl', '?'))
+            decisions[k] = v
+    return {
+        'table_size': gauges.get('tuning.table_size'),
+        'tables_ignored':
+            counters.get('tuning.table_ignored_total'),
+        'decisions': decisions,
+    }
 
 
 def _decode_status(snap):
@@ -183,6 +217,7 @@ def _statusz_doc():
         'prefetch_queue_depth':
             gauges.get('trainer.prefetch_queue_depth'),
         'executor_cache': _executor_cache_table(snap),
+        'tuning': _tuning_status(snap),
         'decode': _decode_status(snap),
         'anomalies': anomaly_state(),
         'flight': {'events': total, 'evicted': evicted,

@@ -130,18 +130,38 @@ def fused_attention(q3, k3, v3, n_head, causal=False, key_length=None,
     # variable-length NMT batches ride the same kernel as dense ones.
     # Dropout doesn't block it either: this op's dropout is on the
     # attention OUTPUT (see below), applied identically after any path.
+    #
+    # r8: with PADDLE_TPU_AUTOTUNE=on the per-shape tuning table picks
+    # the kernel (and the Pallas block sizes) instead of the global
+    # gate — the r4 capture shows the winner flips with seq length. An
+    # EXPLICITLY set PADDLE_TPU_USE_PALLAS still overrides the table.
     use_pallas = False
+    tuned_blocks = (None, None)
     if not use_ring and q.shape[-2] >= 512 and \
             q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0 and \
             q.shape[-1] % 64 == 0:
         from .pallas import pallas_enabled
-        use_pallas = pallas_enabled()
+        from .. import tuning
+        picked = None
+        if tuning.autotune_mode() != 'off' and \
+                not tuning.env_gate_set('PADDLE_TPU_USE_PALLAS'):
+            b, h, tq, d = q.shape
+            picked = tuning.decide_attention(
+                b, h, tq, k.shape[-2], d, str(q.dtype), causal,
+                key_length is not None)
+        if picked is not None:
+            use_pallas = picked.get('impl') == 'pallas'
+            tuned_blocks = (picked.get('block_q'), picked.get('block_k'))
+        else:
+            use_pallas = pallas_enabled()
     if use_ring:
         out = _ring_dispatch(q, k, v, mesh, causal,
                              key_length=key_length)
     elif use_pallas:
         from .pallas.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=causal, kv_len=key_length)
+        out = flash_attention(q, k, v, causal=causal, kv_len=key_length,
+                              block_q=tuned_blocks[0],
+                              block_k=tuned_blocks[1])
     else:
         out = reference_attention(q, k, v, causal=causal,
                                   key_length=key_length,

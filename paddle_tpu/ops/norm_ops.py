@@ -21,11 +21,9 @@ def _bn_bf16_compute():
     return os.environ.get('PADDLE_TPU_BN_COMPUTE', 'bf16') == 'bf16'
 
 
-def _bn_pallas_path(x, layout):
+def _bn_shape_ok(x, layout):
     """Shapes the one-pass kernel handles: channels < 128 or a lane
     multiple, rows a sublane multiple."""
-    if os.environ.get('PADDLE_TPU_BN_PALLAS') != '1':
-        return False
     if x.ndim not in (2, 4):
         return False
     c = x.shape[1] if (x.ndim == 4 and layout == 'NCHW') else x.shape[-1]
@@ -34,6 +32,28 @@ def _bn_pallas_path(x, layout):
         rows *= int(s)
     rows //= int(c)
     return (c < 128 or c % 128 == 0) and rows % 8 == 0
+
+
+def _bn_pallas_path(x, layout):
+    """(use_pallas, tuned_block_r). Precedence: an explicit
+    PADDLE_TPU_BN_PALLAS gate wins; else — with PADDLE_TPU_AUTOTUNE=on —
+    the per-(rows, channels, dtype) tuning table decides the impl and
+    the row-block size; else off (the measured default)."""
+    env = os.environ.get('PADDLE_TPU_BN_PALLAS')
+    if env is not None:
+        return env == '1' and _bn_shape_ok(x, layout), None
+    from .. import tuning
+    if tuning.autotune_mode() != 'off' and _bn_shape_ok(x, layout):
+        c = x.shape[1] if (x.ndim == 4 and layout == 'NCHW') \
+            else x.shape[-1]
+        rows = 1
+        for s in x.shape:
+            rows *= int(s)
+        rows //= int(c)
+        picked = tuning.decide_batch_norm(rows, int(c), str(x.dtype))
+        if picked is not None:
+            return picked.get('impl') == 'pallas', picked.get('block_r')
+    return False, None
 
 
 @register('batch_norm')
@@ -61,16 +81,21 @@ def _batch_norm(ctx):
         axes = (0,)
         bshape = (1, -1)
 
+    use_bn_pallas, tuned_block_r = (False, None) if is_test \
+        else _bn_pallas_path(x, layout)
     if is_test:
         use_mean, use_var = mean, variance
-    elif _bn_pallas_path(x, layout):
+    elif use_bn_pallas:
         # one-pass Pallas kernel (VERDICT r4 next-#2): fp32-accumulated
         # stats + bf16 normalize in ONE pallas_call — the fwd schedule
         # pinned instead of left to XLA's fusion choices. Opt-in
-        # PADDLE_TPU_BN_PALLAS=1, benched as the resnet50_bn_pallas A/B.
+        # PADDLE_TPU_BN_PALLAS=1 (or the autotuner's per-shape verdict),
+        # benched as the resnet50_bn_pallas A/B.
         from .pallas.batch_norm import fused_batch_norm_train
+        kw = {'block_r': tuned_block_r} if tuned_block_r else {}
         out, use_mean, use_var = fused_batch_norm_train(
-            x, scale, bias, eps, layout=layout if x.ndim == 4 else 'NC')
+            x, scale, bias, eps, layout=layout if x.ndim == 4 else 'NC',
+            **kw)
         new_mean = momentum * mean + (1.0 - momentum) * use_mean
         new_var = momentum * variance + (1.0 - momentum) * use_var
         ctx.set_output('MeanOut', jax.lax.stop_gradient(new_mean))

@@ -35,7 +35,14 @@ import jax.numpy as jnp
 from . import interpret_mode
 from . import tpu_compiler_params
 
-DEFAULT_BLOCK_R = int(os.environ.get('PADDLE_TPU_BN_BLOCK_R', '512'))
+DEFAULT_BLOCK_R = 512
+
+
+def _default_block_r():
+    # read per call (not at import) so env changes after import — and
+    # the autotuner's in-process sweeps — take effect
+    return int(os.environ.get('PADDLE_TPU_BN_BLOCK_R',
+                              str(DEFAULT_BLOCK_R)))
 
 
 def bn_pallas_enabled():
@@ -173,7 +180,7 @@ _fused_bn_core.defvjp(_bn_vjp_fwd, _bn_vjp_bwd)
 
 
 def fused_batch_norm_train(x, scale, bias, eps, layout='NHWC',
-                           block_r=DEFAULT_BLOCK_R):
+                           block_r=None):
     """Training-mode BN via the one-pass kernel. x: [N,H,W,C] (NHWC),
     [N,C,H,W] (NCHW — transposed through the kernel's row layout), or
     [N,C]. Returns (y, batch_mean, batch_var) with y in x.dtype and
@@ -186,7 +193,8 @@ def fused_batch_norm_train(x, scale, bias, eps, layout='NHWC',
     shape = x.shape
     c = shape[-1]
     x2 = x.reshape(-1, c)
-    y, mean, var = _fused_bn_core(x2, scale, bias, eps, block_r)
+    y, mean, var = _fused_bn_core(x2, scale, bias, eps,
+                                  block_r or _default_block_r())
     return y.reshape(shape), mean, var
 
 

@@ -31,11 +31,11 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
     o_ref[...] = y.astype(o_ref.dtype)
 
 
-def _ln_pallas(x2, gamma, beta, eps):
+def _ln_pallas(x2, gamma, beta, eps, block_rows=None):
     from jax.experimental import pallas as pl
 
     n, d = x2.shape
-    rows = BLOCK_ROWS
+    rows = block_rows if block_rows else BLOCK_ROWS
     while n % rows:
         rows //= 2
     grid = (n // rows,)
@@ -65,7 +65,20 @@ def _ln_reference(x2, gamma, beta, eps):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _ln_2d(x2, gamma, beta, eps):
     from . import pallas_enabled
-    d = x2.shape[-1]
+    n, d = x2.shape
+    # Autotuned dispatch (r8): with PADDLE_TPU_AUTOTUNE=on and no
+    # explicit PADDLE_TPU_USE_PALLAS the tuning table picks the impl
+    # (and the Pallas row-block size) per (n, d, dtype). The decision is
+    # memoized, so the forward and the vjp-fwd replay agree.
+    from ... import tuning
+    if tuning.autotune_mode() != 'off' and \
+            not tuning.env_gate_set('PADDLE_TPU_USE_PALLAS'):
+        picked = tuning.decide_layer_norm(n, d, str(x2.dtype))
+        if picked is not None:
+            if picked.get('impl') == 'pallas' and d % 128 == 0:
+                return _ln_pallas(x2, gamma, beta, eps,
+                                  block_rows=picked.get('block_rows'))
+            return _ln_reference(x2, gamma, beta, eps)
     if pallas_enabled() and d % 128 == 0 and d >= 1024:
         return _ln_pallas(x2, gamma, beta, eps)
     return _ln_reference(x2, gamma, beta, eps)

@@ -159,17 +159,28 @@ def _paged_pallas(q, k_pages, v_pages, block_tables, seq_lens, sm_scale):
     )(tables, lens, q, k_pages, v_pages)
 
 
-def _use_pallas(bs, d):
+def _use_pallas(q, k_pages, v_pages, block_tables):
     """The kernel wants lane-aligned page tiles; anything else takes the
-    gather path (which handles every shape). PADDLE_TPU_PAGED_PALLAS
-    overrides the shared PADDLE_TPU_USE_PALLAS gate in either
-    direction."""
+    gather path (which handles every shape). Precedence: an EXPLICIT
+    PADDLE_TPU_PAGED_PALLAS overrides everything (in either direction),
+    then an explicit PADDLE_TPU_USE_PALLAS, then — with
+    PADDLE_TPU_AUTOTUNE=on — the per-shape tuning table (this is the
+    dispatch the decode engine's ops/paged_decode_ops.py hot loop rides
+    through), then the pallas_enabled() default (off)."""
+    nb, h, bs, d = k_pages.shape
+    aligned = bs % 8 == 0 and d % 8 == 0
     env = os.environ.get('PADDLE_TPU_PAGED_PALLAS')
     if env is not None:
-        enabled = env not in ('0', 'false', 'False')
-    else:
-        enabled = pallas_enabled()
-    return enabled and bs % 8 == 0 and d % 8 == 0
+        return env not in ('0', 'false', 'False') and aligned
+    from ... import tuning
+    if tuning.autotune_mode() != 'off' and \
+            not tuning.env_gate_set('PADDLE_TPU_USE_PALLAS'):
+        b, p = block_tables.shape
+        picked = tuning.decide_paged_attention(
+            b, p, h, bs, d, v_pages.shape[-1], str(q.dtype))
+        if picked is not None:
+            return picked.get('impl') == 'pallas' and aligned
+    return pallas_enabled() and aligned
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
@@ -180,7 +191,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
     [B] int32. Returns [B, H, Dv]."""
     nb, h, bs, d = k_pages.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    if _use_pallas(bs, d):
+    if _use_pallas(q, k_pages, v_pages, block_tables):
         return _paged_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                              scale)
     return paged_attention_reference(q, k_pages, v_pages, block_tables,

@@ -215,6 +215,11 @@ class DecodeEngine(object):
         signature count."""
         t_all = time.perf_counter()
         nb = self.num_blocks
+        # AOT warm start: every warmup dispatch consults the serialized-
+        # executable cache (core/aot_cache.py); a restarted replica
+        # deserializes its prefill buckets + decode key instead of
+        # compiling them
+        aot0 = dict(self._exe.aot_stats)
         for b in self.prompt_buckets:
             t0 = time.perf_counter()
             self._run_prefill(np.zeros((1, b), 'int64'), 1,
@@ -236,6 +241,11 @@ class DecodeEngine(object):
         _obs.set_gauge('decode.warmup_signatures', self.warmup_signatures)
         _obs.set_gauge('decode.warmup_total_seconds',
                        time.perf_counter() - t_all)
+        st = self._exe.aot_stats
+        _obs.set_gauge('decode.warmup_warm_from_disk',
+                       st['hits'] - aot0['hits'])
+        _obs.set_gauge('decode.warmup_aot_load_seconds',
+                       st['load_seconds'] - aot0['load_seconds'])
         return self.warmup_signatures
 
     def drain(self, timeout=None):

@@ -130,6 +130,8 @@ class Trainer(object):
         self._ckpt_reader = None
         self._last_save = time.monotonic()
         self._step = 0
+        self._t_train_entry = None   # set at train() entry; cleared at
+                                     # the first dispatch (startup gauge)
         self._peak_flops = None   # lazy device_peak_flops() (observe)
         # ------------------------------------------- pipeline state
         self._event_handler = lambda e: None
@@ -216,6 +218,7 @@ class Trainer(object):
                     feeder, steps_per_dispatch, pipeline_depth,
                     host_prefetch, stacked_windows):
         from .reader.state import CheckpointableReader
+        self._t_train_entry = time.perf_counter()
         self._ckpt_reader = (reader if isinstance(reader,
                                                   CheckpointableReader)
                              else None)
@@ -309,6 +312,12 @@ class Trainer(object):
                     'trainer.pipeline_overlap_fraction',
                     max(0.0, 1.0 - ((hb - blocked0[0]) +
                                     (db - blocked0[1])) / wall))
+            # AOT warm-start ledger: how many of this run's keys came
+            # off disk instead of trace+compile (core/aot_cache.py)
+            st = self.exe.aot_stats
+            _obs.set_gauge('trainer.warm_from_disk_keys', st['hits'])
+            _obs.set_gauge('trainer.aot_load_seconds',
+                           st['load_seconds'])
             _obs.flush()   # end-of-train snapshot (no-op without a sink)
 
     # ------------------------------------------------------ feed stream
@@ -476,6 +485,14 @@ class Trainer(object):
                                        stacked_feed=True,
                                        return_handle=True)
         t1 = time.perf_counter()
+        if self._t_train_entry is not None:
+            # cold-vs-warm startup headline: wall from train() entry to
+            # the first dispatch ENQUEUED — startup-program run, resume,
+            # and the first step's trace+compile (or its AOT warm load)
+            # all land in here
+            _obs.set_gauge('trainer.time_to_first_dispatch_seconds',
+                           t1 - self._t_train_entry)
+            self._t_train_entry = None
         self._inflight.append(
             _Inflight(epoch, step0, n_steps, n_items, h, t0, t1))
         _obs.set_gauge('trainer.inflight_depth', len(self._inflight))

@@ -1,0 +1,355 @@
+"""Per-shape kernel autotuner.
+
+On first sight of an (op, shape, dtype, device_kind) key, microbenchmark
+the candidate variants — XLA vs Pallas, and a small grid of Pallas block
+sizes — and record the winner in the persisted :class:`TuningTable`.
+Dispatch sites (``ops/attention_ops.py``, ``ops/pallas/paged_attention
+.py`` — which also covers ``ops/paged_decode_ops.py`` — and the
+layer/batch-norm wrappers) consult ``decide()`` instead of the global
+env gates when autotuning is on; the explicit env gates
+(``PADDLE_TPU_USE_PALLAS`` etc.) always override the table.
+
+Knobs::
+
+    PADDLE_TPU_AUTOTUNE      off (default) | on | record
+    PADDLE_TPU_TUNING_TABLE  table path (default: per-user tmp file)
+
+``on`` trusts existing table entries and only measures unseen keys;
+``record`` re-measures every key it encounters (refreshing a stale
+table — the record-vs-replay workflow: record once on the target chip,
+replay everywhere with ``on``).
+
+Measurement runs eagerly at trace time: candidates execute on synthetic
+inputs of the live shape (concrete arrays, so a nested ``jax.jit``
+dispatches for real even while an outer trace is active), timed with an
+``np.asarray`` sync — ``block_until_ready`` returns at enqueue on the
+tunneled relay (SURVEY §5.1). A candidate that fails to compile (e.g. a
+real Pallas kernel on a CPU host) scores +inf and simply loses. Tests
+inject deterministic timings via :func:`set_timer`.
+"""
+
+import math
+import os
+import time
+
+import numpy as np
+
+from .. import observe as _obs
+from .table import TuningTable
+
+__all__ = ['autotune_mode', 'decide', 'reset', 'set_timer', 'table_path',
+           'current_table', 'device_kind', 'env_gate_set']
+
+_STATE = {'table': None, 'table_path': None, 'memo': {}, 'timer': None}
+
+
+# ---------------------------------------------------------------- knobs
+def autotune_mode(environ=None):
+    """'off' | 'on' | 'record' from PADDLE_TPU_AUTOTUNE."""
+    env = os.environ if environ is None else environ
+    raw = (env.get('PADDLE_TPU_AUTOTUNE') or 'off').strip().lower()
+    if raw in ('on', '1', 'true', 'yes'):
+        return 'on'
+    if raw == 'record':
+        return 'record'
+    return 'off'
+
+
+def table_path():
+    """PADDLE_TPU_TUNING_TABLE, or a per-user tmp default (same rationale
+    as platform_boot.arm_compile_cache: a fixed shared-tmp name would
+    poison across users on a shared machine)."""
+    import tempfile
+    p = os.environ.get('PADDLE_TPU_TUNING_TABLE')
+    if p:
+        return p
+    try:
+        import getpass
+        user = getpass.getuser()
+    except Exception:
+        user = str(os.getuid()) if hasattr(os, 'getuid') else 'default'
+    return os.path.join(tempfile.gettempdir(),
+                        'paddle_tpu_tuning_%s.json' % user)
+
+
+def env_gate_set(*names):
+    """True when any of the named env gates is EXPLICITLY set — the
+    operator pinned a kernel choice, which overrides the table."""
+    return any(os.environ.get(n) is not None for n in names)
+
+
+def device_kind():
+    """The backend's device kind string ('cpu', 'TPU v5e', ...) — the
+    table's top-level key, so one file can hold tables for several chip
+    generations."""
+    kind = _STATE.get('device_kind')
+    if kind is None:
+        try:
+            import jax
+            kind = str(jax.devices()[0].device_kind)
+        except Exception:
+            kind = 'unknown'
+        _STATE['device_kind'] = kind
+    return kind
+
+
+def reset():
+    """Drop every cached decision and the in-memory table (tests, and
+    bench legs that re-point PADDLE_TPU_TUNING_TABLE mid-process)."""
+    _STATE['table'] = None
+    _STATE['table_path'] = None
+    _STATE['memo'] = {}
+    _STATE.pop('device_kind', None)
+
+
+def set_timer(fn):
+    """Inject a timing function ``fn(op, key, variant, thunk) ->
+    seconds`` (None restores the real timer). Tests use this for
+    deterministic winner selection without touching hardware."""
+    _STATE['timer'] = fn
+
+
+def current_table():
+    """The table for the current PADDLE_TPU_TUNING_TABLE path, loading
+    it on first access (and reloading if the path knob changed)."""
+    path = table_path()
+    if _STATE['table'] is None or _STATE['table_path'] != path:
+        _STATE['table'] = TuningTable.load(path)
+        _STATE['table_path'] = path
+        if _STATE['table'].loaded_from_disk:
+            _obs.flight_event('tuning_table_loaded', path=path,
+                              entries=_STATE['table'].size())
+        _obs.set_gauge('tuning.table_size', _STATE['table'].size())
+    return _STATE['table']
+
+
+# ------------------------------------------------------------ measuring
+def _time_thunk(op, key, variant, thunk, warmup=1, iters=3):
+    """Best-of-`iters` wall seconds for one candidate. The thunk builds
+    its own synthetic inputs and returns a device array; np.asarray is
+    the sync (relay-safe). +inf when the candidate cannot run here."""
+    try:
+        for _ in range(max(0, warmup)):
+            np.asarray(thunk())
+        best = math.inf
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            np.asarray(thunk())
+            best = min(best, time.perf_counter() - t0)
+        return best
+    except Exception as e:
+        _obs.flight_event('tuning_candidate_failed', op=op, key=key,
+                          variant=_label(variant),
+                          error='%s: %s' % (type(e).__name__, e))
+        return math.inf
+
+
+def _label(variant):
+    """Stable short label for a variant dict ('pallas bq512 bk256')."""
+    impl = variant.get('impl', '?')
+    extras = ' '.join('%s%s' % (k.replace('block_', 'b'), v)
+                      for k, v in sorted(variant.items()) if k != 'impl')
+    return ('%s %s' % (impl, extras)).strip()
+
+
+def _measure(op, key, candidates):
+    """Time every candidate; returns (winner_variant, {label: secs}).
+    Falls back to the first candidate when nothing ran (all +inf)."""
+    timer = _STATE['timer'] or _time_thunk
+    timings = {}
+    best, best_t = None, math.inf
+    t0 = time.perf_counter()
+    for variant, thunk in candidates:
+        dt = timer(op, key, variant, thunk)
+        timings[_label(variant)] = dt if math.isfinite(dt) else -1.0
+        if dt < best_t:
+            best, best_t = variant, dt
+    if best is None:
+        best = candidates[0][0]
+    _obs.record('tuning.tune_seconds', time.perf_counter() - t0, op=op)
+    return best, timings
+
+
+# -------------------------------------------------------------- deciding
+def decide(op, key, candidates):
+    """The tuned variant dict for (op, key), or None when autotuning is
+    off (callers then fall back to the default env-gate logic).
+
+    ``candidates`` is ``[(variant_dict, thunk), ...]``; thunks only run
+    when the key has never been measured (mode 'on') or always (mode
+    'record'). Decisions are memoized per process — the hot path after
+    the first trace is one dict hit — and persisted to the table file
+    the moment they are measured, so a restarted process replays them
+    without re-benchmarking."""
+    mode = autotune_mode()
+    if mode == 'off' or not candidates:
+        return None
+    kind = device_kind()
+    memo_key = (kind, key)
+    hit = _STATE['memo'].get(memo_key)
+    if hit is not None:
+        return hit
+    table = current_table()
+    if mode == 'on':
+        ent = table.lookup(kind, key)
+        if ent and isinstance(ent.get('winner'), dict):
+            winner = dict(ent['winner'])
+            _STATE['memo'][memo_key] = winner
+            _obs.inc('tuning.decisions_total', op=op, source='table',
+                     impl=winner.get('impl', '?'))
+            return winner
+    winner, timings = _measure(op, key, candidates)
+    table.put(kind, key, winner, timings,
+              mode='recorded' if mode == 'record' else 'measured')
+    table.save()
+    _STATE['memo'][memo_key] = dict(winner)
+    _obs.inc('tuning.decisions_total', op=op, source='measured',
+             impl=winner.get('impl', '?'))
+    _obs.set_gauge('tuning.table_size', table.size())
+    _obs.flight_event('tune', op=op, key=key, winner=_label(winner),
+                      device_kind=kind)
+    return dict(winner)
+
+
+# ------------------------------------------------- per-op decision hooks
+# Each hook renders the shape key, enumerates candidates with synthetic-
+# input thunks, and returns decide()'s verdict. They are called from
+# inside jit traces: thunks build CONCRETE arrays, so the nested
+# executions run eagerly and never leak tracers into the outer program.
+
+def decide_attention(b, h, tq, tk, d, dtype, causal, masked):
+    """xla vs pallas-flash, over the (block_q, block_k) grid. `masked`
+    keys variable-length batches separately (the kernel skips masked key
+    blocks, so its ranking differs from the dense case)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.pallas.flash_attention import (attention_block_variants,
+                                              flash_attention)
+    from ..ops.attention_ops import reference_attention
+
+    key = ('flash_attention|b%d h%d tq%d tk%d d%d causal%d masked%d|%s'
+           % (b, h, tq, tk, d, int(bool(causal)), int(bool(masked)),
+              dtype))
+
+    def mk_inputs():
+        q = jnp.ones((b, h, tq, d), dtype)
+        k = jnp.ones((b, h, tk, d), dtype)
+        v = jnp.ones((b, h, tk, d), dtype)
+        lens = (jnp.full((b,), max(1, (3 * tk) // 4), jnp.int32)
+                if masked else None)
+        return q, k, v, lens
+
+    def xla_thunk():
+        q, k, v, lens = mk_inputs()
+        return jax.jit(lambda q, k, v: reference_attention(
+            q, k, v, causal=causal, key_length=lens))(q, k, v)
+
+    candidates = [({'impl': 'xla'}, xla_thunk)]
+    for bq, bk in attention_block_variants(tq, tk):
+        def pallas_thunk(bq=bq, bk=bk):
+            q, k, v, lens = mk_inputs()
+            return jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, kv_len=lens,
+                block_q=bq, block_k=bk))(q, k, v)
+        candidates.append(
+            ({'impl': 'pallas', 'block_q': bq, 'block_k': bk},
+             pallas_thunk))
+    return decide('flash_attention', key, candidates)
+
+
+def decide_paged_attention(b, p, h, bs, d, dv, dtype):
+    """XLA gather path vs the scalar-prefetch Pallas kernel for one
+    ragged paged-attention shape (the decode hot loop)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.pallas import paged_attention as _pa
+
+    key = ('paged_attention|b%d p%d h%d bs%d d%d dv%d|%s'
+           % (b, p, h, bs, d, dv, dtype))
+
+    def mk_inputs():
+        q = jnp.ones((b, h, d), dtype)
+        kp = jnp.ones((b * p, h, bs, d), dtype)
+        vp = jnp.ones((b * p, h, bs, dv), dtype)
+        tables = jnp.arange(b * p, dtype=jnp.int32).reshape(b, p)
+        lens = jnp.full((b,), p * bs - 1, jnp.int32)
+        return q, kp, vp, tables, lens
+
+    def xla_thunk():
+        args = mk_inputs()
+        return jax.jit(_pa.paged_attention_reference)(*args)
+
+    candidates = [({'impl': 'xla'}, xla_thunk)]
+    if bs % 8 == 0 and d % 8 == 0:   # kernel wants lane-aligned tiles
+        def pallas_thunk():
+            q, kp, vp, tables, lens = mk_inputs()
+            return jax.jit(lambda *a: _pa._paged_pallas(
+                *a, sm_scale=d ** -0.5))(q, kp, vp, tables, lens)
+        candidates.append(({'impl': 'pallas'}, pallas_thunk))
+    return decide('paged_attention', key, candidates)
+
+
+def decide_layer_norm(n, d, dtype):
+    """xla vs the fused Pallas row kernel over a small block_rows grid
+    (the kernel's win is long rows; the grid lets short-row shapes keep
+    the XLA fusion)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.pallas import layer_norm as _ln
+
+    key = 'layer_norm|n%d d%d|%s' % (n, d, dtype)
+
+    def mk_inputs():
+        return (jnp.ones((n, d), dtype), jnp.ones((d,), jnp.float32),
+                jnp.zeros((d,), jnp.float32))
+
+    def xla_thunk():
+        x, g, b = mk_inputs()
+        return jax.jit(lambda x, g, b: _ln._ln_reference(
+            x, g, b, 1e-5))(x, g, b)
+
+    candidates = [({'impl': 'xla'}, xla_thunk)]
+    if d % 128 == 0:
+        for rows in (512, 256, 128):
+            if rows > n:
+                continue
+            def pallas_thunk(rows=rows):
+                x, g, b = mk_inputs()
+                return jax.jit(lambda x, g, b: _ln._ln_pallas(
+                    x, g, b, 1e-5, block_rows=rows))(x, g, b)
+            candidates.append(({'impl': 'pallas', 'block_rows': rows},
+                               pallas_thunk))
+    return decide('layer_norm', key, candidates)
+
+
+def decide_batch_norm(r, c, dtype):
+    """xla two-pass stats vs the one-pass fused Pallas BN kernel over a
+    block_r grid (training-mode forward only — the backward is jnp on
+    both paths)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.pallas import batch_norm as _bn
+
+    key = 'batch_norm|r%d c%d|%s' % (r, c, dtype)
+
+    def mk_inputs():
+        return (jnp.ones((r, c), dtype), jnp.ones((c,), jnp.float32),
+                jnp.zeros((c,), jnp.float32))
+
+    def xla_thunk():
+        x, s, b = mk_inputs()
+        return jax.jit(lambda x, s, b: _bn._bn_reference(
+            x, s, b, 1e-5)[0])(x, s, b)
+
+    candidates = [({'impl': 'xla'}, xla_thunk)]
+    if r % 8 == 0 and (c % 128 == 0 or c < 128):
+        for br in (512, 256):
+            if br > r:
+                continue
+            def pallas_thunk(br=br):
+                x, s, b = mk_inputs()
+                return jax.jit(lambda x, s, b: _bn._fused_bn_fwd(
+                    x, s, b, 1e-5, br)[0])(x, s, b)
+            candidates.append(({'impl': 'pallas', 'block_r': br},
+                               pallas_thunk))
+    return decide('batch_norm', key, candidates)

@@ -1,0 +1,151 @@
+"""Persisted per-shape kernel-selection table.
+
+One JSON file holds, per device kind, the measured winner for every
+(op, shape, dtype) key the autotuner has seen — so the microbenchmark
+runs once per key per chip generation, not once per process. The r4
+on-chip capture is the motivating data: XLA attention beats Pallas at
+seq1024 while Pallas wins 13x at seq4096, so a single global gate is
+wrong for at least one of the two shapes any long-context model runs.
+
+Schema (format_version 1)::
+
+    {
+      "format_version": 1,
+      "jax": "0.4.37",                  # writer provenance, not checked
+      "tables": {
+        "<device_kind>": {
+          "<op>|<shape>|<dtype>": {
+            "winner":  {"impl": "pallas", "block_q": 512, "block_k": 256},
+            "timings": {"xla": 1.41e-3, "pallas bq512 bk256": 9.2e-4},
+            "mode":    "measured",      # or "recorded"
+            "ts":      1722800000.0
+          }
+        }
+      }
+    }
+
+Durability contract matches every other artifact in this repo
+(io._write_atomic): writes land via a UNIQUE tmp file + ``os.replace``
+so a crashed writer never leaves a half-table, and concurrent writers
+never share a tmp. A corrupted or version-mismatched table is IGNORED
+(empty table + a ``tuning_table_ignored`` flight event), never raised:
+a stale cache must not take a training run down.
+
+Stdlib-only on purpose — ``tools/tuning_inspect.py`` reads the same
+schema without importing jax.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+from .. import observe as _obs
+
+FORMAT_VERSION = 1
+
+
+class TuningTable(object):
+    """In-memory view of one tuning-table file."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self.tables = {}          # device_kind -> {key: entry}
+        self.loaded_from_disk = False
+
+    # ------------------------------------------------------------ access
+    def lookup(self, device_kind, key):
+        """The recorded entry for (device_kind, key), or None."""
+        return self.tables.get(device_kind, {}).get(key)
+
+    def put(self, device_kind, key, winner, timings, mode='measured'):
+        self.tables.setdefault(device_kind, {})[key] = {
+            'winner': dict(winner),
+            'timings': {k: round(float(v), 9) for k, v in timings.items()},
+            'mode': mode,
+            'ts': round(time.time(), 3),
+        }
+
+    def size(self):
+        return sum(len(t) for t in self.tables.values())
+
+    def to_dict(self):
+        jax_ver = None
+        try:
+            import jax
+            jax_ver = jax.__version__
+        except Exception:
+            pass
+        return {'format_version': FORMAT_VERSION, 'jax': jax_ver,
+                'tables': self.tables}
+
+    # ------------------------------------------------------- persistence
+    @classmethod
+    def load(cls, path):
+        """Read *path*; a missing file is an empty table, a corrupted or
+        version-mismatched one is an empty table plus a flight event —
+        the autotuner re-measures, it never crashes on stale state."""
+        t = cls(path)
+        if not path or not os.path.exists(path):
+            return t
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError('not a JSON object')
+            ver = data.get('format_version')
+            if ver != FORMAT_VERSION:
+                raise ValueError('format_version %r != %d'
+                                 % (ver, FORMAT_VERSION))
+            tables = data.get('tables')
+            if not isinstance(tables, dict):
+                raise ValueError('missing "tables" object')
+            for kind, entries in tables.items():
+                if not isinstance(entries, dict):
+                    raise ValueError('device table %r is not an object'
+                                     % kind)
+        except Exception as e:
+            _obs.inc('tuning.table_ignored_total')
+            _obs.flight_event('tuning_table_ignored', path=str(path),
+                              error='%s: %s' % (type(e).__name__, e))
+            return t
+        t.tables = tables
+        t.loaded_from_disk = True
+        return t
+
+    def save(self, path=None):
+        """Atomic write (unique tmp + os.replace). Merges with whatever
+        is on disk first, so two processes tuning different keys against
+        one table file compose instead of clobbering. Best-effort: a
+        failed save records a flight event and returns None."""
+        path = path or self.path
+        if not path:
+            return None
+        try:
+            on_disk = TuningTable.load(path)
+            for kind, entries in on_disk.tables.items():
+                mine = self.tables.setdefault(kind, {})
+                for key, ent in entries.items():
+                    mine.setdefault(key, ent)
+            d = os.path.dirname(path) or '.'
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d,
+                                       prefix=os.path.basename(path) + '.')
+            try:
+                with os.fdopen(fd, 'w') as f:
+                    json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+                umask = os.umask(0)
+                os.umask(umask)
+                os.chmod(tmp, 0o666 & ~umask)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:
+            _obs.flight_event('tuning_table_save_failed', path=str(path),
+                              error='%s: %s' % (type(e).__name__, e))
+            return None
+        return path

@@ -79,6 +79,11 @@ QUEUE = [
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),
+    # autotuner + AOT warm start (ISSUE 8): tuned-vs-default attention
+    # at the r4 seq{1024,4096} shapes (does the winner flip on THIS
+    # chip?) + cold-vs-warm startup seconds; tuning.*/aot.* gauges land
+    # in the shared metrics JSONL
+    ('autotune', 'autotune', None, 900),
 ]
 
 # non-bench tools: (key, argv, timeout) — raw stdout lines stored
