@@ -864,6 +864,51 @@ def _autotune_startup_child():
             'compile_flight_events': compiles}
 
 
+def bench_verify(batch=8, seq=64, vocab=32000, iters=10):
+    """ISSUE 9 overhead guard: the static verifier must stay noise next
+    to the cold compile it precedes. Builds the transformer train
+    program, times a full run of every analysis pass (best of `iters`
+    — the verifier is pure Python over the op list), then times the
+    COLD compile+first-step of the same program, and reports the
+    ratio. Gauges analysis.verify_seconds /
+    analysis.verify_vs_compile_ratio land in the metrics JSONL; `ok`
+    is the acceptance bit (ratio < 1%)."""
+    fluid = _fresh()
+    from paddle_tpu import analysis, observe
+    from paddle_tpu.models import transformer as T
+    avg_cost, _ = T.transformer_base(
+        src_vocab_size=vocab, trg_vocab_size=vocab,
+        src_seq_len=seq, trg_seq_len=seq, max_length=max(256, seq))
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    prog = fluid.default_main_program()
+
+    best = float('inf')
+    diags = []
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        diags = analysis.run_passes(prog, fetch_names=[avg_cost.name])
+        best = min(best, time.perf_counter() - t0)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    feed = _to_device(T.make_fake_batch(batch, seq, seq, vocab, vocab))
+    t0 = time.perf_counter()
+    out = exe.run(feed=feed, fetch_list=[avg_cost])
+    np.asarray(out[0])
+    cold = time.perf_counter() - t0
+
+    ratio = best / cold if cold > 0 else float('inf')
+    observe.set_gauge('analysis.verify_seconds', best)
+    observe.set_gauge('analysis.verify_vs_compile_ratio', ratio)
+    counts = analysis.summarize(diags)
+    return {'verify_seconds': round(best, 6),
+            'cold_compile_seconds': round(cold, 4),
+            'verify_vs_compile_ratio': round(ratio, 6),
+            'ops': len(prog.global_block().ops),
+            'diagnostics': counts,
+            'ok': bool(ratio < 0.01 and counts['error'] == 0)}
+
+
 def _run_workload_child(workload, backend, reduced):
     """Child-process entry: run ONE workload, print 'RESULT <number>'."""
     from paddle_tpu import observe
@@ -899,6 +944,11 @@ def _run_workload_child(workload, backend, reduced):
         return
     if workload == 'autotune_child':
         print('RESULT_JSON %s' % json.dumps(_autotune_startup_child()),
+              flush=True)
+        return
+    if workload == 'verify':
+        kw = dict(batch=2, seq=16, vocab=512, iters=3) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_verify(**kw)),
               flush=True)
         return
     if workload == 'resnet50_anatomy':
@@ -1483,7 +1533,7 @@ if __name__ == '__main__':
                                 'pipeline_transformer',
                                 'pipeline_resnet50',
                                 'decode_transformer', 'autotune',
-                                'autotune_child'])
+                                'autotune_child', 'verify'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

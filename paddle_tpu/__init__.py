@@ -29,6 +29,7 @@ from . import io  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import metrics  # noqa: F401
 from . import observe  # noqa: F401
+from . import analysis  # noqa: F401
 from . import profiler  # noqa: F401
 from . import backward  # noqa: F401
 from . import debug  # noqa: F401

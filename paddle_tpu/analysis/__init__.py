@@ -1,0 +1,127 @@
+"""paddle_tpu.analysis — the Program IR verifier.
+
+Whole-program XLA compilation means graph bugs otherwise surface as
+opaque tracer exceptions (or silent recompiles) deep inside `jit`, far
+from the user code that appended the op. This package runs BEFORE any
+trace: five static-analysis passes over Program/Block/Operator IR,
+each emitting structured diagnostics with severity, op index, and the
+op's construction provenance (`file.py:line`, captured at append_op).
+
+Passes (see docs/static_analysis.md for the full catalog):
+
+- ``wellformed`` — undefined inputs, use-before-def in block order,
+  duplicate writers of one temporary, fetch-unreachable dead ops,
+- ``shapes``     — shape/dtype contracts for the high-traffic op set
+  (matmul/mul, conv, fused attention, norms, elementwise, reshape/
+  concat/transpose, optimizer updates),
+- ``sharding``   — PartitionSpec consistency: unknown mesh axes,
+  axis-indivisible dims, unannotated params on a >1 mesh, spec
+  conflicts that force implicit resharding,
+- ``donation``   — double-donation and read-after-donate of in-place
+  persistable state (params, optimizer accumulators, KV arenas),
+- ``recompile``  — attrs embedding per-process values/object ids and
+  unbound feed dims: the executor-cache signature-churn class.
+
+Three ways in:
+
+- ``PADDLE_TPU_VERIFY=off|warn|strict`` on the Executor: each program
+  key is verified ONCE at first compile. ``strict`` raises
+  :class:`ProgramVerifyError` before anything traces; ``warn`` records
+  ``program_verify`` flight events plus
+  ``analysis.diagnostics_total{severity,pass}`` counters and carries
+  on.
+- The trainer and both serving engines call :func:`startup_verify` at
+  startup (default mode ``warn`` when the env is unset).
+- ``python tools/program_lint.py model_dir/`` lints a serialized
+  program offline (``--json`` for machines).
+"""
+
+import os
+import time
+
+from .base import (SEVERITIES, SEVERITY_ERROR, SEVERITY_INFO,  # noqa: F401
+                   SEVERITY_WARNING, AnalysisContext, Diagnostic,
+                   PASSES, ProgramVerifyError, analysis_pass,
+                   run_passes)
+
+__all__ = ['Diagnostic', 'ProgramVerifyError', 'analysis_pass',
+           'run_passes', 'verify', 'startup_verify', 'verify_mode',
+           'summarize', 'PASSES', 'SEVERITIES']
+
+_MODES = ('off', 'warn', 'strict')
+
+
+def verify_mode(default='off'):
+    """The PADDLE_TPU_VERIFY mode ('off' | 'warn' | 'strict'), read per
+    call so tests and long-lived processes can flip it; `default`
+    applies when the variable is unset."""
+    raw = os.environ.get('PADDLE_TPU_VERIFY', '').strip().lower()
+    if not raw:
+        return default
+    if raw not in _MODES:
+        raise ValueError('PADDLE_TPU_VERIFY=%r (expected one of %s)'
+                         % (raw, '|'.join(_MODES)))
+    return raw
+
+
+def summarize(diagnostics):
+    """{severity: count} over a diagnostics list (all keys present)."""
+    counts = dict.fromkeys(SEVERITIES, 0)
+    for d in diagnostics:
+        counts[d.severity] += 1
+    return counts
+
+
+def verify(program, feed_names=None, fetch_names=None, mode='strict',
+           label='program'):
+    """Run every pass over `program` and apply `mode`: 'off' skips
+    entirely (returns []), 'warn' publishes telemetry and returns the
+    diagnostics, 'strict' additionally raises ProgramVerifyError when
+    any error-severity diagnostic exists. `label` tags the telemetry
+    (trainer / serving / decode / executor kind)."""
+    if mode == 'off':
+        return []
+    if mode not in _MODES:
+        raise ValueError('verify mode %r (expected one of %s)'
+                         % (mode, '|'.join(_MODES)))
+    t0 = time.perf_counter()
+    diags = run_passes(program, feed_names=feed_names,
+                       fetch_names=fetch_names)
+    dt = time.perf_counter() - t0
+    _publish(label, diags, dt)
+    if mode == 'strict':
+        counts = summarize(diags)
+        if counts[SEVERITY_ERROR]:
+            raise ProgramVerifyError(diags, context=label)
+    return diags
+
+
+def startup_verify(program, feed_names=None, fetch_names=None,
+                   label='startup'):
+    """Entry point for the trainer and serving engines: one verification
+    at startup, honoring PADDLE_TPU_VERIFY but defaulting to 'warn'
+    when unset (the check is one pure-Python walk over the ops — noise
+    next to the XLA compile it precedes)."""
+    return verify(program, feed_names=feed_names,
+                  fetch_names=fetch_names,
+                  mode=verify_mode(default='warn'), label=label)
+
+
+def _publish(label, diags, seconds):
+    from .. import observe as _obs
+    counts = summarize(diags)
+    if _obs.enabled():
+        _obs.inc('analysis.programs_verified_total', label=label)
+        _obs.record('analysis.verify_seconds', seconds, label=label)
+        for d in diags:
+            _obs.inc('analysis.diagnostics_total',
+                     **{'severity': d.severity, 'pass': d.pass_name})
+    first_error = next((d.format() for d in diags
+                        if d.severity == SEVERITY_ERROR), None)
+    event = {'label': label, 'seconds': round(seconds, 6),
+             'errors': counts[SEVERITY_ERROR],
+             'warnings': counts[SEVERITY_WARNING],
+             'infos': counts[SEVERITY_INFO]}
+    if first_error:
+        event['first_error'] = first_error[:300]
+    _obs.flight_event('program_verify', **event)

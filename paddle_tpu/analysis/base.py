@@ -1,0 +1,177 @@
+"""Pass framework for static analysis over Program IR.
+
+A pass is a function ``fn(ctx)`` registered under a name with
+``@analysis_pass('name')``; it inspects ``ctx.program`` and reports
+findings through ``ctx.error / ctx.warning / ctx.info``, each of which
+appends a structured :class:`Diagnostic` (severity, pass name, op
+index, variable, and the op's construction provenance ``file:line``).
+Passes NEVER mutate the program and never raise for findings — raising
+is the caller's policy (``analysis.verify`` in strict mode).
+
+The framework is deliberately jax-free at module level so
+``tools/program_lint.py`` can lint a serialized program without
+touching an accelerator runtime.
+"""
+
+SEVERITY_ERROR = 'error'
+SEVERITY_WARNING = 'warning'
+SEVERITY_INFO = 'info'
+SEVERITIES = (SEVERITY_ERROR, SEVERITY_WARNING, SEVERITY_INFO)
+
+
+class Diagnostic(object):
+    """One finding: where (op index / var / provenance), what (pass,
+    code, message), how bad (severity)."""
+
+    __slots__ = ('pass_name', 'code', 'severity', 'message', 'op_index',
+                 'op_type', 'block_idx', 'var', 'provenance')
+
+    def __init__(self, pass_name, code, severity, message, op_index=None,
+                 op_type=None, block_idx=0, var=None, provenance=None):
+        if severity not in SEVERITIES:
+            raise ValueError('unknown severity %r' % (severity,))
+        self.pass_name = pass_name
+        self.code = code
+        self.severity = severity
+        self.message = message
+        self.op_index = op_index
+        self.op_type = op_type
+        self.block_idx = block_idx
+        self.var = var
+        self.provenance = provenance
+
+    def to_dict(self):
+        return {'pass': self.pass_name, 'code': self.code,
+                'severity': self.severity, 'message': self.message,
+                'op_index': self.op_index, 'op_type': self.op_type,
+                'block': self.block_idx, 'var': self.var,
+                'provenance': self.provenance}
+
+    def format(self):
+        loc = []
+        if self.op_index is not None:
+            loc.append('op#%d' % self.op_index)
+        if self.op_type:
+            loc.append(self.op_type)
+        if self.var:
+            loc.append('var %r' % self.var)
+        where = ' ' + ' '.join(loc) if loc else ''
+        built = ' (built at %s)' % self.provenance if self.provenance \
+            else ''
+        return '%s[%s/%s]%s: %s%s' % (self.severity, self.pass_name,
+                                      self.code, where, self.message,
+                                      built)
+
+    def __repr__(self):
+        return 'Diagnostic(%s)' % self.format()
+
+
+class ProgramVerifyError(RuntimeError):
+    """Strict-mode verification failure. `.diagnostics` holds EVERY
+    finding from the run (warnings/infos included); the message lists
+    the errors that made it raise."""
+
+    def __init__(self, diagnostics, context=None):
+        self.diagnostics = list(diagnostics)
+        errors = [d for d in self.diagnostics
+                  if d.severity == SEVERITY_ERROR]
+        head = ('program verification failed%s: %d error(s), '
+                '%d diagnostic(s) total'
+                % (' [%s]' % context if context else '', len(errors),
+                   len(self.diagnostics)))
+        lines = [head] + ['  ' + d.format() for d in errors[:20]]
+        if len(errors) > 20:
+            lines.append('  ... and %d more errors' % (len(errors) - 20))
+        super(ProgramVerifyError, self).__init__('\n'.join(lines))
+
+
+# Registered passes in definition order (the order they run).
+PASSES = {}
+
+
+def analysis_pass(name):
+    """Register a pass under `name`. The function receives an
+    AnalysisContext and reports via ctx.error/warning/info."""
+    def deco(fn):
+        if name in PASSES:
+            raise ValueError('duplicate analysis pass %r' % name)
+        PASSES[name] = fn
+        return fn
+    return deco
+
+
+class AnalysisContext(object):
+    """What a pass sees: the program, optional feed/fetch context, and
+    the diagnostics sink."""
+
+    def __init__(self, program, feed_names=None, fetch_names=None):
+        self.program = program
+        self.block = program.global_block()
+        self.feed_names = set(feed_names or ())
+        self.fetch_names = [getattr(f, 'name', f)
+                            for f in (fetch_names or ())]
+        self.diagnostics = []
+        self._pass = None
+
+    # ------------------------------------------------------------ report
+    def _report(self, severity, code, message, op=None, op_index=None,
+                var=None):
+        self.diagnostics.append(Diagnostic(
+            self._pass, code, severity, message, op_index=op_index,
+            op_type=getattr(op, 'type', None),
+            block_idx=getattr(getattr(op, 'block', None), 'idx', 0),
+            var=var, provenance=getattr(op, 'provenance', None)))
+
+    def error(self, code, message, op=None, op_index=None, var=None):
+        self._report(SEVERITY_ERROR, code, message, op, op_index, var)
+
+    def warning(self, code, message, op=None, op_index=None, var=None):
+        self._report(SEVERITY_WARNING, code, message, op, op_index, var)
+
+    def info(self, code, message, op=None, op_index=None, var=None):
+        self._report(SEVERITY_INFO, code, message, op, op_index, var)
+
+    # ----------------------------------------------------------- helpers
+    def find_var(self, name):
+        return self.block._find_var_recursive(name)
+
+    def shape_of(self, name):
+        """Declared shape tuple (with -1 wildcards) or None."""
+        v = self.find_var(name)
+        if v is None or v.shape is None:
+            return None
+        return tuple(v.shape)
+
+    def dtype_of(self, name):
+        v = self.find_var(name)
+        return v.dtype if v is not None else None
+
+
+def _ensure_passes_loaded():
+    # importing the modules registers their passes
+    from . import wellformed, shapes, sharding, donation, \
+        recompile  # noqa: F401
+
+
+def run_passes(program, feed_names=None, fetch_names=None, passes=None):
+    """Run the analysis passes over `program`; returns the list of
+    Diagnostics in pass order. `passes` selects a subset by name
+    (default: every registered pass). A pass that crashes becomes a
+    'pass-crashed' warning instead of masking the program under
+    analysis — the verifier must never be the thing that takes a
+    training run down."""
+    _ensure_passes_loaded()
+    ctx = AnalysisContext(program, feed_names=feed_names,
+                          fetch_names=fetch_names)
+    for name in (list(PASSES) if passes is None else passes):
+        if name not in PASSES:
+            raise ValueError('unknown analysis pass %r (have: %s)'
+                             % (name, ', '.join(PASSES)))
+        ctx._pass = name
+        try:
+            PASSES[name](ctx)
+        except Exception as e:
+            ctx.warning('pass-crashed',
+                        'analysis pass %r crashed: %s: %s'
+                        % (name, type(e).__name__, e))
+    return ctx.diagnostics

@@ -1,0 +1,68 @@
+"""Pass 4 — donation/aliasing safety.
+
+The executor DONATES every scope input to the jitted step: persistable
+buffers (params, optimizer state, the decode KV arenas) alias their
+outputs and update in place in HBM. Two ops writing one persistable in
+a single step therefore race on a donated buffer (the executor keeps
+the last write; whichever the user meant, one update is silently
+lost), and an op that reads a param AFTER its in-place optimizer
+update observes the post-step value inside the very step whose forward
+consumed the pre-step value.
+"""
+
+from .base import analysis_pass
+
+_SUBBLOCK_OPS = frozenset(('while', 'if_else', 'static_rnn',
+                           'dynamic_rnn'))
+
+
+@analysis_pass('donation')
+def check(ctx):
+    block = ctx.block
+    writers = {}
+    for i, op in enumerate(block.ops):
+        if op.type in _SUBBLOCK_OPS:
+            continue
+        for name in set(op.output_names()):
+            v = ctx.find_var(name)
+            if v is None or not v.persistable:
+                continue
+            writers.setdefault(name, []).append((i, op))
+
+    for name, lst in writers.items():
+        if len(lst) <= 1:
+            continue
+        i, op = lst[1]
+        ctx.error('double-donation',
+                  'persistable %r is written by %d ops in one step '
+                  '(first at op#%d %s) — with buffer donation the '
+                  'writes race on one aliased buffer and only the '
+                  'last survives' % (name, len(lst), lst[0][0],
+                                     lst[0][1].type),
+                  op=op, op_index=i, var=name)
+
+    # read-after-donate: Param-slot in-place updates (ParamOut == Param)
+    # followed by any op that reads the updated var later in the step
+    updates = {}
+    for i, op in enumerate(block.ops):
+        pname = op.input('Param')
+        if pname is not None and pname in op.output_names():
+            updates.setdefault(pname, (i, op))
+    if not updates:
+        return
+    for j, op in enumerate(block.ops):
+        for name in set(op.input_names()):
+            at = updates.get(name)
+            if at is None or j <= at[0] or op is at[1]:
+                continue
+            if op.input('Param') == name and name in op.output_names():
+                # another in-place updater of the same var: that race is
+                # double-donation, already reported above
+                continue
+            ctx.warning('read-after-donate',
+                        'op reads %r after its in-place update at '
+                        'op#%d %s — it observes the POST-update value '
+                        'within the same step (the forward consumed '
+                        'the pre-update value)' % (name, at[0],
+                                                   at[1].type),
+                        op=op, op_index=j, var=name)

@@ -1,0 +1,94 @@
+"""Pass 5 — recompile-hazard lint.
+
+The executor caches compiled steps per (program content, feed
+signature); the AOT disk cache additionally fingerprints the
+SERIALIZED program. Two bug classes silently defeat both: attrs that
+embed per-process Python values (an object repr carries a memory
+address, a callable can't round-trip through serialization at all), so
+the 'same' program fingerprints differently every build; and feed vars
+with unbound non-batch dims, where every distinct length arriving from
+live traffic mints a fresh XLA signature — the signature-churn class
+the serving engines bound with BucketLadder and fixed decode shapes.
+"""
+
+from .base import analysis_pass
+
+_SCALARS = (bool, int, float, str, bytes, type(None))
+
+
+def _attr_hazard(value, depth=0):
+    """None, or (code, severity, detail) for the worst hazard in an
+    attr value tree."""
+    if isinstance(value, _SCALARS):
+        return None
+    if depth > 6:
+        return None
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            h = _attr_hazard(v, depth + 1)
+            if h is not None:
+                return h
+        return None
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if not isinstance(k, _SCALARS):
+                return ('attr-object-id', 'error',
+                        'dict key %r is not a serializable scalar' % (k,))
+            h = _attr_hazard(v, depth + 1)
+            if h is not None:
+                return h
+        return None
+    if isinstance(value, (set, frozenset)):
+        return ('attr-unordered', 'warning',
+                'set value %r has no stable iteration order — its '
+                'serialization (and so the AOT cache fingerprint) can '
+                'differ between processes' % (sorted(map(repr, value)),))
+    if callable(value):
+        return ('attr-callable', 'error',
+                'callable %r cannot be serialized; its identity (a '
+                'per-process pointer) leaks into the program '
+                'fingerprint' % getattr(value, '__name__', value))
+    tname = type(value).__name__
+    if tname == 'ndarray':
+        return ('attr-ndarray', 'warning',
+                'numpy array of shape %s embedded in attrs — prefer a '
+                'list (arrays are rebuilt per call and defeat '
+                'fingerprint stability)' % (getattr(value, 'shape',
+                                                    '?'),))
+    r = repr(value)
+    if ' object at 0x' in r or ' at 0x' in r:
+        return ('attr-object-id', 'error',
+                'attr holds %s whose repr embeds a memory address — '
+                'the program fingerprint (and any cache keyed on it) '
+                'churns every process' % type(value).__name__)
+    return ('attr-object', 'warning',
+            'attr holds a %s instance, which JSON serialization of '
+            'the program cannot represent' % type(value).__name__)
+
+
+@analysis_pass('recompile')
+def check(ctx):
+    for i, op in enumerate(ctx.block.ops):
+        for attr_name, value in op.attrs.items():
+            h = _attr_hazard(value)
+            if h is None:
+                continue
+            code, severity, detail = h
+            msg = 'attr %r of %s: %s' % (attr_name, op.type, detail)
+            if severity == 'error':
+                ctx.error(code, msg, op=op, op_index=i)
+            else:
+                ctx.warning(code, msg, op=op, op_index=i)
+
+    for v in ctx.block.vars.values():
+        if not v.is_data or v.shape is None:
+            continue
+        unbound = [d for d in range(1, len(v.shape)) if v.shape[d] == -1]
+        if unbound:
+            ctx.warning('dynamic-feed-dim',
+                        'data var %r has unbound non-batch dims %s — '
+                        'every distinct length fed at run time mints a '
+                        'new executor signature (compile + cache '
+                        'entry); bucket or pad it '
+                        '(serving.BucketLadder)' % (v.name, unbound),
+                        var=v.name)

@@ -1,0 +1,122 @@
+"""Pass 3 — sharding consistency.
+
+Propagates the logical PartitionSpecs the transpiler attached
+(`program.var_shardings`, GSPMD-style) through static checks: a spec
+axis that the mesh does not have, a sharded dim the mesh axis cannot
+divide, parameters left unannotated on a >1-device mesh, and input
+spec conflicts that force XLA to insert an implicit all-gather/
+reshard on the hot path. Mesh and specs are duck-typed (``mesh.shape``
+mapping, specs iterate as axis entries) so the pass never imports jax.
+"""
+
+from .base import analysis_pass
+
+# Ops where inputs meeting with different layouts forces a reshard.
+_ALIGNED_OPS = frozenset((
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min'))
+
+
+def _spec_entries(spec):
+    """PartitionSpec -> list of per-dim entries, each None | axis name |
+    tuple of axis names."""
+    try:
+        return list(spec)
+    except TypeError:
+        return []
+
+
+def _entry_axes(entry):
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+@analysis_pass('sharding')
+def check(ctx):
+    program = ctx.program
+    mesh = program.mesh
+    shardings = program.var_shardings or {}
+    if mesh is None and not shardings:
+        return
+    mesh_shape = {}
+    if mesh is not None:
+        mesh_shape = dict(mesh.shape)
+    n_devices = 1
+    for size in mesh_shape.values():
+        n_devices *= int(size)
+
+    first_op = ctx.block.ops[0] if ctx.block.ops else None
+    for name in sorted(shardings):
+        spec = shardings[name]
+        entries = _spec_entries(spec)
+        if not entries:
+            continue
+        var = ctx.find_var(name)
+        shape = None if var is None or var.shape is None \
+            else tuple(var.shape)
+        if shape is not None and len(entries) > len(shape):
+            ctx.error('spec-rank-mismatch',
+                      'sharding spec %s has %d entries but %r has rank '
+                      '%d' % (tuple(entries), len(entries), name,
+                              len(shape)), var=name)
+            continue
+        for dim, entry in enumerate(entries):
+            extent = 1
+            for axis in _entry_axes(entry):
+                if mesh is not None and axis not in mesh_shape:
+                    ctx.error('unknown-mesh-axis',
+                              '%r dim %d is sharded over axis %r, '
+                              'which mesh %s does not have'
+                              % (name, dim, axis,
+                                 dict(mesh_shape)), var=name)
+                    continue
+                extent *= int(mesh_shape.get(axis, 1))
+            if extent <= 1 or shape is None:
+                continue
+            d = shape[dim]
+            if d is not None and d >= 0 and d % extent:
+                ctx.error('axis-indivisible',
+                          '%r dim %d (=%d) is sharded over %s '
+                          '(extent %d) but %d %% %d != 0 — XLA must '
+                          'pad or reshard every step'
+                          % (name, dim, d, _entry_axes(entry), extent,
+                             d, extent), var=name)
+
+    if n_devices > 1:
+        for param in program.all_parameters():
+            if param.name not in shardings:
+                ctx.warning('unannotated-param',
+                            'parameter %r has no sharding spec on a '
+                            '%d-device mesh — it will be replicated '
+                            'by default; run parallel.transpile or '
+                            'annotate it' % (param.name, n_devices),
+                            var=param.name)
+
+    # spec conflicts at aligned ops: both inputs annotated, same rank,
+    # different layouts -> GSPMD inserts a reshard to make them meet
+    def sharded_spec(name):
+        entries = _spec_entries(shardings.get(name))
+        return entries if any(e is not None for e in entries) else None
+
+    for i, op in enumerate(ctx.block.ops):
+        if op.type not in _ALIGNED_OPS:
+            continue
+        xn, yn = op.input('X'), op.input('Y')
+        if xn is None or yn is None:
+            continue
+        xs, ys = sharded_spec(xn), sharded_spec(yn)
+        if xs is None or ys is None:
+            continue
+        xv, yv = ctx.shape_of(xn), ctx.shape_of(yn)
+        if xv is None or yv is None or len(xv) != len(yv):
+            continue
+        if xs != ys:
+            ctx.warning('spec-conflict',
+                        '%s meets %r sharded %s with %r sharded %s — '
+                        'GSPMD will insert an implicit reshard here '
+                        'every step' % (op.type, xn, tuple(xs), yn,
+                                        tuple(ys)), op=op, op_index=i,
+                        var=yn)

@@ -1,0 +1,129 @@
+"""Pass 1 — graph well-formedness.
+
+Catches the whole-program-compilation failure modes that otherwise
+surface as opaque KeyErrors deep inside the tracer: reads of names no
+block defines, reads that happen before their producer in block order,
+two ops racing on one temporary, and ops no fetch target can reach
+(which the executor silently prunes — dead weight in the builder).
+"""
+
+from .base import analysis_pass
+
+# Op types that legitimately rewrite an existing var (loop counters,
+# tensor-array cells, explicit copies); duplicate writes through them
+# are control flow, not races.
+_REWRITE_OPS = frozenset(('array_write', 'assign', 'increment', 'while',
+                          'if_else', 'static_rnn', 'dynamic_rnn',
+                          'beam_search', 'scatter'))
+
+# Pruning survivors that exist for their side effect, not a fetch.
+_EFFECT_OPS = frozenset(('print', 'backward_marker'))
+
+
+def _injected_names(program):
+    """Names that op LOWERINGS inject into sub-block envs at trace time
+    rather than any op producing them: recurrent memories
+    (memory_names pre entries), per-step scan slices
+    (step_input_names), and the generation-decode feedback token
+    (id_pre_name). The executor treats reads of declared-nowhere names
+    the same way (core/executor.py _compile feeds them through), so
+    they are convention, not breakage."""
+    injected = set()
+    for b in program.blocks:
+        for op in b.ops:
+            for pre, _cur in op.attrs.get('memory_names') or ():
+                injected.add(pre)
+            injected.update(op.attrs.get('step_input_names') or ())
+            id_pre = op.attrs.get('id_pre_name')
+            if id_pre:
+                injected.add(id_pre)
+    return injected
+
+
+@analysis_pass('wellformed')
+def check(ctx):
+    from ..core.executor import _op_reads, _prune_ops
+    program, block = ctx.program, ctx.block
+    reads_cache = {}
+
+    defined = set(ctx.feed_names)
+    for b in program.blocks:
+        for name, v in b.vars.items():
+            if v.persistable or v.is_data:
+                defined.add(name)
+
+    all_written = set()
+    for b in program.blocks:
+        for op in b.ops:
+            all_written.update(op.output_names())
+            if op.type == 'backward_marker':
+                all_written.update(op.attrs.get('grad_names', ()))
+
+    defined |= _injected_names(program)
+
+    producers = {}
+    for i, op in enumerate(block.ops):
+        if op.type == 'backward_marker':
+            defined.update(op.attrs.get('grad_names', ()))
+            continue
+        direct = set(op.input_names())
+        for name in _op_reads(op, program, reads_cache):
+            if name in defined:
+                continue
+            defined.add(name)   # report each name once
+            if ctx.find_var(name) is None:
+                if name in direct:
+                    ctx.error('undefined-input',
+                              'op reads %r, which no block declares '
+                              'and no op produces' % name, op=op,
+                              op_index=i, var=name)
+                else:
+                    # a sub-block read of a declared-nowhere name: the
+                    # executor assumes a lowering injects it; flag it,
+                    # but not fatally
+                    ctx.warning('undefined-subblock-input',
+                                'sub-block of op reads %r, which no '
+                                'block declares and no op produces — '
+                                'the lowering must inject it at trace '
+                                'time' % name, op=op, op_index=i,
+                                var=name)
+            elif name in all_written:
+                ctx.error('use-before-def',
+                          'op reads %r before any producer in block '
+                          'order (first written by a later op)' % name,
+                          op=op, op_index=i, var=name)
+            else:
+                ctx.error('uninitialized-input',
+                          'op reads %r, which is neither fed, '
+                          'persistable, nor produced by any op — the '
+                          'executor will fail to gather it from scope'
+                          % name, op=op, op_index=i, var=name)
+        for name in op.output_names():
+            defined.add(name)
+            producers.setdefault(name, []).append((i, op))
+
+    for name, writers in producers.items():
+        if len(writers) <= 1:
+            continue
+        v = ctx.find_var(name)
+        if v is not None and v.persistable:
+            continue   # in-place persistable updates: donation pass
+        if any(op.type in _REWRITE_OPS for _, op in writers):
+            continue
+        i, op = writers[1]
+        ctx.warning('duplicate-writer',
+                    '%r is written by %d ops (first at op#%d %s) — '
+                    'later writes shadow earlier ones in one trace'
+                    % (name, len(writers), writers[0][0],
+                       writers[0][1].type), op=op, op_index=i, var=name)
+
+    if ctx.fetch_names:
+        kept = set(id(op) for op in _prune_ops(
+            block, list(block.ops), ctx.fetch_names, reads_cache))
+        for i, op in enumerate(block.ops):
+            if id(op) in kept or op.type in _EFFECT_OPS:
+                continue
+            ctx.info('dead-op',
+                     'op reaches no fetch target and writes no '
+                     'persistable state; the executor prunes it',
+                     op=op, op_index=i)

@@ -247,6 +247,10 @@ class Executor(object):
         # thread's hit can't mask another thread's miss.
         self._lock = threading.Lock()
         self._compile_locks = {}
+        # Program keys already checked by the static verifier
+        # (PADDLE_TPU_VERIFY): verification runs once per key, at first
+        # compile, BEFORE anything traces.
+        self._verified = set()
         # The step fn DONATES its scope inputs (param buffers alias
         # outputs); two concurrent dispatches on one scope would hand
         # the second a deleted buffer. Dispatch + scope write-back is
@@ -293,6 +297,23 @@ class Executor(object):
             step0 = self._step
             self._step += n
         return np.int32(step0)
+
+    def _maybe_verify(self, kind, key, program, feed_vals, fetch_names):
+        """PADDLE_TPU_VERIFY=off|warn|strict: run the static verifier
+        (paddle_tpu.analysis) over the program ONCE per cache key, at
+        the first sight of that key and BEFORE any trace — strict mode
+        raises ProgramVerifyError while the op that broke the graph is
+        still one `file:line` away; warn mode records program_verify
+        flight events + analysis.* counters and proceeds. 'off' (the
+        default) costs one set lookup per run."""
+        if key in self._verified:
+            return
+        from ..analysis import verify, verify_mode
+        mode = verify_mode()
+        if mode != 'off':
+            verify(program, feed_names=sorted(feed_vals),
+                   fetch_names=fetch_names, mode=mode, label=kind)
+        self._verified.add(key)
 
     def _lookup_or_compile(self, kind, key, use_cache, compile_fn,
                            program=None, aot_parts=None):
@@ -434,6 +455,8 @@ class Executor(object):
                                 for n, v in feed_vals.items()))
         key = (id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names))
+        self._maybe_verify('single', key, program, feed_vals,
+                           fetch_names)
         self.last_warm_from_disk = False
         compiled, missed = self._lookup_or_compile(
             'single', key, use_program_cache,
@@ -530,6 +553,7 @@ class Executor(object):
         key = ('multi', id(program), program._version, program.amp,
                program.remat_policy, feed_sig, tuple(fetch_names),
                steps, stacked_feed)
+        self._maybe_verify('multi', key, program, feed_vals, fetch_names)
 
         def _build_multi():
             base = self._compile(program, sorted(feed_vals), fetch_names)

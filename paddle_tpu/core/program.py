@@ -10,9 +10,41 @@ compiled-executable caches invalidate.
 """
 
 import contextlib
+import os
+import sys
 
 from . import unique_name
 from .dtypes import canonical_dtype
+
+# Root of the paddle_tpu package: frames under it are framework
+# machinery, frames outside it are the user code an op's construction
+# provenance should point at (core/program.py -> core -> paddle_tpu).
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _capture_provenance():
+    """'file.py:line' of the nearest non-framework frame on the stack —
+    the user statement that (transitively) appended this op. Every
+    analysis diagnostic and Operator.__repr__ points there, so a shape
+    error deep in a 200-op graph names the layers call that built it,
+    not the tracer. One short frame walk per append_op; hot
+    program-building loops can switch it off with
+    PADDLE_TPU_PROVENANCE=0 (None is stored, diagnostics degrade to
+    op indices). Returns None when the whole stack is framework frames
+    (programs built by clone/serialize keep the ORIGINAL op's
+    provenance instead — see Program.clone)."""
+    if os.environ.get('PADDLE_TPU_PROVENANCE') == '0':
+        return None
+    f = sys._getframe(2)   # skip _capture_provenance + append/prepend_op
+    depth = 0
+    while f is not None and depth < 40:
+        filename = f.f_code.co_filename
+        if not filename.startswith(_PKG_DIR) and \
+                not filename.startswith('<'):
+            return '%s:%d' % (filename, f.f_lineno)
+        f = f.f_back
+        depth += 1
+    return None
 
 
 class Variable(object):
@@ -120,14 +152,21 @@ class Parameter(Variable):
 
 
 class Operator(object):
-    """One op invocation. inputs/outputs map slot name -> list of var names."""
+    """One op invocation. inputs/outputs map slot name -> list of var names.
 
-    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+    `provenance` is the 'file.py:line' of the user statement that built
+    the op (captured by Block.append_op; None with
+    PADDLE_TPU_PROVENANCE=0 or for purely framework-built programs).
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None,
+                 provenance=None):
         self.block = block
         self.type = type
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        self.provenance = provenance
 
     def input(self, slot):
         names = self.inputs.get(slot, [])
@@ -147,7 +186,9 @@ class Operator(object):
         return self.attrs.get(name, default)
 
     def __repr__(self):
-        return 'Op(%s, in=%s, out=%s)' % (self.type, self.inputs, self.outputs)
+        where = ' @ %s' % self.provenance if self.provenance else ''
+        return 'Op(%s, in=%s, out=%s%s)' % (self.type, self.inputs,
+                                            self.outputs, where)
 
 
 def _to_name_list(value):
@@ -210,7 +251,8 @@ class Block(object):
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         inputs = {k: _to_name_list(v) for k, v in (inputs or {}).items()}
         outputs = {k: _to_name_list(v) for k, v in (outputs or {}).items()}
-        op = Operator(self, type, inputs, outputs, attrs)
+        op = Operator(self, type, inputs, outputs, attrs,
+                      provenance=_capture_provenance())
         self.ops.append(op)
         self.program._bump_version()
         return op
@@ -218,7 +260,8 @@ class Block(object):
     def prepend_op(self, type, inputs=None, outputs=None, attrs=None):
         inputs = {k: _to_name_list(v) for k, v in (inputs or {}).items()}
         outputs = {k: _to_name_list(v) for k, v in (outputs or {}).items()}
-        op = Operator(self, type, inputs, outputs, attrs)
+        op = Operator(self, type, inputs, outputs, attrs,
+                      provenance=_capture_provenance())
         self.ops.insert(0, op)
         self.program._bump_version()
         return op
@@ -345,7 +388,9 @@ class Program(object):
                     attrs['is_test'] = True
                 if for_test and op.type in ('dropout', 'batch_norm'):
                     attrs['is_test'] = True
-                nb.append_op(op.type, op.inputs, op.outputs, attrs)
+                # keep the ORIGINAL construction site, not the clone call
+                nb.append_op(op.type, op.inputs, op.outputs,
+                             attrs).provenance = op.provenance
         p.current_block_idx = 0
         return p
 

@@ -26,7 +26,8 @@ def program_to_dict(program):
             'parent_idx': b.parent_idx,
             'vars': [_var_to_dict(v) for v in b.vars.values()],
             'ops': [{'type': op.type, 'inputs': op.inputs,
-                     'outputs': op.outputs, 'attrs': op.attrs}
+                     'outputs': op.outputs, 'attrs': op.attrs,
+                     'provenance': op.provenance}
                     for op in b.ops],
         })
     return {'blocks': blocks, 'random_seed': program.random_seed}
@@ -54,6 +55,10 @@ def program_from_dict(data):
             v.stop_gradient = vd['stop_gradient']
             b.vars[vd['name']] = v
         for od in bd['ops']:
-            b.append_op(od['type'], od['inputs'], od['outputs'], od['attrs'])
+            # restore the recorded construction site (absent in pre-
+            # provenance serializations; the deserialize call site would
+            # be a lie)
+            b.append_op(od['type'], od['inputs'], od['outputs'],
+                        od['attrs']).provenance = od.get('provenance')
     p.current_block_idx = 0
     return p

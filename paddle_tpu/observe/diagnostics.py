@@ -15,7 +15,9 @@ started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
                hits), the autotuner panel (tuning-table size, decision
                counts), trainer in-flight pipeline depth, MFU/goodput,
                the decode-engine panel (running/waiting sequences,
-               KV-page occupancy, preemption/token counters), anomaly
+               KV-page occupancy, preemption/token counters), the
+               static-verifier panel (programs verified, diagnostics
+               by severity/pass — paddle_tpu.analysis), anomaly
                state, flight-recorder occupancy, health results
     /tracez    last N completed spans as JSON (?n=200)
     /healthz   200 ok / 503 degraded from the liveness health checks
@@ -192,6 +194,36 @@ def _decode_status(snap):
     }
 
 
+def _analysis_status(snap):
+    """Static-verifier panel (None when no analysis.* metric exists):
+    programs verified by label, diagnostics by (severity, pass), and
+    total verify seconds — the live answer to 'did the verifier see
+    this program, and what did it say'."""
+    counters = snap.get('counters', {})
+    histograms = snap.get('histograms', {})
+    if not any(k.startswith('analysis.')
+               for k in list(counters) + list(histograms)):
+        return None
+    verified = {}
+    diagnostics = {}
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'analysis.programs_verified_total':
+            verified[labels.get('label', '?')] = v
+        elif name == 'analysis.diagnostics_total':
+            k = '%s/%s' % (labels.get('severity', '?'),
+                           labels.get('pass', '?'))
+            diagnostics[k] = diagnostics.get(k, 0) + v
+    seconds = 0.0
+    for rendered, st in histograms.items():
+        name, _ = parse_rendered(rendered)
+        if name == 'analysis.verify_seconds':
+            seconds += st.get('sum') or 0.0
+    return {'programs_verified': verified,
+            'diagnostics': diagnostics,
+            'verify_seconds': round(seconds, 6)}
+
+
 def _statusz_doc():
     from . import (anomaly_state, enabled, flight_dump_path,
                    flight_recorder, goodput, snapshot)
@@ -219,6 +251,7 @@ def _statusz_doc():
         'executor_cache': _executor_cache_table(snap),
         'tuning': _tuning_status(snap),
         'decode': _decode_status(snap),
+        'analysis': _analysis_status(snap),
         'anomalies': anomaly_state(),
         'flight': {'events': total, 'evicted': evicted,
                    'capacity': fr.capacity,

@@ -81,6 +81,20 @@ class DecodeEngine(object):
         self._progs = build_lm_programs(spec, self.max_batch,
                                         self.block_size, self.num_blocks,
                                         self.pages_per_seq)
+        # static IR verification of all three programs before anything
+        # compiles (default warn; PADDLE_TPU_VERIFY=strict refuses a
+        # broken graph at construction, not mid-traffic)
+        from ... import analysis as _analysis
+        _analysis.startup_verify(self._progs.startup,
+                                 label='decode_startup')
+        _analysis.startup_verify(
+            self._progs.prefill,
+            fetch_names=[self._progs.prefill_fetch],
+            label='decode_prefill')
+        _analysis.startup_verify(
+            self._progs.decode,
+            fetch_names=[self._progs.decode_fetch],
+            label='decode_step')
         self.capacity = self._progs.capacity
         self.max_prompt_len = int(max_prompt_len) if max_prompt_len \
             else self.capacity - 1

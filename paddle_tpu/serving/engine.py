@@ -198,7 +198,19 @@ class ServingEngine(object):
     def start(self):
         """Launch the batcher and dispatch threads (idempotent).
         Registers ready() as a /readyz check on the diagnostics server's
-        health registry (observe.serve exposes it)."""
+        health registry (observe.serve exposes it). Verifies the
+        predictor's program first (paddle_tpu.analysis, default warn;
+        PADDLE_TPU_VERIFY=strict refuses to serve a broken graph)."""
+        program = getattr(self._predictor, 'program', None)
+        if program is not None:   # duck-typed predictors have no IR
+            from .. import analysis as _analysis
+            _analysis.startup_verify(
+                program,
+                feed_names=list(self._predictor.feed_names),
+                fetch_names=[getattr(f, 'name', f) for f in
+                             getattr(self._predictor, 'fetch_targets',
+                                     ())],
+                label='serving')
         with self._mu:
             if self._closed:
                 raise EngineClosedError('ServingEngine is shut down')

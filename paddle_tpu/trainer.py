@@ -202,6 +202,15 @@ class Trainer(object):
         # recorder (and a SIGTERM postmortem) even with metrics off, so
         # a preempted run leaves its last seconds behind
         _obs.arm_flight_from_env()
+        # static IR verification before the first compile: default warn
+        # (flight events + counters), PADDLE_TPU_VERIFY=strict raises
+        # ProgramVerifyError here — before tracing, pointing at the
+        # layers call that built the broken op
+        from . import analysis as _analysis
+        _analysis.startup_verify(
+            self.program,
+            fetch_names=[getattr(f, 'name', f) for f in self.fetches],
+            label='trainer')
         _obs.run_begin()
         try:
             self._train_impl(num_epochs, event_handler, reader,

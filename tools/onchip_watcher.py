@@ -84,6 +84,10 @@ QUEUE = [
     # chip?) + cold-vs-warm startup seconds; tuning.*/aot.* gauges land
     # in the shared metrics JSONL
     ('autotune', 'autotune', None, 900),
+    # static verifier overhead guard (ISSUE 9): analysis passes vs cold
+    # compile on the transformer program; analysis.* gauges land in the
+    # shared metrics JSONL and `ok` asserts the <1% contract on-chip
+    ('verify', 'verify', None, 600),
 ]
 
 # non-bench tools: (key, argv, timeout) — raw stdout lines stored

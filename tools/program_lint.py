@@ -1,0 +1,94 @@
+"""Lint a serialized Program with the static-analysis passes.
+
+Points at a ``save_inference_model`` directory (or its
+``__model__.json`` directly), deserializes the program — no jax, no
+devices — and runs every ``paddle_tpu.analysis`` pass over it, using
+the feed/fetch names recorded in the model meta. Construction
+provenance survives serialization, so diagnostics still name the
+``file.py:line`` that appended the offending op.
+
+Usage::
+
+    python tools/program_lint.py /path/to/model_dir
+    python tools/program_lint.py model_dir/__model__.json --json
+    python tools/program_lint.py model_dir --strict     # warnings fail too
+
+Exit codes: 0 clean (infos allowed), 1 errors found (or, with
+--strict, warnings too), 2 unreadable input.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_meta(path):
+    if os.path.isdir(path):
+        path = os.path.join(path, '__model__.json')
+    with open(path) as f:
+        return path, json.load(f)
+
+
+def lint(meta, passes=None):
+    """(diagnostics, counts) for a loaded __model__.json meta dict."""
+    from paddle_tpu import analysis
+    from paddle_tpu.core.serialize import program_from_dict
+    program = program_from_dict(meta['program'])
+    diags = analysis.run_passes(program,
+                                feed_names=meta.get('feed_names'),
+                                fetch_names=meta.get('fetch_names'),
+                                passes=passes)
+    return diags, analysis.summarize(diags)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='static-analysis lint over a serialized Program')
+    ap.add_argument('model', help='save_inference_model dir or the '
+                                  '__model__.json inside it')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable report on stdout')
+    ap.add_argument('--strict', action='store_true',
+                    help='non-zero exit on warnings as well as errors')
+    ap.add_argument('--pass', dest='passes', action='append',
+                    metavar='NAME',
+                    help='run only the named pass (repeatable)')
+    args = ap.parse_args(argv)
+
+    try:
+        path, meta = _load_meta(args.model)
+    except (OSError, ValueError) as e:
+        print('program_lint: cannot read %s: %s' % (args.model, e),
+              file=sys.stderr)
+        return 2
+
+    try:
+        diags, counts = lint(meta, passes=args.passes)
+    except ValueError as e:          # unknown --pass name
+        print('program_lint: %s' % e, file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            'model': path,
+            'ops': sum(len(b['ops']) for b in meta['program']['blocks']),
+            'counts': counts,
+            'diagnostics': [d.to_dict() for d in diags],
+        }, indent=2, sort_keys=True))
+    else:
+        for d in diags:
+            print(d.format())
+        print('%s: %d error(s), %d warning(s), %d info(s)'
+              % (path, counts['error'], counts['warning'],
+                 counts['info']))
+
+    failed = counts['error'] or (args.strict and counts['warning'])
+    return 1 if failed else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Repo-wide AST lint for the bug classes that have actually bitten
+this codebase — run as a tier-1 test (tests/test_repo_lint.py).
+
+Rules:
+
+- ``import-time-env`` (paddle_tpu/ops/ and paddle_tpu/tuning/ only):
+  no ``os.environ`` / ``os.getenv`` / ``get_flag`` / ``FLAGS`` reads
+  at module import time — including class bodies, decorators, and
+  function DEFAULT argument expressions (all evaluate at import). An
+  env knob frozen at import cannot be flipped per call or per test;
+  this is the exact class PR 8 fixed by hand in flash_attention /
+  batch_norm (PADDLE_TPU_PALLAS_BLOCK_K read once, forever).
+- ``bare-except`` (paddle_tpu/ everywhere): ``except:`` swallows
+  KeyboardInterrupt/SystemExit — name the exception.
+- ``mutable-default`` (paddle_tpu/ everywhere): list/dict/set literals
+  (or list()/dict()/set() calls) as default argument values share one
+  instance across every call.
+
+Usage::
+
+    python tools/repo_lint.py                # lint the repo, exit 1 on hits
+    python tools/repo_lint.py --root DIR --json
+"""
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+# Directories (relative to --root) where import-time env/flag reads are
+# banned. ops/ and tuning/ lowerings run inside jit-compiled dispatch:
+# a knob read at import silently pins the process to its boot-time env.
+ENV_SCOPED_DIRS = ('paddle_tpu/ops', 'paddle_tpu/tuning')
+LINT_ROOT = 'paddle_tpu'
+
+_ENV_ATTRS = ('environ', 'getenv')
+_ENV_NAMES = ('environ', 'getenv', 'get_flag', 'FLAGS')
+_MUTABLE_CALLS = ('list', 'dict', 'set')
+
+
+class Violation(object):
+    __slots__ = ('path', 'line', 'code', 'message')
+
+    def __init__(self, path, line, code, message):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.message = message
+
+    def to_dict(self):
+        return {'path': self.path, 'line': self.line, 'code': self.code,
+                'message': self.message}
+
+    def format(self):
+        return '%s:%d: [%s] %s' % (self.path, self.line, self.code,
+                                   self.message)
+
+
+def _is_env_read(node):
+    """True for os.environ / os.getenv / <x>.environ / bare environ /
+    getenv / get_flag / FLAGS references."""
+    if isinstance(node, ast.Attribute) and node.attr in _ENV_ATTRS:
+        return True
+    if isinstance(node, ast.Name) and node.id in _ENV_NAMES:
+        return True
+    return False
+
+
+def _walk_import_time(body, visit):
+    """Visit every expression that evaluates at module import: module
+    statements, class bodies, decorators, and function default args —
+    but NOT function bodies."""
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                visit(d)
+            a = node.args
+            for default in list(a.defaults) + [d for d in a.kw_defaults
+                                               if d is not None]:
+                visit(default)
+        elif isinstance(node, ast.ClassDef):
+            for d in node.decorator_list:
+                visit(d)
+            _walk_import_time(node.body, visit)
+        else:
+            visit(node)
+
+
+def lint_source(path, source, env_scoped=False):
+    """Violations for one file's source text."""
+    out = []
+    try:
+        tree = ast.parse(source, path)
+    except SyntaxError as e:
+        out.append(Violation(path, e.lineno or 0, 'syntax-error', str(e)))
+        return out
+
+    if env_scoped:
+        def visit(expr):
+            for sub in ast.walk(expr):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    # deferred bodies are fine; their defaults are
+                    # re-visited by _walk_import_time only at top level,
+                    # which is the case that matters (nested defs whose
+                    # defaults read env at import are vanishingly rare)
+                    continue
+                if _is_env_read(sub):
+                    out.append(Violation(
+                        path, sub.lineno, 'import-time-env',
+                        'environment/flag read at module import time — '
+                        'read it inside the function (per call) instead'))
+        _walk_import_time(tree.body, visit)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(Violation(
+                path, node.lineno, 'bare-except',
+                "bare 'except:' catches KeyboardInterrupt/SystemExit — "
+                'name the exception (Exception at the widest)'))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for default in list(a.defaults) + [d for d in a.kw_defaults
+                                               if d is not None]:
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set,
+                                           ast.ListComp, ast.DictComp,
+                                           ast.SetComp))
+                if not bad and isinstance(default, ast.Call) and \
+                        isinstance(default.func, ast.Name) and \
+                        default.func.id in _MUTABLE_CALLS:
+                    bad = True
+                if bad:
+                    out.append(Violation(
+                        path, default.lineno, 'mutable-default',
+                        'mutable default argument in %r shares one '
+                        'instance across calls — default to None'
+                        % node.name))
+    return out
+
+
+def lint_tree(root):
+    """Violations over <root>/paddle_tpu/**.py."""
+    violations = []
+    scoped = tuple(os.path.join(root, d.replace('/', os.sep)) + os.sep
+                   for d in ENV_SCOPED_DIRS)
+    top = os.path.join(root, LINT_ROOT)
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+        for fname in sorted(filenames):
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fname)
+            env_scoped = path.startswith(scoped)
+            try:
+                with open(path, encoding='utf-8') as f:
+                    source = f.read()
+            except OSError as e:
+                violations.append(Violation(path, 0, 'unreadable',
+                                            str(e)))
+                continue
+            violations.extend(lint_source(
+                os.path.relpath(path, root), source,
+                env_scoped=env_scoped))
+    return violations
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='repo-wide AST lint (import-time env reads, bare '
+                    'except, mutable defaults)')
+    ap.add_argument('--root', default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help='repo root (contains '
+                                          'paddle_tpu/)')
+    ap.add_argument('--json', action='store_true')
+    args = ap.parse_args(argv)
+
+    violations = lint_tree(args.root)
+    if args.json:
+        print(json.dumps({
+            'root': args.root,
+            'violations': [v.to_dict() for v in violations],
+            'count': len(violations),
+        }, indent=2, sort_keys=True))
+    else:
+        for v in violations:
+            print(v.format())
+        print('repo_lint: %d violation(s)' % len(violations))
+    return 1 if violations else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
