@@ -532,6 +532,218 @@ def bench_decode(duration=8.0, clients=8, max_batch=16, block_size=32,
     }
 
 
+def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
+                spike_qps=700.0, spike_at=2.0, spike_s=1.5, kill_at=2.4,
+                latency_budget_s=0.025, availability=0.95, window_s=1.5,
+                max_batch=8, max_queue_depth=12, trace_sample=0.05,
+                in_dim=8, retries=3, compute_delay_ms=10.0):
+    """Fleet chaos scenario (ROADMAP item 5): a >=3-replica router under
+    a diurnal open-loop load with a flash-crowd burst and a replica
+    kill mid-spike (fault.inject.kill_replica). Asserts nothing itself
+    — it measures and returns: accepted/completed/lost request counts
+    (the zero-loss contract), the burn-rate and goodput timelines
+    around the kill window, per-phase reject/error counts (plottable
+    shed windows), readiness flips, and the sampled-trace census.
+    slo.*/router.* metrics land in the metrics JSONL beside the
+    results store; tools/metrics_report.py --slo renders them."""
+    import tempfile
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.fault import inject
+    from paddle_tpu.observe.slo import Objective, SloTracker
+    from paddle_tpu.serving import (NoReplicaAvailableError, Router,
+                                    ServingEngine)
+    from paddle_tpu.serving.loadgen import (Stats, diurnal, flash_crowd,
+                                            heavy_tailed_rows, open_loop,
+                                            percentiles)
+
+    fluid = _fresh()
+    model_dir = os.path.join(tempfile.mkdtemp(prefix='fleet_bench_'),
+                             'model')
+    x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
+    h = fluid.layers.fc(input=x, size=16, act='relu')
+    out = fluid.layers.fc(input=h, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(model_dir, ['x'], [out], exe)
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+
+    from paddle_tpu.inference import create_predictor
+
+    class _ChaosPredictor(object):
+        """Duck-typed predictor with a fixed per-batch compute floor:
+        the overload arithmetic (offered rows/s vs replica capacity)
+        stops depending on how fast THIS machine's tiny MLP runs, so
+        the kill window burns error budget by construction."""
+
+        def __init__(self, inner, delay_s):
+            self._inner = inner
+            self._delay_s = delay_s
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+        def predict(self, feed):
+            out = self._inner.predict(feed)
+            if self._delay_s:
+                time.sleep(self._delay_s)
+            return out
+
+    delay_s = float(compute_delay_ms) / 1000.0
+    engines = [ServingEngine(_ChaosPredictor(create_predictor(model_dir),
+                                             delay_s),
+                             max_batch_size=max_batch,
+                             batch_timeout_ms=1.0,
+                             max_queue_depth=max_queue_depth,
+                             name='replica%d' % i)
+               for i in range(replicas)]
+    t_w0 = time.perf_counter()
+    for eng in engines:
+        eng.warmup()
+        eng.start()
+    warmup_s = time.perf_counter() - t_w0
+
+    tracker = SloTracker([Objective('fleet', latency_budget_s,
+                                    availability_target=availability,
+                                    window_s=window_s)])
+    router = Router(engines, slo=tracker, route='fleet',
+                    retries=retries)
+
+    schedule = flash_crowd(
+        diurnal(steady_qps, 1.25 * steady_qps, period_s=2 * duration),
+        spike_qps, spike_at, spike_s)
+
+    stats = Stats()
+    submitted = [0]
+    no_replica = [0]
+
+    def submit_request(rng):
+        rows = heavy_tailed_rows(rng, 1, max_batch)
+        feed = {'x': rng.rand(rows, in_dim).astype('float32')}
+        try:
+            fut = router.submit(feed, session=int(rng.randint(0, 64)),
+                                deadline_s=latency_budget_s)
+        except NoReplicaAvailableError:
+            no_replica[0] += 1
+            return None   # counted as a reject in the ledger
+        # QueueFullError (incl. SLOShedError) propagates: the loop
+        # counts it as a reject with a timestamp
+        submitted[0] += 1
+        return fut, rows
+
+    victim = engines[-1]
+    ready_before_kill = [None]
+    ready_after_kill = [None]
+    burn_timeline, goodput_timeline = [], []
+    t0 = time.perf_counter()
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.wait(0.05):
+            now = time.perf_counter()
+            burn_timeline.append(
+                (round(now - t0, 3), tracker.burn_rate('fleet', now)))
+            goodput_timeline.append(
+                (round(now - t0, 3), tracker.goodput('fleet', now)))
+
+    def killer():
+        wait = kill_at - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        ready_before_kill[0] = victim.ready()
+        inject.kill_replica(victim, drain=False)
+        ready_after_kill[0] = victim.ready()
+
+    threads = [threading.Thread(target=sampler, daemon=True),
+               threading.Thread(target=killer, daemon=True)]
+    # sampled requests leave cross-thread trace timelines + exemplars;
+    # per-call env read, restored after the run
+    prev_sample = os.environ.get('PADDLE_TPU_TRACE_SAMPLE')
+    os.environ['PADDLE_TPU_TRACE_SAMPLE'] = str(trace_sample)
+    try:
+        for t in threads:
+            t.start()
+        open_loop(submit_request, stats, t0 + duration, schedule)
+        for eng in engines:
+            if eng is not victim:
+                eng.shutdown(drain=True)
+        # router callbacks resolve synchronously with the inner
+        # futures; a short grace covers the last callback chain
+        t_end = time.perf_counter() + 10.0
+        while stats.ok + stats.errors < submitted[0] and \
+                time.perf_counter() < t_end:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        if prev_sample is None:
+            os.environ.pop('PADDLE_TPU_TRACE_SAMPLE', None)
+        else:
+            os.environ['PADDLE_TPU_TRACE_SAMPLE'] = prev_sample
+    wall = time.perf_counter() - t0
+    for t in threads:
+        t.join(timeout=5)
+    router.close()
+    tracker.publish()
+
+    # sampled-trace census: distinct trace ids and the widest thread
+    # spread any one of them achieved (the >=3-thread acceptance)
+    by_trace = {}
+    for ev in observe.spans().events():
+        tid = (ev.get('args') or {}).get('trace_id')
+        if tid and ev.get('ph') == 'X':
+            by_trace.setdefault(tid, set()).add(ev.get('tid'))
+    kill_window = (kill_at, min(kill_at + 2.0, duration))
+    burn_during_kill = max(
+        [b for t, b in burn_timeline
+         if kill_window[0] <= t <= kill_window[1]] or [0.0])
+    tail = [g for t, g in goodput_timeline if t >= 0.8 * duration]
+    accepted = submitted[0]
+    completed = stats.ok + stats.errors
+    phases = {
+        'steady': stats.counts_between(0.0, spike_at),
+        'spike': stats.counts_between(spike_at, spike_at + spike_s),
+        'after': stats.counts_between(spike_at + spike_s, duration),
+    }
+    snap = observe.snapshot()
+    return {
+        'workload': 'fleet',
+        'replicas': replicas,
+        'duration_s': round(wall, 3),
+        'accepted': accepted,
+        'completed': completed,
+        'lost': accepted - completed,
+        'requests_ok': stats.ok,
+        'requests_rejected': stats.rejected,
+        'requests_errored': stats.errors,
+        'no_replica': no_replica[0],
+        'latency_ms': percentiles(stats.latencies),
+        'phases': phases,
+        'burn_during_kill': round(burn_during_kill, 4),
+        'burn_timeline': burn_timeline,
+        'goodput_end_rps': round(sum(tail) / len(tail), 2)
+        if tail else 0.0,
+        'goodput_timeline': goodput_timeline,
+        'kill': {'victim': victim.name, 'at_s': kill_at,
+                 'ready_before': ready_before_kill[0],
+                 'ready_after': ready_after_kill[0]},
+        'failovers': sum(
+            v for k, v in snap['counters'].items()
+            if k.startswith('router.failover_total')),
+        'sheds': sum(v for k, v in snap['counters'].items()
+                     if k.startswith('router.shed_total')),
+        'sampled_traces': len(by_trace),
+        'max_trace_threads': max(
+            [len(tids) for tids in by_trace.values()] or [0]),
+        'slo': {'route': 'fleet',
+                'latency_budget_s': latency_budget_s,
+                'availability_target': availability,
+                'window_s': window_s},
+        'warmup_s': round(warmup_s, 3),
+    }
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -990,6 +1202,13 @@ def _run_workload_child(workload, backend, reduced):
                   n_head=2, d_model=32, d_inner=64, prompt_lo=2,
                   prompt_hi=16, max_new=16) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_decode(**kw)),
+              flush=True)
+        return
+    if workload == 'fleet':
+        kw = dict(duration=3.0, steady_qps=30.0, spike_qps=700.0,
+                  spike_at=1.0, spike_s=1.0, kill_at=1.2,
+                  window_s=1.0, max_queue_depth=8) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_fleet(**kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':
@@ -1532,8 +1751,8 @@ if __name__ == '__main__':
                                 'moe_cap1.25', 'moe_cap2.0',
                                 'pipeline_transformer',
                                 'pipeline_resnet50',
-                                'decode_transformer', 'autotune',
-                                'autotune_child', 'verify'])
+                                'decode_transformer', 'fleet',
+                                'autotune', 'autotune_child', 'verify'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

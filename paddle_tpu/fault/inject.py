@@ -13,7 +13,10 @@ randomness — reruns reproduce exactly):
 - truncate a checkpoint file right after it commits (a write torn by
   preemption, or bit-rot/partial copy that survived the atomic rename),
 - poison batch k's float arrays with NaNs (corrupt input),
-- make a reader raise transiently (flaky storage).
+- make a reader raise transiently (flaky storage),
+- kill one serving replica mid-load (``kill_replica`` — the fleet
+  chaos scenario's replica-down event; the router's failover and the
+  /readyz flip are asserted against it).
 
 Hook points: the Trainer calls fire('step_end', step=...) after each
 step, the CheckpointManager calls fire('checkpoint_saved', ...) after
@@ -35,7 +38,7 @@ import os
 
 __all__ = ['KILL_EXIT_CODE', 'FaultPlan', 'TransientReaderError',
            'install', 'install_from_env', 'clear', 'active', 'fire',
-           'truncate_file', 'poison_nans', 'flaky']
+           'truncate_file', 'poison_nans', 'flaky', 'kill_replica']
 
 KILL_EXIT_CODE = 42
 _ENV_KILL = 'PADDLE_TPU_FI_KILL_AT_STEP'
@@ -134,6 +137,37 @@ def fire(point, step=None, dirname=None):
             and plan.corrupt_checkpoint_at_step is not None
             and step == plan.corrupt_checkpoint_at_step and dirname):
         truncate_file(os.path.join(dirname, 'params.npz'))
+
+
+def kill_replica(engine, drain=False):
+    """Chaos action for the serving fleet: abruptly take one replica
+    down mid-load (``drain=False``, the default, is the preemption
+    shape — queued-but-unbatched requests fail with the typed
+    EngineClosedError, which the router's failover resubmits
+    elsewhere; batches already handed to dispatch still complete).
+    The flight event makes the kill findable in postmortems and the
+    chaos bench's assertion windows. Returns the engine."""
+    name = getattr(engine, 'name', None) or type(engine).__name__
+    try:
+        from .. import observe as _obs
+        _obs.flight_event('replica_kill', replica=str(name),
+                          drain=bool(drain))
+        _obs.inc('fault.replica_kills_total', replica=str(name))
+    except Exception:
+        _obs = None
+    engine.shutdown(drain=drain)
+    # a killed replica doesn't get to tidy its own grave: graceful
+    # shutdown unregisters the engine's /readyz check, but a chaos kill
+    # re-registers it so the corpse shows NOT-ready (the balancer-visible
+    # flip the failover tests assert) instead of silently vanishing
+    check = getattr(engine, '_ready_check', None)
+    if _obs is not None and callable(check):
+        try:
+            _obs.register_health_check('serving.%s' % name, check,
+                                       readiness_only=True)
+        except Exception:
+            pass
+    return engine
 
 
 def truncate_file(path, keep_fraction=0.5):

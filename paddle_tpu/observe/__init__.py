@@ -21,7 +21,15 @@ Six pieces, one switch:
   metrics off (`flight.py`, rendered by tools/flight_report.py),
 - streaming anomaly detection: EWMA z-score detectors over loss /
   step-time / anything fed to ``anomaly()``, flipping /healthz to
-  degraded while tripped (`anomaly.py`).
+  degraded while tripped (`anomaly.py`),
+- per-request distributed tracing: ``RequestContext`` correlates one
+  request's spans across threads via trace ids + Chrome-trace flow
+  events, sampled by ``PADDLE_TPU_TRACE_SAMPLE``, with histogram
+  exemplars linking /metrics p99 spikes to /tracez traces
+  (`reqtrace.py`),
+- SLO tracking: declared per-route objectives, rolling error-budget
+  burn rate, goodput, and the predicted p99 that drives the serving
+  router's SLO-aware admission (`slo.py`).
 
 Instrumented call sites across the executor, trainer, reader, fault,
 and parallel layers all funnel through the module-level helpers here
@@ -218,9 +226,12 @@ def add_gauge(name, n, **labels):
         _REG.gauge(name).add(n, **labels)
 
 
-def record(name, value, **labels):
+def record(name, value, exemplar=None, **labels):
+    """Histogram observation; ``exemplar`` (a trace id) rides along to
+    the worst-bucket exemplar slot so /metrics p99 spikes link to
+    /tracez?trace_id= (see reqtrace.py)."""
     if _enabled:
-        _REG.histogram(name).observe(value, **labels)
+        _REG.histogram(name).observe(value, exemplar=exemplar, **labels)
 
 
 def get_gauge(name, default=None, **labels):

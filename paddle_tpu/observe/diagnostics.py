@@ -18,8 +18,13 @@ started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
                KV-page occupancy, preemption/token counters), the
                static-verifier panel (programs verified, diagnostics
                by severity/pass — paddle_tpu.analysis), anomaly
-               state, flight-recorder occupancy, health results
-    /tracez    last N completed spans as JSON (?n=200)
+               state, the SLO panel (per-route objective, burn rate,
+               goodput, predicted p99, slowest sampled trace ids) and
+               fleet-router panel (replica readiness/queue depths,
+               dispatch/retry/shed counters), flight-recorder
+               occupancy, health results
+    /tracez    last N completed spans as JSON (?n=200), or ONE sampled
+               request's cross-thread timeline (?trace_id=<id>)
     /healthz   200 ok / 503 degraded from the liveness health checks
                plus the anomaly monitor (degraded while any detector
                is tripped)
@@ -224,6 +229,82 @@ def _analysis_status(snap):
             'verify_seconds': round(seconds, 6)}
 
 
+def _slo_status(snap):
+    """SLO panel (None when no slo.* metric exists): per-route
+    objective, burn rate, goodput, predicted p99, and the slowest
+    sampled trace ids — rendered from the registry's slo.* metrics so
+    the panel works against a live tracker OR a replayed snapshot."""
+    gauges = snap.get('gauges', {})
+    counters = snap.get('counters', {})
+    if not any(k.startswith('slo.') for k in list(gauges)
+               + list(counters)):
+        return None
+    routes = {}
+
+    def ent(route):
+        return routes.setdefault(route or '?', {
+            'latency_budget_s': None, 'availability_target': None,
+            'burn_rate': None, 'goodput_rps': None,
+            'predicted_p99_s': None, 'requests_total': 0,
+            'in_slo_total': 0, 'violations_total': 0, 'slowest': []})
+
+    gmap = {'slo.latency_budget_seconds': 'latency_budget_s',
+            'slo.availability_target': 'availability_target',
+            'slo.burn_rate': 'burn_rate',
+            'slo.goodput_rps': 'goodput_rps',
+            'slo.predicted_p99_seconds': 'predicted_p99_s'}
+    for rendered, v in gauges.items():
+        name, labels = parse_rendered(rendered)
+        if name in gmap:
+            ent(labels.get('route'))[gmap[name]] = v
+        elif name == 'slo.slowest_seconds':
+            ent(labels.get('route'))['slowest'].append(
+                {'seconds': v, 'trace_id': labels.get('trace_id')})
+    cmap = {'slo.requests_total': 'requests_total',
+            'slo.in_slo_total': 'in_slo_total',
+            'slo.violations_total': 'violations_total'}
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name in cmap:
+            ent(labels.get('route'))[cmap[name]] = v
+    for r in routes.values():
+        r['slowest'].sort(key=lambda s: -(s['seconds'] or 0.0))
+        del r['slowest'][5:]
+    return routes
+
+
+def _router_status(snap):
+    """Fleet-router panel (None when no router.* metric exists):
+    replica readiness + queue depths, dispatch/retry/shed counters."""
+    gauges = snap.get('gauges', {})
+    counters = snap.get('counters', {})
+    if not any(k.startswith('router.') for k in list(gauges)
+               + list(counters)):
+        return None
+    depths, dispatched, retries, shed = {}, {}, 0, {}
+    for rendered, v in gauges.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'router.replica_queue_depth':
+            depths[labels.get('replica', '?')] = v
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'router.dispatch_total':
+            dispatched[labels.get('replica', '?')] = v
+        elif name == 'router.retries_total':
+            retries += v
+        elif name == 'router.shed_total':
+            shed[labels.get('reason', '?')] = v
+    return {
+        'replicas_ready': gauges.get('router.replicas_ready'),
+        'replicas_total': gauges.get('router.replicas_total'),
+        'replica_queue_depth': depths,
+        'dispatch_total': dispatched,
+        'retries_total': retries,
+        'shed_total': shed,
+        'no_replica_total': counters.get('router.no_replica_total'),
+    }
+
+
 def _statusz_doc():
     from . import (anomaly_state, enabled, flight_dump_path,
                    flight_recorder, goodput, snapshot)
@@ -252,6 +333,8 @@ def _statusz_doc():
         'tuning': _tuning_status(snap),
         'decode': _decode_status(snap),
         'analysis': _analysis_status(snap),
+        'slo': _slo_status(snap),
+        'router': _router_status(snap),
         'anomalies': anomaly_state(),
         'flight': {'events': total, 'evicted': evicted,
                    'capacity': fr.capacity,
@@ -263,13 +346,23 @@ def _statusz_doc():
 
 def _tracez_doc(query):
     from . import spans
+    params = dict(p.split('=', 1) for p in query.split('&') if '=' in p)
     try:
-        n = int(dict(p.split('=', 1) for p in query.split('&')
-                     if '=' in p).get('n', 200))
+        n = int(params.get('n', 200))
     except Exception:
         n = 200
     rec = spans()
     evs = rec.events()
+    trace_id = params.get('trace_id')
+    if trace_id:
+        # one sampled request's full cross-thread timeline: every span,
+        # instant, and flow event whose args carry this trace id
+        # (reqtrace.RequestContext tags them all)
+        evs = [e for e in evs
+               if (e.get('args') or {}).get('trace_id') == trace_id]
+        return {'trace_id': trace_id, 'spans': evs,
+                'threads': sorted({e.get('tid') for e in evs}),
+                'recorded': len(evs)}
     return {'spans': evs[-max(1, n):], 'recorded': len(evs),
             'dropped': getattr(rec, '_dropped', 0)}
 

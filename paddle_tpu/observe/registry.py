@@ -103,8 +103,11 @@ def prometheus_exposition(snapshot):
     exposition (format 0.0.4). Counters and gauges map directly (metric
     names mangled to the legal charset: dots become underscores);
     histograms render as summaries — ``{quantile="0.5|0.9|0.95|0.99"}``
-    series from the reservoir plus exact ``_sum``/``_count``. Extra
-    snapshot keys (ts/pid/host/kind) are ignored."""
+    series from the reservoir plus exact ``_sum``/``_count``; a
+    histogram carrying a worst-bucket exemplar (trace id of the largest
+    observed sample) renders it OpenMetrics-style on the 0.99 quantile
+    line: ``... # {trace_id="<id>"} <value>``. Extra snapshot keys
+    (ts/pid/host/kind) are ignored."""
     lines = []
     for kind, prom_type in (('counters', 'counter'), ('gauges', 'gauge')):
         grouped = {}
@@ -131,6 +134,8 @@ def prometheus_exposition(snapshot):
         lines.append('# TYPE %s summary' % pn)
         for labels, st in sorted(grouped[name],
                                  key=lambda lv: sorted(lv[0].items())):
+            ex = st.get('exemplar') if isinstance(st.get('exemplar'),
+                                                  dict) else None
             for q, key in (('0.5', 'p50'), ('0.9', 'p90'),
                            ('0.95', 'p95'), ('0.99', 'p99')):
                 v = st.get(key)
@@ -138,8 +143,13 @@ def prometheus_exposition(snapshot):
                     continue
                 ql = dict(labels)
                 ql['quantile'] = q
-                lines.append('%s%s %s'
-                             % (pn, _prom_labels(ql), _prom_num(v)))
+                line = '%s%s %s' % (pn, _prom_labels(ql), _prom_num(v))
+                if q == '0.99' and ex is not None and \
+                        ex.get('trace_id') is not None:
+                    line += ' # %s %s' % (
+                        _prom_labels({'trace_id': ex['trace_id']}),
+                        _prom_num(ex.get('value') or 0.0))
+                lines.append(line)
             lines.append('%s_sum%s %s' % (pn, _prom_labels(labels),
                                           _prom_num(st.get('sum') or 0.0)))
             lines.append('%s_count%s %s'
@@ -203,7 +213,8 @@ class Gauge(_Metric):
 
 
 class _HistState(object):
-    __slots__ = ('count', 'total', 'min', 'max', 'samples', 'rng')
+    __slots__ = ('count', 'total', 'min', 'max', 'samples', 'rng',
+                 'exemplar')
 
     def __init__(self, seed):
         self.count = 0
@@ -212,8 +223,12 @@ class _HistState(object):
         self.max = None
         self.samples = []
         self.rng = random.Random(seed)
+        # worst-bucket exemplar: the trace id of the largest value ever
+        # observed WITH an exemplar — a p99 spike on /metrics links
+        # straight to the trace that caused it (/tracez?trace_id=)
+        self.exemplar = None
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         v = float(v)
         self.count += 1
         self.total += v
@@ -221,6 +236,9 @@ class _HistState(object):
             self.min = v
         if self.max is None or v > self.max:
             self.max = v
+        if exemplar is not None and (self.exemplar is None
+                                     or v >= self.exemplar['value']):
+            self.exemplar = {'value': v, 'trace_id': str(exemplar)}
         if len(self.samples) < RESERVOIR_CAP:
             self.samples.append(v)
         else:
@@ -236,6 +254,8 @@ class _HistState(object):
         for q, key in ((0.5, 'p50'), (0.9, 'p90'), (0.95, 'p95'),
                        (0.99, 'p99')):
             out[key] = s[min(len(s) - 1, int(q * len(s)))] if s else None
+        if self.exemplar is not None:
+            out['exemplar'] = dict(self.exemplar)
         return out
 
 
@@ -245,13 +265,13 @@ class Histogram(_Metric):
 
     kind = 'histogram'
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
         lk = _label_key(labels)
         with self._lock:
             st = self._values.get(lk)
             if st is None:
                 st = self._values[lk] = _HistState(hash((self.name, lk)))
-            st.observe(value)
+            st.observe(value, exemplar=exemplar)
 
     def stats(self, **labels):
         with self._lock:

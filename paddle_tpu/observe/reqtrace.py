@@ -1,0 +1,176 @@
+"""Per-request distributed tracing: one trace id across threads.
+
+A serving request crosses at least three threads — the client calls
+``submit()``, the batcher assembles it into a micro-batch, the
+dispatcher computes and un-pads it — and the thread-local span stack in
+``spans.py`` cannot say "this queue_wait, THAT compute" about any one
+request. ``RequestContext`` is the correlating handle:
+
+- created once at admission (``ServingEngine.submit`` /
+  ``DecodeEngine.submit`` / ``serving.router.Router.submit``) with a
+  fresh ``trace_id``, the route name, an optional absolute deadline,
+  and a sampling decision,
+- carried on the request object (``_Request.ctx`` /
+  ``Sequence.ctx``) across every thread hop,
+- each stage calls ``ctx.stage(name, t0, t1)`` on whatever thread
+  completed it — an explicit-interval span tagged ``trace_id`` on that
+  thread's track — plus ``ctx.event(name)`` for zero-duration marks
+  (per-token decode events),
+- thread hops are linked by Chrome-trace flow events (``ctx.flow_*``,
+  spans.FlowHandle) so Perfetto draws the arrows and
+  ``/tracez?trace_id=`` reassembles the timeline server-side.
+
+Sampling: ``PADDLE_TPU_TRACE_SAMPLE`` (a fraction, read PER CALL —
+never at import) decides whether a request records spans; unsampled
+requests pay one env read plus one random draw and carry a context
+whose recording methods are no-ops. Histogram exemplars close the
+loop: the engines pass ``ctx.exemplar()`` (trace id when sampled) into
+request-latency ``observe.record`` calls, so the worst sample on
+/metrics names the trace that caused it.
+"""
+
+import os
+import random
+import sys
+import threading
+import time
+
+__all__ = ['RequestContext', 'new_context', 'sample_rate',
+           'TRACE_SAMPLE_ENV']
+
+
+def _obs():
+    # the parent package, resolved at call time: ``observe.spans`` names
+    # both the submodule and the accessor function, so a from-import
+    # here would bind whichever happened to win at import order
+    return sys.modules['paddle_tpu.observe']
+
+
+def _enabled():
+    return _obs().enabled()
+
+
+def _spans_fn():
+    return _obs().spans()
+
+TRACE_SAMPLE_ENV = 'PADDLE_TPU_TRACE_SAMPLE'
+
+_rng = random.Random()
+_rng_lock = threading.Lock()
+
+
+def sample_rate(environ=None):
+    """The live trace-sampling fraction in [0, 1] — read from the
+    environment PER CALL (the repo_lint-enforced contract), default 0.
+    Malformed values read as 0 rather than raising mid-submit."""
+    env = os.environ if environ is None else environ
+    raw = env.get(TRACE_SAMPLE_ENV)
+    if not raw:
+        return 0.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 0.0
+
+
+def _new_trace_id():
+    # 48 bits: unique enough for any run's sampled set, and small
+    # enough that the int form (the Chrome-trace flow id) survives
+    # every JSON parser's float path exactly
+    with _rng_lock:
+        return '%012x' % _rng.getrandbits(48)
+
+
+def new_context(route, deadline_s=None, sample=None):
+    """Create the per-request context at admission. ``deadline_s`` is a
+    relative budget (seconds from now); ``sample`` overrides the
+    environment sampling fraction (pass 1.0/0.0 for deterministic
+    tests). A request is only ever sampled while telemetry is enabled —
+    spans would be dropped on the floor otherwise."""
+    rate = sample_rate() if sample is None else float(sample)
+    if rate >= 1.0:
+        sampled = True
+    elif rate <= 0.0:
+        sampled = False
+    else:
+        with _rng_lock:
+            sampled = _rng.random() < rate
+    sampled = bool(sampled and _enabled())
+    return RequestContext(
+        trace_id=_new_trace_id() if sampled else None,
+        route=route,
+        deadline=(time.perf_counter() + float(deadline_s))
+        if deadline_s is not None else None,
+        sampled=sampled)
+
+
+class RequestContext(object):
+    """Identity + budget + recording surface for one request."""
+
+    __slots__ = ('trace_id', 'route', 'deadline', 'sampled', 't_start',
+                 '_flow')
+
+    def __init__(self, trace_id, route, deadline, sampled):
+        self.trace_id = trace_id
+        self.route = route
+        self.deadline = deadline      # absolute perf_counter, or None
+        self.sampled = sampled
+        self.t_start = time.perf_counter()
+        self._flow = None
+
+    # ------------------------------------------------------------ budget
+    def remaining(self):
+        """Seconds of deadline budget left (None without a deadline;
+        negative once blown)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.perf_counter()
+
+    def expired(self):
+        return self.deadline is not None and \
+            time.perf_counter() > self.deadline
+
+    def exemplar(self):
+        """The trace id when sampled, else None — feed it straight to
+        ``observe.record(..., exemplar=ctx.exemplar())``."""
+        return self.trace_id if self.sampled else None
+
+    # --------------------------------------------------------- recording
+    def _attrs(self, extra=None):
+        a = {'trace_id': self.trace_id, 'route': self.route}
+        if extra:
+            a.update(extra)
+        return a
+
+    def stage(self, name, t0, t1, **attrs):
+        """Record one completed stage of this request's timeline
+        (explicit perf_counter bounds, calling thread's track)."""
+        if self.sampled:
+            _spans_fn().add_span(name, t0, t1, attrs=self._attrs(attrs))
+
+    def event(self, name, **attrs):
+        """Zero-duration mark on this request's timeline (per-token
+        decode events, shed/retry decisions)."""
+        if self.sampled:
+            _spans_fn().add_instant(name, attrs=self._attrs(attrs))
+
+    # ------------------------------------------------- cross-thread flow
+    def flow_begin(self, name):
+        """Start (or restart) this request's flow arrow on the calling
+        thread; the consumer thread's flow_step/flow_end links its
+        spans back to this point. Flow id = the trace id, so the raw
+        Perfetto JSON stays greppable by either."""
+        if not self.sampled:
+            return None
+        self._flow = _spans_fn().flow_begin(
+            name, attrs=self._attrs(), flow_id=int(self.trace_id, 16))
+        return self._flow
+
+    def flow_step(self, name=None):
+        if self.sampled and self._flow is not None:
+            _spans_fn().flow_step(self._flow, attrs=self._attrs())
+
+    def flow_end(self, name=None):
+        if self.sampled and self._flow is not None:
+            _spans_fn().flow_end(self._flow, attrs=self._attrs())
+            self._flow = None

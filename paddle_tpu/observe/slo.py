@@ -1,0 +1,307 @@
+"""SLO tracking: declared objectives, rolling error-budget burn rate,
+goodput, and the predicted p99 that drives SLO-aware admission.
+
+An objective declares, per route, what "good" means::
+
+    Objective(route='serve', latency_budget_s=0.5,
+              availability_target=0.99, window_s=60.0)
+
+A request is **in SLO** when it completed without error AND within the
+latency budget. Over a rolling window the tracker derives:
+
+- **burn rate** — the classic SRE ratio: observed bad fraction over
+  the error budget ``(1 - availability_target)``. 1.0 means the error
+  budget is being spent exactly as provisioned; 10 means ten times too
+  fast (alarm); 0 means nothing is being burned.
+- **goodput** — in-SLO completions per second over the window (the
+  ROADMAP's "goodput asserted through the observe pipeline").
+- **predicted p99** — the rolling window's own latency p99, the number
+  the router compares against a request's remaining deadline budget to
+  shed *before* queueing work it cannot serve in time.
+- **slowest sampled requests** — a top-K (latency, trace_id) ledger of
+  sampled requests, published as labeled gauges so an offline metrics
+  JSONL still names the traces worth reading
+  (``tools/metrics_report.py --slo``).
+
+Everything is published into the shared metrics registry under
+``slo.*`` (gauges re-set on every record; counters monotonic), so
+/metrics, /statusz, and the JSONL sink all see the same numbers with
+no extra plumbing. Pure stdlib, no jax, no import-time environment
+reads (tools/repo_lint.py enforces the latter for this module).
+"""
+
+import collections
+import sys
+import threading
+import time
+
+__all__ = ['Objective', 'SloTracker', 'DEFAULT_WINDOW_S']
+
+DEFAULT_WINDOW_S = 60.0
+SLOWEST_K = 5
+
+
+class Objective(object):
+    """Declared SLO for one route."""
+
+    __slots__ = ('route', 'latency_budget_s', 'availability_target',
+                 'window_s')
+
+    def __init__(self, route, latency_budget_s, availability_target=0.99,
+                 window_s=DEFAULT_WINDOW_S):
+        if not 0.0 < availability_target < 1.0:
+            raise ValueError('availability_target must be in (0, 1), '
+                             'got %r' % (availability_target,))
+        if latency_budget_s <= 0:
+            raise ValueError('latency_budget_s must be > 0')
+        self.route = str(route)
+        self.latency_budget_s = float(latency_budget_s)
+        self.availability_target = float(availability_target)
+        self.window_s = float(window_s)
+
+    @property
+    def error_budget(self):
+        return 1.0 - self.availability_target
+
+    def to_dict(self):
+        return {'route': self.route,
+                'latency_budget_s': self.latency_budget_s,
+                'availability_target': self.availability_target,
+                'window_s': self.window_s}
+
+
+class _RouteWindow(object):
+    """Rolling request window for one route: O(1) amortized record,
+    lazily re-sorted latencies for the p99 prediction."""
+
+    __slots__ = ('obj', 'events', 'total', 'bad', 'sorted_lat',
+                 'sorted_at', 'slowest')
+
+    def __init__(self, obj):
+        self.obj = obj
+        self.events = collections.deque()   # (t, latency_s, in_slo)
+        self.total = 0
+        self.bad = 0
+        self.sorted_lat = ()
+        self.sorted_at = -1.0
+        self.slowest = []                   # [(latency_s, trace_id)]
+
+    def evict(self, now):
+        horizon = now - self.obj.window_s
+        ev = self.events
+        while ev and ev[0][0] < horizon:
+            _, _, in_slo = ev.popleft()
+            self.total -= 1
+            if not in_slo:
+                self.bad -= 1
+
+    def record(self, now, latency_s, in_slo, trace_id):
+        self.evict(now)
+        self.events.append((now, latency_s, in_slo))
+        self.total += 1
+        if not in_slo:
+            self.bad += 1
+        if trace_id is not None:
+            self.slowest.append((latency_s, str(trace_id)))
+            if len(self.slowest) > 4 * SLOWEST_K:
+                self.slowest.sort(reverse=True)
+                del self.slowest[SLOWEST_K:]
+
+    def latencies(self, now):
+        """Window latencies, sorted; re-sorted at most every 0.25s so
+        per-submit admission checks stay cheap under load. An empty
+        cache refreshes immediately: reading an idle route (publish,
+        /statusz) must not blind predicted_p99 for the first 0.25s of
+        traffic that follows."""
+        if (now - self.sorted_at > 0.25
+                or (not self.sorted_lat and self.events)):
+            self.sorted_lat = tuple(sorted(e[1] for e in self.events))
+            self.sorted_at = now
+        return self.sorted_lat
+
+    def top_slowest(self):
+        self.slowest.sort(reverse=True)
+        del self.slowest[SLOWEST_K:]
+        return list(self.slowest)
+
+
+class SloTracker(object):
+    """Thread-safe SLO ledger over one or more route objectives.
+
+    ``record(route, latency_s, ok)`` classifies a completion, updates
+    the rolling window, and publishes the derived ``slo.*`` metrics;
+    ``burn_rate``/``goodput``/``predicted_p99`` answer admission and
+    assertion queries. Routes without a declared objective are
+    rejected loudly — an unmeasured route is a silent SLO hole.
+    """
+
+    def __init__(self, objectives, registry=None):
+        objs = list(objectives)
+        if not objs:
+            raise ValueError('SloTracker needs at least one Objective')
+        self._mu = threading.Lock()
+        self._routes = {}
+        for o in objs:
+            if o.route in self._routes:
+                raise ValueError('duplicate objective for route %r'
+                                 % o.route)
+            self._routes[o.route] = _RouteWindow(o)
+        self._registry = registry
+        self._publish_objectives()
+
+    # ------------------------------------------------------------ access
+    def objective(self, route):
+        return self._window(route).obj
+
+    def routes(self):
+        return sorted(self._routes)
+
+    def _window(self, route):
+        try:
+            return self._routes[route]
+        except KeyError:
+            raise KeyError('no SLO objective declared for route %r '
+                           '(declared: %s)' % (route, self.routes()))
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        # parent package resolved at call time (``observe.registry``
+        # names both the submodule and the accessor function)
+        obs = sys.modules['paddle_tpu.observe']
+        return obs.registry() if obs.enabled() else None
+
+    # ------------------------------------------------------------ record
+    def record(self, route, latency_s, ok=True, trace_id=None, now=None):
+        """Classify one completed request. Returns True when it was in
+        SLO (ok AND within the latency budget)."""
+        now = time.perf_counter() if now is None else now
+        with self._mu:
+            w = self._window(route)
+            in_slo = bool(ok) and latency_s <= w.obj.latency_budget_s
+            w.record(now, float(latency_s), in_slo, trace_id)
+            burn = self._burn_rate_locked(w)
+            goodput = self._goodput_locked(w, now)
+        reg = self._reg()
+        if reg is not None:
+            reg.counter('slo.requests_total').inc(route=route)
+            reg.counter('slo.in_slo_total' if in_slo
+                        else 'slo.violations_total').inc(route=route)
+            reg.gauge('slo.burn_rate').set(burn, route=route)
+            reg.gauge('slo.goodput_rps').set(goodput, route=route)
+            reg.gauge('slo.error_budget_remaining').set(
+                max(0.0, 1.0 - burn), route=route)
+            p99 = self.predicted_p99(route, now)
+            if p99 is not None:
+                reg.gauge('slo.predicted_p99_seconds').set(p99,
+                                                           route=route)
+            if trace_id is not None:
+                with self._mu:
+                    top = self._routes[route].top_slowest()
+                for lat, tid in top:
+                    reg.gauge('slo.slowest_seconds').set(
+                        lat, route=route, trace_id=tid)
+        return in_slo
+
+    # ----------------------------------------------------------- derived
+    def _burn_rate_locked(self, w):
+        if not w.total:
+            return 0.0
+        return (w.bad / float(w.total)) / w.obj.error_budget
+
+    def _goodput_locked(self, w, now):
+        w.evict(now)
+        good = w.total - w.bad
+        span = min(w.obj.window_s,
+                   max(1e-9, now - w.events[0][0]) if w.events else 1e-9)
+        return good / span if w.events else 0.0
+
+    def burn_rate(self, route, now=None):
+        """Error-budget burn multiplier over the rolling window (1.0 =
+        burning exactly the provisioned budget)."""
+        now = time.perf_counter() if now is None else now
+        with self._mu:
+            w = self._window(route)
+            w.evict(now)
+            return self._burn_rate_locked(w)
+
+    def goodput(self, route, now=None):
+        """In-SLO completions per second over the rolling window."""
+        now = time.perf_counter() if now is None else now
+        with self._mu:
+            return self._goodput_locked(self._window(route), now)
+
+    def predicted_p99(self, route, now=None):
+        """The rolling window's latency p99 (None with an empty
+        window) — the router's crystal ball for admission."""
+        now = time.perf_counter() if now is None else now
+        with self._mu:
+            w = self._window(route)
+            w.evict(now)
+            lat = w.latencies(now)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    def window_counts(self, route, now=None):
+        """(total, bad) currently inside the window."""
+        now = time.perf_counter() if now is None else now
+        with self._mu:
+            w = self._window(route)
+            w.evict(now)
+            return w.total, w.bad
+
+    def slowest(self, route):
+        """Top-K slowest sampled (latency_s, trace_id) pairs."""
+        with self._mu:
+            return self._window(route).top_slowest()
+
+    # ------------------------------------------------------------ export
+    def _publish_objectives(self):
+        reg = self._reg()
+        if reg is None:
+            return
+        for route, w in self._routes.items():
+            o = w.obj
+            reg.gauge('slo.latency_budget_seconds').set(
+                o.latency_budget_s, route=route)
+            reg.gauge('slo.availability_target').set(
+                o.availability_target, route=route)
+            reg.gauge('slo.window_seconds').set(o.window_s, route=route)
+
+    def publish(self):
+        """Re-publish every derived gauge now (objectives included) —
+        call before a final snapshot so an idle route still exports its
+        last-known state."""
+        self._publish_objectives()
+        reg = self._reg()
+        if reg is None:
+            return
+        now = time.perf_counter()
+        for route in self.routes():
+            reg.gauge('slo.burn_rate').set(self.burn_rate(route, now),
+                                           route=route)
+            reg.gauge('slo.goodput_rps').set(self.goodput(route, now),
+                                             route=route)
+            p99 = self.predicted_p99(route, now)
+            if p99 is not None:
+                reg.gauge('slo.predicted_p99_seconds').set(p99,
+                                                           route=route)
+
+    def status(self):
+        """JSON-ready per-route panel for /statusz."""
+        now = time.perf_counter()
+        out = {}
+        for route in self.routes():
+            total, bad = self.window_counts(route, now)
+            out[route] = {
+                'objective': self.objective(route).to_dict(),
+                'window_requests': total,
+                'window_bad': bad,
+                'burn_rate': round(self.burn_rate(route, now), 4),
+                'goodput_rps': round(self.goodput(route, now), 3),
+                'predicted_p99_s': self.predicted_p99(route, now),
+                'slowest': [{'seconds': s, 'trace_id': t}
+                            for s, t in self.slowest(route)],
+            }
+        return out

@@ -12,6 +12,18 @@ also enters ``jax.profiler.TraceAnnotation(name)``, so the SAME span
 names show up inside an XLA device trace captured with
 ``profiler.start_profiler(trace_dir=...)`` — host intervals and device
 ops line up by name in one Perfetto view.
+
+Cross-thread parenting: the thread-local ``begin``/``end`` stack can
+only nest spans on ONE thread. A request that crosses threads (serving
+submit → batcher → dispatcher, any producer→consumer handoff) links its
+spans with Chrome-trace *flow events* instead: the producer calls
+``flow_begin(name)`` and hands the returned ``FlowHandle`` to the
+consumer, who calls ``flow_step``/``flow_end`` on *its* thread — Perfetto
+draws an arrow between the enclosing slices. ``add_span`` records a
+completed interval with explicit perf_counter timestamps (no stack), so
+a stage measured on thread A but *observed* finishing on thread B still
+lands on the observing thread's track with exact bounds, and
+``add_instant`` records zero-duration marks (per-token events).
 """
 
 import json
@@ -20,7 +32,7 @@ import sys
 import threading
 import time
 
-__all__ = ['SpanRecorder', 'MAX_EVENTS']
+__all__ = ['SpanRecorder', 'FlowHandle', 'MAX_EVENTS']
 
 # bound memory in unbounded runs: keep the first MAX_EVENTS spans and
 # count the rest (dropped count is recorded in the export metadata)
@@ -37,6 +49,20 @@ class _Span(object):
         self.ann = None
 
 
+class FlowHandle(object):
+    """Ticket for one producer→consumer handoff arrow. Created by
+    ``SpanRecorder.flow_begin`` on the producer thread; any number of
+    ``flow_step`` calls and one ``flow_end`` may follow from OTHER
+    threads — the events share ``flow_id`` so Perfetto links the
+    enclosing slices across tracks."""
+
+    __slots__ = ('flow_id', 'name')
+
+    def __init__(self, flow_id, name):
+        self.flow_id = flow_id
+        self.name = name
+
+
 class SpanRecorder(object):
     def __init__(self):
         self._lock = threading.Lock()
@@ -51,6 +77,7 @@ class SpanRecorder(object):
         # anchored to an epoch timestamp so ts is meaningful across
         # threads and aligns with the jax trace clock reasonably well
         self._epoch0 = time.time() - time.perf_counter()
+        self._flow_ids = 0
 
     # ---------------------------------------------------------- record
     def begin(self, name, attrs=None, bridge_jax=True):
@@ -91,6 +118,9 @@ class SpanRecorder(object):
               'dur': (t1 - top.t0) * 1e6}
         if top.attrs:
             ev['args'] = top.attrs
+        self._append(ev)
+
+    def _append(self, ev):
         with self._lock:
             if len(self._events) < MAX_EVENTS:
                 self._events.append(ev)
@@ -106,6 +136,65 @@ class SpanRecorder(object):
 
     def depth(self):
         return len(getattr(self._tls, 'stack', ()) or ())
+
+    # ------------------------------------------- explicit-interval spans
+    def add_span(self, name, t0, t1, attrs=None, tid=None):
+        """Record a completed span with explicit ``time.perf_counter()``
+        bounds — no thread-local stack, no jax bridge. The span lands on
+        the calling thread's track (or ``tid``), so a stage whose start
+        was clocked on another thread (e.g. a request's queue wait,
+        started at submit() but observed ending in the batcher) still
+        renders with exact bounds."""
+        ev = {'name': name, 'ph': 'X', 'pid': os.getpid(),
+              'tid': threading.get_ident() if tid is None else tid,
+              'ts': (self._epoch0 + t0) * 1e6,
+              'dur': max(0.0, t1 - t0) * 1e6}
+        if attrs:
+            ev['args'] = dict(attrs)
+        self._append(ev)
+
+    def add_instant(self, name, attrs=None):
+        """Record a zero-duration mark on the calling thread (scope
+        't'): per-token decode events, admission decisions, kills."""
+        ev = {'name': name, 'ph': 'i', 's': 't', 'pid': os.getpid(),
+              'tid': threading.get_ident(),
+              'ts': (self._epoch0 + time.perf_counter()) * 1e6}
+        if attrs:
+            ev['args'] = dict(attrs)
+        self._append(ev)
+
+    # ------------------------------------------------ cross-thread flows
+    def flow_begin(self, name, attrs=None, flow_id=None):
+        """Start a flow arrow on the calling thread; returns the
+        FlowHandle the consumer thread passes to flow_step/flow_end.
+        ``flow_id`` defaults to a recorder-unique integer (pass a
+        trace id to make the arrow greppable in the raw JSON)."""
+        with self._lock:
+            if flow_id is None:
+                self._flow_ids += 1
+                flow_id = self._flow_ids
+        h = FlowHandle(flow_id, name)
+        self._flow_event('s', h, attrs)
+        return h
+
+    def flow_step(self, handle, attrs=None):
+        """Mark the flow passing through the calling thread."""
+        self._flow_event('t', handle, attrs)
+
+    def flow_end(self, handle, attrs=None):
+        """Terminate the flow on the calling thread."""
+        self._flow_event('f', handle, attrs, bind_enclosing=True)
+
+    def _flow_event(self, ph, handle, attrs, bind_enclosing=False):
+        ev = {'name': handle.name, 'cat': 'flow', 'ph': ph,
+              'id': handle.flow_id, 'pid': os.getpid(),
+              'tid': threading.get_ident(),
+              'ts': (self._epoch0 + time.perf_counter()) * 1e6}
+        if bind_enclosing:
+            ev['bp'] = 'e'   # bind the arrowhead to the enclosing slice
+        if attrs:
+            ev['args'] = dict(attrs)
+        self._append(ev)
 
     # ---------------------------------------------------------- export
     def events(self):

@@ -36,6 +36,7 @@ import time
 import numpy as np
 
 from ... import observe as _obs
+from ...observe import reqtrace as _reqtrace
 from ...core.executor import Executor
 from ...core.place import TPUPlace
 from ...core.scope import Scope, scope_guard
@@ -138,11 +139,15 @@ class DecodeEngine(object):
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-               seed=0, eos_id=None):
+               seed=0, eos_id=None, ctx=None, deadline_s=None):
         """Enqueue one generation request; returns a GenerationStream.
         Raises QueueFullError past max_queue_depth, EngineClosedError
         after shutdown, ValueError for prompts the page budget can
-        never hold."""
+        never hold. ``ctx`` carries an upstream trace context; when
+        absent one is created here (route 'decode', sampling per
+        PADDLE_TPU_TRACE_SAMPLE) — sampled requests record queue-wait/
+        prefill spans plus a per-token event timeline."""
+        t_sub0 = time.perf_counter()
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         max_new = int(max_new_tokens)
         if not prompt:
@@ -177,12 +182,19 @@ class DecodeEngine(object):
                 raise QueueFullError(
                     'decode queue full (%d waiting >= max_queue_depth='
                     '%d)' % (waiting, self.max_queue_depth))
+            if ctx is None:
+                ctx = _reqtrace.new_context('decode',
+                                            deadline_s=deadline_s)
             seq = Sequence(next(self._ids), prompt, max_new, temperature,
-                           seed, eos_id)
+                           seed, eos_id, ctx=ctx)
             with self._done_cv:
                 self._unfinished += 1
             self._sched.add(seq)
             self._mu.notify_all()
+        if ctx.sampled:
+            ctx.stage('submit', t_sub0, time.perf_counter(),
+                      prompt_tokens=len(prompt))
+            ctx.flow_begin('decode_request')
         _obs.inc('decode.requests_total')
         return seq.stream
 
@@ -352,7 +364,13 @@ class DecodeEngine(object):
             if seq is None:
                 return
             _obs.record('decode.queue_seconds',
-                        seq.t_admit - seq.t_submit)
+                        seq.t_admit - seq.t_submit,
+                        exemplar=seq.ctx.exemplar() if seq.ctx
+                        else None)
+            if seq.ctx is not None and seq.ctx.sampled:
+                # began on the submit thread, ends here on the worker
+                seq.ctx.stage('queue_wait', seq.t_submit, seq.t_admit)
+                seq.ctx.flow_step()
             self._prefill(seq)
 
     # ----------------------------------------------------------- dispatch
@@ -400,9 +418,12 @@ class DecodeEngine(object):
         t0 = time.perf_counter()
         tok = self._run_prefill(ids, s, self._table_row(seq)[None, :],
                                 seq.temperature, seq.seed)
-        _obs.record('decode.prefill_seconds', time.perf_counter() - t0,
-                    bucket=bucket)
+        t1 = time.perf_counter()
+        _obs.record('decode.prefill_seconds', t1 - t0, bucket=bucket)
         _obs.inc('decode.prefills_total')
+        if seq.ctx is not None and seq.ctx.sampled:
+            seq.ctx.stage('prefill', t0, t1, bucket=bucket,
+                          prefix_tokens=s)
         seq.cache_len = s
         self._emit(seq, tok, time.perf_counter())
         reason = seq.finished()
@@ -451,11 +472,20 @@ class DecodeEngine(object):
         seq.t_last_token = now
         seq.stream._put(token)
         seq.streamed += 1
+        if seq.ctx is not None and seq.ctx.sampled:
+            # the per-token timeline: one instant mark per generated
+            # token, so a sampled trace shows decode cadence directly
+            seq.ctx.event('token', pos=len(seq.generated))
         _obs.inc('decode.tokens_total')
 
     def _finish(self, seq, reason):
         self._sched.finish(seq, reason)
         _obs.record('decode.request_seconds',
-                    time.perf_counter() - seq.t_submit)
+                    time.perf_counter() - seq.t_submit,
+                    exemplar=seq.ctx.exemplar() if seq.ctx else None)
         _obs.record('decode.request_tokens', len(seq.generated))
+        if seq.ctx is not None and seq.ctx.sampled:
+            seq.ctx.event('finish', reason=reason,
+                          tokens=len(seq.generated))
+            seq.ctx.flow_end()
         self._request_done()

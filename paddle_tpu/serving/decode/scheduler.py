@@ -99,10 +99,11 @@ class Sequence(object):
     __slots__ = ('request_id', 'prompt', 'max_new_tokens', 'temperature',
                  'seed', 'eos_id', 'table', 'generated', 'streamed',
                  'state', 'stream', 'cache_len', 'pending_token',
-                 't_submit', 't_admit', 't_last_token', 'preemptions')
+                 't_submit', 't_admit', 't_last_token', 'preemptions',
+                 'ctx')
 
     def __init__(self, request_id, prompt, max_new_tokens, temperature,
-                 seed, eos_id):
+                 seed, eos_id, ctx=None):
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -120,6 +121,7 @@ class Sequence(object):
         self.t_admit = None
         self.t_last_token = None
         self.preemptions = 0
+        self.ctx = ctx      # reqtrace.RequestContext (trace correlation)
 
     def prefix(self):
         """Tokens whose KV must exist before the next decode step —
@@ -221,6 +223,8 @@ class Scheduler(object):
         _obs.flight_event('decode_preempt', request_id=seq.request_id,
                           generated=len(seq.generated),
                           freed_blocks=self.pool.free_blocks())
+        if seq.ctx is not None:
+            seq.ctx.event('preempt', generated=len(seq.generated))
         self._publish()
 
     # ----------------------------------------------------------- finish

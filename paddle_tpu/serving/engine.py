@@ -36,6 +36,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import observe as _obs
+from ..observe import reqtrace as _reqtrace
 from .buckets import BucketLadder
 
 __all__ = ['ServingEngine', 'QueueFullError', 'EngineClosedError']
@@ -54,14 +55,16 @@ class EngineClosedError(RuntimeError):
 
 
 class _Request(object):
-    __slots__ = ('feed', 'rows', 'future', 't_submit', 't_batched')
+    __slots__ = ('feed', 'rows', 'future', 't_submit', 't_batched',
+                 'ctx')
 
-    def __init__(self, feed, rows):
+    def __init__(self, feed, rows, ctx=None):
         self.feed = feed
         self.rows = rows
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.t_batched = None
+        self.ctx = ctx      # reqtrace.RequestContext (trace correlation)
 
 
 class ServingEngine(object):
@@ -85,8 +88,11 @@ class ServingEngine(object):
     def __init__(self, predictor, max_batch_size=8, batch_timeout_ms=2.0,
                  max_queue_depth=64, ladder=None, seq_axes=None,
                  seq_lens=None, pad='edge', mask_feed=None,
-                 fetch_seq_axes=None, dispatch_depth=2):
+                 fetch_seq_axes=None, dispatch_depth=2, name=None):
         self._predictor = predictor
+        # replica identity: the router's dispatch labels, health-check
+        # names, and trace route tags all key on this
+        self.name = str(name) if name else 'engine%d' % next(_ENGINE_IDS)
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
         self.max_queue_depth = int(max_queue_depth)
@@ -144,14 +150,29 @@ class ServingEngine(object):
             self._ladder.bucket_seq(self._ladder._seq_len_of(feed))
         return rows
 
-    def submit(self, feed):
+    def queue_depth(self):
+        """Requests admitted but not yet batched — the router's
+        least-loaded signal (same number as the serving.queue_depth
+        gauge, readable without the registry)."""
+        with self._mu:
+            return len(self._pending)
+
+    def submit(self, feed, ctx=None, deadline_s=None):
         """Enqueue one request ({name: array} with a leading batch
         axis, <= max_batch_size rows). Returns a Future resolving to
         the list of fetch arrays for exactly those rows. Raises
         QueueFullError past max_queue_depth and EngineClosedError after
-        shutdown; malformed feeds raise ValueError synchronously."""
+        shutdown; malformed feeds raise ValueError synchronously.
+
+        ``ctx`` (a reqtrace.RequestContext) carries an upstream trace —
+        the router passes its own so one trace id spans the whole hop
+        chain; when absent a fresh context is created here (sampling
+        per PADDLE_TPU_TRACE_SAMPLE, deadline from ``deadline_s``)."""
+        t_sub0 = time.perf_counter()
         rows = self._validate(feed)
-        req = _Request(feed, rows)
+        if ctx is None:
+            ctx = _reqtrace.new_context(self.name, deadline_s=deadline_s)
+        req = _Request(feed, rows, ctx)
         # count the request BEFORE it becomes visible to the batcher —
         # otherwise a fast resolve could decrement past a drain()'s
         # notion of zero while this submit is still in flight
@@ -177,6 +198,12 @@ class ServingEngine(object):
         except BaseException:
             self._request_done()
             raise
+        if ctx.sampled:
+            # the client thread's own slice of the timeline (validate +
+            # enqueue) and the flow arrow the batcher/dispatcher link to
+            ctx.stage('submit', t_sub0, time.perf_counter(),
+                      engine=self.name, rows=rows)
+            ctx.flow_begin('request')
         _obs.inc('serving.requests_total')
         return req.future
 
@@ -223,7 +250,7 @@ class ServingEngine(object):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
-        self._health_name = 'serving.engine%d' % next(_ENGINE_IDS)
+        self._health_name = 'serving.%s' % self.name
         _obs.register_health_check(self._health_name, self._ready_check,
                                    readiness_only=True)
         return self
@@ -416,7 +443,13 @@ class ServingEngine(object):
             # that reached RUNNING can no longer be cancelled
             if r.future.set_running_or_notify_cancel():
                 r.t_batched = now
-                _obs.record('serving.queue_seconds', now - r.t_submit)
+                _obs.record('serving.queue_seconds', now - r.t_submit,
+                            exemplar=r.ctx.exemplar() if r.ctx else None)
+                if r.ctx is not None and r.ctx.sampled:
+                    # queue_wait started on the client thread but ends
+                    # here: explicit bounds, batcher thread's track
+                    r.ctx.stage('queue_wait', r.t_submit, now)
+                    r.ctx.flow_step()
                 live.append(r)
             else:
                 self._request_done()
@@ -435,6 +468,11 @@ class ServingEngine(object):
                 r.future.set_exception(e)
                 self._request_done()
             return
+        t_asm = time.perf_counter()
+        for r in live:
+            if r.ctx is not None and r.ctx.sampled:
+                r.ctx.stage('batch_assemble', now, t_asm,
+                            batch_rows=info.total)
         _obs.inc('serving.batches_total')
         _obs.record('serving.batch_size', info.total)
         _obs.record('serving.padding_waste', info.waste())
@@ -449,11 +487,13 @@ class ServingEngine(object):
             t0 = time.perf_counter()
             for r in batch:
                 _obs.record('serving.batch_seconds', t0 - r.t_batched)
+                if r.ctx is not None and r.ctx.sampled:
+                    r.ctx.stage('dispatch', r.t_batched, t0)
             try:
                 with self._predict_mu:
                     fetches = self._predictor.predict(padded)
-                _obs.record('serving.compute_seconds',
-                            time.perf_counter() - t0,
+                t_comp = time.perf_counter()
+                _obs.record('serving.compute_seconds', t_comp - t0,
                             bucket=info.batch_bucket)
                 results = self._ladder.disassemble(fetches, info,
                                                    self._fetch_seq_axes)
@@ -461,12 +501,23 @@ class ServingEngine(object):
                 for r, outs in zip(batch, results):
                     r.future.set_result(outs)
                     _obs.record('serving.request_seconds',
-                                now - r.t_submit)
+                                now - r.t_submit,
+                                exemplar=r.ctx.exemplar() if r.ctx
+                                else None)
+                    if r.ctx is not None and r.ctx.sampled:
+                        r.ctx.stage('compute', t0, t_comp,
+                                    bucket=info.batch_bucket)
+                        r.ctx.stage('unpad', t_comp, now)
+                        r.ctx.flow_end()
                     self._request_done()
             except BaseException as e:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
+                        if r.ctx is not None:
+                            r.ctx.event('request_error',
+                                        error=type(e).__name__)
+                            r.ctx.flow_end()
                         self._request_done()
                 _obs.inc('serving.batch_errors_total')
 

@@ -1,0 +1,302 @@
+"""Multi-replica serving router: least-loaded + session-affinity
+dispatch, retry-on-replica-down, and SLO-aware admission.
+
+One ``ServingEngine`` is a single replica; this router fronts N of
+them (any objects with ``submit(feed, ctx=)``, ``ready()``,
+``queue_depth()`` and a ``name`` — the decode engine's facade fits the
+same shape for token workloads) and makes the fleet behave like one
+endpoint:
+
+- **placement** — requests go to the *ready* replica with the
+  shallowest admission queue (each engine's ``ready()`` +
+  ``queue_depth()``, the same numbers its /readyz check and
+  ``serving.queue_depth`` gauge export). A ``session`` key pins a
+  client to a preferred replica (consistent hash) while it stays
+  ready — cache/affinity wins without giving up failover.
+- **failover** — a replica that dies mid-request fails that request
+  with ``EngineClosedError``; the router catches exactly that (it
+  means "replica gone", never "bad request") and resubmits to another
+  replica, up to ``retries`` times. A replica that is full at submit
+  time is skipped for the next-least-loaded one. Accepted requests
+  therefore either complete or fail with a typed error — never hang.
+- **SLO-aware admission** — with an ``observe.slo.SloTracker``
+  attached, each submit compares the route's rolling predicted p99
+  against the request's remaining deadline budget (or the route's
+  latency budget): when the fleet is predicted to blow the budget the
+  router *sheds* (``SLOShedError``, a ``QueueFullError`` subclass so
+  existing backpressure handling just works) or *degrades* (admits
+  but tags the request context) instead of queueing doomed work —
+  replacing the blunt per-replica ``QueueFullError`` with a policy
+  that looks at measured behavior.
+
+Every decision is observable: ``router.*`` counters/gauges (dispatch
+per replica, retries, sheds by reason, replicas ready), flight events
+for failover and shedding, and per-request trace events on sampled
+``RequestContext``s. No environment reads at import time
+(tools/repo_lint.py enforces this module).
+"""
+
+import itertools
+import threading
+import time
+import zlib
+
+from concurrent.futures import Future
+
+from .. import observe as _obs
+from ..observe import reqtrace as _reqtrace
+from .engine import EngineClosedError, QueueFullError
+
+__all__ = ['Router', 'NoReplicaAvailableError', 'SLOShedError']
+
+_ROUTER_IDS = itertools.count(1)
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every replica is down or not ready — the fleet cannot accept
+    this request at all (distinct from QueueFullError: full is
+    transient backpressure, this is an availability incident)."""
+
+
+class SLOShedError(QueueFullError):
+    """Admission control shed this request: the route's predicted p99
+    exceeds its remaining latency budget. A QueueFullError subclass so
+    callers' existing reject/backoff handling applies unchanged."""
+
+
+class Router(object):
+    """Least-loaded / session-affinity dispatch over N serving
+    replicas.
+
+    ::
+
+        replicas = [ServingEngine(pred_i, name='replica%d' % i)
+                    for i, pred_i in enumerate(preds)]
+        tracker = SloTracker([Objective('serve', latency_budget_s=0.5)])
+        router = Router(replicas, slo=tracker, route='serve')
+        fut = router.submit({'x': batch}, session='user-42')
+        outs = router.predict({'x': batch})
+        router.close()        # unregisters health; replicas are yours
+
+    ``admission``: 'slo' sheds/degrades on predicted-p99 breach (needs
+    ``slo``), 'none' skips the check. ``on_breach``: 'shed' raises
+    SLOShedError, 'degrade' admits but tags the request context and
+    counts it. The router owns no threads; completion hooks run on the
+    replicas' dispatcher threads.
+    """
+
+    def __init__(self, replicas, slo=None, route='serve',
+                 session_affinity=True, retries=2, admission=None,
+                 on_breach='shed'):
+        reps = list(replicas)
+        if not reps:
+            raise ValueError('Router needs at least one replica')
+        names = [getattr(r, 'name', None) or 'replica%d' % i
+                 for i, r in enumerate(reps)]
+        if len(set(names)) != len(names):
+            raise ValueError('replica names must be unique, got %s'
+                             % names)
+        self._replicas = list(zip(names, reps))
+        self.route = str(route)
+        self._slo = slo
+        if admission is None:
+            admission = 'slo' if slo is not None else 'none'
+        if admission == 'slo' and slo is None:
+            raise ValueError("admission='slo' needs an SloTracker")
+        if on_breach not in ('shed', 'degrade'):
+            raise ValueError("on_breach must be 'shed' or 'degrade'")
+        self.admission = admission
+        self.on_breach = on_breach
+        self.session_affinity = bool(session_affinity)
+        self.retries = int(retries)
+        self._mu = threading.Lock()
+        self._rr = itertools.count()    # tiebreak for equal depths
+        self._health_name = 'serving.router%d' % next(_ROUTER_IDS)
+        _obs.register_health_check(self._health_name, self._ready_check,
+                                   readiness_only=True)
+        _obs.set_gauge('router.replicas_total', len(reps))
+
+    # --------------------------------------------------------- lifecycle
+    def ready(self):
+        """True while at least one replica is ready — the fleet-level
+        /readyz signal."""
+        return any(r.ready() for _, r in self._replicas)
+
+    def _ready_check(self):
+        n = sum(1 for _, r in self._replicas if r.ready())
+        if n:
+            return True, '%d/%d replicas ready' % (n,
+                                                   len(self._replicas))
+        return False, '0/%d replicas ready' % len(self._replicas)
+
+    def close(self, shutdown_replicas=False, drain=True):
+        """Unregister the router's health check; optionally shut every
+        replica down too."""
+        _obs.unregister_health_check(self._health_name)
+        if shutdown_replicas:
+            for _, r in self._replicas:
+                r.shutdown(drain=drain)
+
+    def replicas(self):
+        """[(name, replica)] — live view for tests and tooling."""
+        return list(self._replicas)
+
+    # --------------------------------------------------------- placement
+    def _publish_fleet(self):
+        ready = 0
+        for name, r in self._replicas:
+            ok = r.ready()
+            ready += bool(ok)
+            _obs.set_gauge('router.replica_queue_depth',
+                           r.queue_depth() if ok else -1, replica=name)
+        _obs.set_gauge('router.replicas_ready', ready)
+
+    def _candidates(self, session=None, exclude=()):
+        """Ready replicas in dispatch-preference order: the session's
+        pinned replica first (when affine and ready), then ascending
+        queue depth."""
+        avail = [(name, r) for name, r in self._replicas
+                 if name not in exclude and r.ready()]
+        ranked = sorted(avail,
+                        key=lambda nr: (nr[1].queue_depth(),
+                                        next(self._rr)))
+        if session is not None and self.session_affinity and \
+                self._replicas:
+            pin = self._replicas[
+                zlib.crc32(str(session).encode()) % len(self._replicas)]
+            if pin in ranked:
+                ranked.remove(pin)
+                ranked.insert(0, pin)
+        return ranked
+
+    # --------------------------------------------------------- admission
+    def _admission_check(self, ctx):
+        """Shed or degrade when the route's predicted p99 exceeds the
+        request's remaining budget. Returns True when the request was
+        degraded (admitted past a predicted breach)."""
+        if self.admission != 'slo':
+            return False
+        p99 = self._slo.predicted_p99(self.route)
+        if p99 is None:
+            return False
+        remaining = ctx.remaining()
+        budget = remaining if remaining is not None else \
+            self._slo.objective(self.route).latency_budget_s
+        if p99 <= budget:
+            return False
+        if self.on_breach == 'degrade':
+            _obs.inc('router.degraded_total', route=self.route)
+            ctx.event('degraded', predicted_p99=p99, budget=budget)
+            return True
+        _obs.inc('router.shed_total', reason='predicted_p99',
+                 route=self.route)
+        _obs.flight_event('router_shed', route=self.route,
+                          predicted_p99=round(p99, 6),
+                          budget=round(budget, 6))
+        ctx.event('shed', predicted_p99=p99, budget=budget)
+        raise SLOShedError(
+            'admission shed: predicted p99 %.4fs exceeds remaining '
+            'budget %.4fs on route %r' % (p99, budget, self.route))
+
+    # ----------------------------------------------------------- intake
+    def submit(self, feed, session=None, deadline_s=None, ctx=None):
+        """Route one request to the fleet; returns a Future. Raises
+        SLOShedError (admission), QueueFullError (every ready replica
+        full), NoReplicaAvailableError (no ready replica); after
+        acceptance the future resolves with the result or a typed
+        error — a replica dying mid-request triggers transparent
+        resubmission up to ``retries`` times first."""
+        if ctx is None:
+            ctx = _reqtrace.new_context(self.route,
+                                        deadline_s=deadline_s)
+        _obs.inc('router.requests_total', route=self.route)
+        self._admission_check(ctx)
+        outer = Future()
+        self._dispatch(feed, session, ctx, outer, tried=(),
+                       attempts_left=self.retries)
+        self._publish_fleet()
+        return outer
+
+    def predict(self, feed, session=None, deadline_s=None, timeout=None):
+        """submit() + wait."""
+        return self.submit(feed, session=session,
+                           deadline_s=deadline_s).result(timeout)
+
+    def _dispatch(self, feed, session, ctx, outer, tried, attempts_left):
+        last_full = None
+        for name, replica in self._candidates(session, exclude=tried):
+            try:
+                inner = replica.submit(feed, ctx=ctx)
+            except QueueFullError as e:
+                last_full = e
+                continue
+            except EngineClosedError:
+                continue   # lost the race with a shutdown: next replica
+            _obs.inc('router.dispatch_total', replica=name,
+                     route=self.route)
+            ctx.event('routed', replica=name)
+            inner.add_done_callback(
+                lambda f, name=name: self._on_done(
+                    f, name, feed, session, ctx, outer, tried + (name,),
+                    attempts_left))
+            return
+        # nothing accepted it: full everywhere vs nothing ready
+        if last_full is not None:
+            _obs.inc('router.shed_total', reason='queue_full',
+                     route=self.route)
+            raise last_full
+        _obs.inc('router.no_replica_total', route=self.route)
+        _obs.flight_event('router_no_replica', route=self.route)
+        raise NoReplicaAvailableError(
+            'no ready replica (fleet of %d) for route %r'
+            % (len(self._replicas), self.route))
+
+    def _on_done(self, inner, name, feed, session, ctx, outer, tried,
+                 attempts_left):
+        try:
+            result = inner.result()
+        except EngineClosedError as e:
+            # the replica died under this request — the ONE failure
+            # class where retrying elsewhere is always safe (the
+            # request never computed)
+            _obs.inc('router.failover_total', replica=name,
+                     route=self.route)
+            _obs.flight_event('router_failover', replica=name,
+                              route=self.route,
+                              attempts_left=attempts_left)
+            ctx.event('failover', replica=name)
+            if attempts_left > 0:
+                try:
+                    self._dispatch(feed, session, ctx, outer,
+                                   tried=tried,
+                                   attempts_left=attempts_left - 1)
+                except NoReplicaAvailableError:
+                    # nowhere left to go: the request died with its
+                    # replica — surface THAT, not the fleet census
+                    self._finish(outer, ctx, exc=e)
+                except Exception as redispatch_exc:
+                    self._finish(outer, ctx, exc=redispatch_exc)
+                return
+            self._finish(outer, ctx, exc=e)
+        except BaseException as e:
+            self._finish(outer, ctx, exc=e)
+        else:
+            self._finish(outer, ctx, result=result)
+
+    def _finish(self, outer, ctx, result=None, exc=None):
+        latency = time.perf_counter() - ctx.t_start
+        ok = exc is None
+        _obs.record('router.request_seconds', latency,
+                    exemplar=ctx.exemplar(), route=self.route)
+        if self._slo is not None:
+            self._slo.record(self.route, latency, ok=ok,
+                             trace_id=ctx.exemplar())
+        try:
+            if ok:
+                outer.set_result(result)
+            else:
+                _obs.inc('router.request_errors_total',
+                         error=type(exc).__name__, route=self.route)
+                outer.set_exception(exc)
+        except Exception:
+            pass   # client cancelled the outer future: result dropped

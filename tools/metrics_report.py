@@ -13,8 +13,10 @@ end-of-run state); ``--all-pids`` reports the newest summary per pid,
 ``--per-host`` per host (merged multihost JSONLs — records carry a
 ``host`` = jax.process_index() field), ``--snapshot`` takes the newest
 line of any kind. ``--json`` emits one machine-readable object for
-scripting, and ``--prom`` converts the chosen record to Prometheus
-text exposition (drop it in a node_exporter textfile-collector dir and
+scripting, ``--slo`` renders the SLO panel (per-route objectives,
+error-budget burn rate, goodput, and the top-5 slowest sampled trace
+ids — each one a ``/tracez?trace_id=`` timeline), and ``--prom``
+converts the chosen record to Prometheus text exposition (drop it in a node_exporter textfile-collector dir and
 offline runs feed the same dashboards as live ``/metrics`` scrapes) —
 fast tests exercise all three paths so this tool cannot bit-rot.
 
@@ -108,6 +110,80 @@ def _fmt_val(v):
     return str(v)
 
 
+# ------------------------------------------------------------ SLO view
+def derive_slo(rec):
+    """Per-route SLO panel from one record's slo.* metrics: declared
+    objective, burn rate, goodput, predicted p99, and the top-5
+    slowest sampled trace ids (slo.slowest_seconds{route,trace_id}
+    gauges — each names a /tracez?trace_id= timeline)."""
+    parse = _registry_mod().parse_rendered
+    routes = {}
+
+    def ent(route):
+        return routes.setdefault(route or '?', {
+            'latency_budget_s': None, 'availability_target': None,
+            'window_s': None, 'burn_rate': None, 'goodput_rps': None,
+            'predicted_p99_s': None, 'requests_total': 0,
+            'in_slo_total': 0, 'violations_total': 0, 'slowest': []})
+
+    gmap = {'slo.latency_budget_seconds': 'latency_budget_s',
+            'slo.availability_target': 'availability_target',
+            'slo.window_seconds': 'window_s',
+            'slo.burn_rate': 'burn_rate',
+            'slo.goodput_rps': 'goodput_rps',
+            'slo.predicted_p99_seconds': 'predicted_p99_s'}
+    for rendered, v in rec.get('gauges', {}).items():
+        name, labels = parse(rendered)
+        if name in gmap:
+            ent(labels.get('route'))[gmap[name]] = v
+        elif name == 'slo.slowest_seconds':
+            ent(labels.get('route'))['slowest'].append(
+                {'seconds': v, 'trace_id': labels.get('trace_id')})
+    cmap = {'slo.requests_total': 'requests_total',
+            'slo.in_slo_total': 'in_slo_total',
+            'slo.violations_total': 'violations_total'}
+    for rendered, v in rec.get('counters', {}).items():
+        name, labels = parse(rendered)
+        if name in cmap:
+            ent(labels.get('route'))[cmap[name]] = v
+    for r in routes.values():
+        r['slowest'].sort(key=lambda s: -(s['seconds'] or 0.0))
+        del r['slowest'][5:]
+    return {'ts': rec.get('ts'), 'pid': rec.get('pid'),
+            'host': rec.get('host', 0), 'routes': routes}
+
+
+def render_slo(rec):
+    doc = derive_slo(rec)
+    lines = []
+    if not doc['routes']:
+        return 'no slo.* metrics in this record'
+    for route in sorted(doc['routes']):
+        r = doc['routes'][route]
+        obj = 'objective: p(lat <= %ss) >= %s over %ss window' % (
+            _fmt_val(r['latency_budget_s'] or 0.0),
+            _fmt_val(r['availability_target'] or 0.0),
+            _fmt_val(r['window_s'] or 0.0))
+        lines.append('== route %r — %s' % (route, obj))
+        lines.append('   burn rate %s   goodput %s rps   '
+                     'predicted p99 %s s'
+                     % (_fmt_val(r['burn_rate'] or 0.0),
+                        _fmt_val(r['goodput_rps'] or 0.0),
+                        _fmt_val(r['predicted_p99_s'])
+                        if r['predicted_p99_s'] is not None else '?'))
+        lines.append('   requests %d   in-SLO %d   violations %d'
+                     % (r['requests_total'], r['in_slo_total'],
+                        r['violations_total']))
+        if r['slowest']:
+            lines.append('   slowest sampled requests:')
+            for s in r['slowest']:
+                lines.append('     %10.6fs  trace_id=%s  '
+                             '(/tracez?trace_id=%s)'
+                             % (s['seconds'], s['trace_id'],
+                                s['trace_id']))
+    return '\n'.join(lines)
+
+
 def render(rec):
     lines = []
     d = derive(rec)
@@ -166,9 +242,17 @@ def main(argv=None):
     p.add_argument('--prom', action='store_true',
                    help='emit the chosen record(s) as Prometheus text '
                         'exposition (textfile-collector format)')
+    p.add_argument('--slo', action='store_true',
+                   help='render the SLO panel: per-route objectives, '
+                        'burn rate, goodput, and the top-5 slowest '
+                        'sampled trace ids')
     args = p.parse_args(argv)
     if args.json and args.prom:
         sys.stderr.write('metrics_report: --json and --prom are '
+                         'mutually exclusive\n')
+        return 2
+    if args.slo and args.prom:
+        sys.stderr.write('metrics_report: --slo and --prom are '
                          'mutually exclusive\n')
         return 2
 
@@ -190,7 +274,13 @@ def main(argv=None):
         chosen = [pick(records, any_kind=args.snapshot)]
 
     try:
-        if args.json:
+        if args.slo:
+            if args.json:
+                docs = [derive_slo(r) for r in chosen]
+                print(json.dumps(docs[0] if len(docs) == 1 else docs))
+            else:
+                print('\n\n'.join(render_slo(r) for r in chosen))
+        elif args.json:
             docs = [derive(r) for r in chosen]
             print(json.dumps(docs[0] if len(docs) == 1 else docs))
         elif args.prom:

@@ -76,6 +76,10 @@ QUEUE = [
     # (PR 6); inter-token percentiles + decode.* metrics land in the
     # shared metrics JSONL
     ('decode_transformer', 'decode_transformer', None, 700),
+    # fleet chaos scenario (ISSUE 10): 3-replica router under flash
+    # crowd + replica kill; slo.*/router.* burn-rate/goodput metrics
+    # land in the shared metrics JSONL (metrics_report.py --slo)
+    ('fleet', 'fleet', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),

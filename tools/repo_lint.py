@@ -3,13 +3,14 @@ this codebase — run as a tier-1 test (tests/test_repo_lint.py).
 
 Rules:
 
-- ``import-time-env`` (paddle_tpu/ops/ and paddle_tpu/tuning/ only):
-  no ``os.environ`` / ``os.getenv`` / ``get_flag`` / ``FLAGS`` reads
-  at module import time — including class bodies, decorators, and
-  function DEFAULT argument expressions (all evaluate at import). An
-  env knob frozen at import cannot be flipped per call or per test;
-  this is the exact class PR 8 fixed by hand in flash_attention /
-  batch_norm (PADDLE_TPU_PALLAS_BLOCK_K read once, forever).
+- ``import-time-env`` (paddle_tpu/ops/, paddle_tpu/tuning/, and the
+  ENV_SCOPED_FILES serving/observe modules): no ``os.environ`` /
+  ``os.getenv`` / ``get_flag`` / ``FLAGS`` reads at module import
+  time — including class bodies, decorators, and function DEFAULT
+  argument expressions (all evaluate at import). An env knob frozen
+  at import cannot be flipped per call or per test; this is the exact
+  class PR 8 fixed by hand in flash_attention / batch_norm
+  (PADDLE_TPU_PALLAS_BLOCK_K read once, forever).
 - ``bare-except`` (paddle_tpu/ everywhere): ``except:`` swallows
   KeyboardInterrupt/SystemExit — name the exception.
 - ``mutable-default`` (paddle_tpu/ everywhere): list/dict/set literals
@@ -32,6 +33,12 @@ import sys
 # banned. ops/ and tuning/ lowerings run inside jit-compiled dispatch:
 # a knob read at import silently pins the process to its boot-time env.
 ENV_SCOPED_DIRS = ('paddle_tpu/ops', 'paddle_tpu/tuning')
+# Individual modules under the same ban: long-lived serving-path code
+# whose knobs (trace sampling, admission policy) must stay flippable
+# per call/per test — the exact class PR 8 fixed in ops/ by hand.
+ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
+                    'paddle_tpu/observe/slo.py',
+                    'paddle_tpu/observe/reqtrace.py')
 LINT_ROOT = 'paddle_tpu'
 
 _ENV_ATTRS = ('environ', 'getenv')
@@ -144,6 +151,8 @@ def lint_tree(root):
     violations = []
     scoped = tuple(os.path.join(root, d.replace('/', os.sep)) + os.sep
                    for d in ENV_SCOPED_DIRS)
+    scoped_files = frozenset(os.path.join(root, f.replace('/', os.sep))
+                             for f in ENV_SCOPED_FILES)
     top = os.path.join(root, LINT_ROOT)
     for dirpath, dirnames, filenames in os.walk(top):
         dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
@@ -151,7 +160,7 @@ def lint_tree(root):
             if not fname.endswith('.py'):
                 continue
             path = os.path.join(dirpath, fname)
-            env_scoped = path.startswith(scoped)
+            env_scoped = path.startswith(scoped) or path in scoped_files
             try:
                 with open(path, encoding='utf-8') as f:
                     source = f.read()

@@ -84,6 +84,10 @@ def main(argv=None):
                    help='closed-loop concurrent clients')
     p.add_argument('--qps', type=float, default=200.0,
                    help='open-loop offered request rate')
+    p.add_argument('--qps-schedule', default=None,
+                   help="open-loop time-varying rate: 't:qps' "
+                        "breakpoints, e.g. '0:50,2:500,4:50' (step-"
+                        'hold; overrides --qps)')
     p.add_argument('--max-batch-size', type=int, default=8)
     p.add_argument('--batch-timeout-ms', type=float, default=2.0)
     p.add_argument('--max-queue-depth', type=int, default=64)
@@ -147,13 +151,24 @@ def main(argv=None):
     warmup_s = time.perf_counter() - t_w0
     engine.start()
 
+    qps = args.qps
+    if args.qps_schedule:
+        try:
+            qps = [(float(t), float(q)) for t, q in
+                   (part.split(':', 1)
+                    for part in args.qps_schedule.split(','))]
+        except ValueError:
+            raise SystemExit("serving_bench: --qps-schedule wants "
+                             "'t:qps,t:qps,...', got %r"
+                             % args.qps_schedule)
+
     stats = Stats()
     t0 = time.perf_counter()
     deadline = t0 + args.duration
     if args.mode == 'closed':
         _closed_loop(engine, make_feed, stats, deadline, args.clients)
     else:
-        _open_loop(engine, make_feed, stats, deadline, args.qps)
+        _open_loop(engine, make_feed, stats, deadline, qps)
     engine.shutdown(drain=True)
     wall = time.perf_counter() - t0
 
@@ -171,6 +186,9 @@ def main(argv=None):
         'duration_s': round(wall, 4),
         'clients': args.clients if args.mode == 'closed' else None,
         'offered_qps': args.qps if args.mode == 'open' else None,
+        'qps_schedule': args.qps_schedule
+        if args.mode == 'open' else None,
+        'rejects_timeline': [round(t, 3) for t in stats.reject_times],
         'requests_ok': stats.ok,
         'requests_rejected': stats.rejected,
         'requests_errored': stats.errors,
