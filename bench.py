@@ -532,6 +532,44 @@ def bench_decode(duration=8.0, clients=8, max_batch=16, block_size=32,
     }
 
 
+class _ChaosPredictor(object):
+    """Duck-typed predictor with a fixed per-batch compute floor: the
+    overload arithmetic (offered rows/s vs replica capacity) stops
+    depending on how fast THIS machine's tiny MLP runs, so chaos
+    windows burn error budget by construction. Shared by the fleet and
+    autoscale chaos workloads."""
+
+    def __init__(self, inner, delay_s):
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+    def predict(self, feed):
+        out = self._inner.predict(feed)
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        return out
+
+
+def _save_chaos_model(in_dim):
+    """Save the tiny MLP the chaos scenarios serve; returns its dir."""
+    import tempfile
+    fluid = _fresh()
+    model_dir = os.path.join(tempfile.mkdtemp(prefix='fleet_bench_'),
+                             'model')
+    x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
+    h = fluid.layers.fc(input=x, size=16, act='relu')
+    out = fluid.layers.fc(input=h, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(model_dir, ['x'], [out], exe)
+    fluid.reset_default_programs()
+    fluid.global_scope().clear()
+    return model_dir
+
+
 def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
                 spike_qps=700.0, spike_at=2.0, spike_s=1.5, kill_at=2.4,
                 latency_budget_s=0.025, availability=0.95, window_s=1.5,
@@ -546,7 +584,6 @@ def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
     shed windows), readiness flips, and the sampled-trace census.
     slo.*/router.* metrics land in the metrics JSONL beside the
     results store; tools/metrics_report.py --slo renders them."""
-    import tempfile
     import threading
 
     from paddle_tpu import observe
@@ -558,38 +595,8 @@ def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
                                             heavy_tailed_rows, open_loop,
                                             percentiles)
 
-    fluid = _fresh()
-    model_dir = os.path.join(tempfile.mkdtemp(prefix='fleet_bench_'),
-                             'model')
-    x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
-    h = fluid.layers.fc(input=x, size=16, act='relu')
-    out = fluid.layers.fc(input=h, size=4, act='softmax')
-    exe = fluid.Executor(fluid.CPUPlace())
-    exe.run(fluid.default_startup_program())
-    fluid.io.save_inference_model(model_dir, ['x'], [out], exe)
-    fluid.reset_default_programs()
-    fluid.global_scope().clear()
-
+    model_dir = _save_chaos_model(in_dim)
     from paddle_tpu.inference import create_predictor
-
-    class _ChaosPredictor(object):
-        """Duck-typed predictor with a fixed per-batch compute floor:
-        the overload arithmetic (offered rows/s vs replica capacity)
-        stops depending on how fast THIS machine's tiny MLP runs, so
-        the kill window burns error budget by construction."""
-
-        def __init__(self, inner, delay_s):
-            self._inner = inner
-            self._delay_s = delay_s
-
-        def __getattr__(self, attr):
-            return getattr(self._inner, attr)
-
-        def predict(self, feed):
-            out = self._inner.predict(feed)
-            if self._delay_s:
-                time.sleep(self._delay_s)
-            return out
 
     delay_s = float(compute_delay_ms) / 1000.0
     engines = [ServingEngine(_ChaosPredictor(create_predictor(model_dir),
@@ -741,6 +748,294 @@ def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
                 'availability_target': availability,
                 'window_s': window_s},
         'warmup_s': round(warmup_s, 3),
+    }
+
+
+def bench_autoscale(in_dim=8, max_batch=8, max_queue_depth=12,
+                    compute_delay_ms=10.0, latency_budget_s=0.05,
+                    availability=0.95, window_s=1.5,
+                    flash_duration=4.0, flash_steady_qps=30.0,
+                    flash_spike_qps=500.0, flash_spike_at=1.2,
+                    crash_duration=4.0, crash_qps=40.0, crash_kills=4,
+                    crash_interval_s=0.45, crash_first_kill_at=0.6,
+                    trough_duration=4.0, trough_high_qps=40.0,
+                    trough_low_qps=4.0, trough_drop_at=1.0,
+                    retry_budget=0.1, retry_budget_burst=20.0,
+                    trace_sample=0.05):
+    """Self-healing autoscaling chaos suite (ISSUE 11): three scenarios
+    through one FleetController + hedging Router, each measured (the
+    test asserts):
+
+    1. **flash crowd** — offered load jumps ~15x; the controller must
+       scale out (AOT-warm spawns) before the error budget burns
+       through: burn spikes >1x then recovers <1x within the run,
+       with zero accepted-request loss.
+    2. **crash loop** — one replica slot is killed repeatedly
+       (fault.inject.crash_loop); the circuit breaker must quarantine
+       the flapping lineage (flight event + counter) and goodput must
+       recover on the survivors.
+    3. **diurnal trough** — load drops ~10x; the controller must scale
+       in by drain-then-shutdown with zero accepted-request loss and
+       zero errors.
+
+    Hedged requests run throughout: the returned ``hedge`` ledger
+    proves retry traffic (hedges + failovers) stayed inside the token
+    budget ``retry_budget x accepted + burst`` and that no hedge ever
+    produced a result differing from its primary
+    (``router.hedge_mismatch_total == 0``). Periodic JSONL snapshots
+    (observe.flush) make the scale timeline reconstructable by
+    ``tools/metrics_report.py --fleet``."""
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.fault import inject
+    from paddle_tpu.observe.slo import Objective, SloTracker
+    from paddle_tpu.serving import (FleetController,
+                                    NoReplicaAvailableError, Router,
+                                    ServingEngine)
+    from paddle_tpu.serving.loadgen import (Stats, flash_crowd,
+                                            open_loop, percentiles)
+
+    model_dir = _save_chaos_model(in_dim)
+    from paddle_tpu.inference import create_predictor
+
+    delay_s = float(compute_delay_ms) / 1000.0
+    aot_dir = os.path.join(os.path.dirname(model_dir), 'aot_cache')
+
+    def make_engine(name):
+        """The ReplicaFactory: a fresh predictor over the shared AOT
+        executable cache, so every spawn after the first warm-starts
+        from serialized executables instead of compiling."""
+        pred = _ChaosPredictor(create_predictor(model_dir), delay_s)
+        return ServingEngine(pred, max_batch_size=max_batch,
+                             batch_timeout_ms=1.0,
+                             max_queue_depth=max_queue_depth,
+                             name=name)
+
+    def counter_sum(snap, prefix):
+        return sum(v for k, v in snap['counters'].items()
+                   if k.startswith(prefix))
+
+    def run_scenario(tag, qps_spec, duration, n_start, ctl_kw,
+                     chaos=None, deadline_s=None):
+        """One scenario: fresh fleet + controller, open-loop load,
+        sampler thread (burn/goodput/census timeline + periodic JSONL
+        snapshots), optional chaos thread. Returns the measured dict
+        (counter values are per-scenario deltas)."""
+        snap0 = observe.snapshot()
+        engines = []
+        t_w0 = time.perf_counter()
+        for i in range(n_start):
+            eng = make_engine('%s%d' % (tag, i))
+            eng.warmup()
+            eng.start()
+            engines.append(eng)
+        warmup_s = time.perf_counter() - t_w0
+        tracker = SloTracker([Objective(tag, latency_budget_s,
+                                        availability_target=availability,
+                                        window_s=window_s)])
+        router = Router(engines, slo=tracker, route=tag, retries=3,
+                        hedge=True, retry_budget=retry_budget,
+                        retry_budget_burst=retry_budget_burst)
+        ctl = FleetController(router, make_engine, slo=tracker,
+                              route=tag, name_prefix='%s-auto' % tag,
+                              **ctl_kw)
+        ctl.start()
+
+        stats = Stats()
+        submitted = [0]
+        no_replica = [0]
+
+        def submit_request(rng):
+            rows = int(rng.randint(1, max(2, max_batch // 2)))
+            feed = {'x': rng.rand(rows, in_dim).astype('float32')}
+            try:
+                fut = router.submit(feed,
+                                    session=int(rng.randint(0, 64)),
+                                    deadline_s=deadline_s)
+            except NoReplicaAvailableError:
+                no_replica[0] += 1
+                return None
+            submitted[0] += 1
+            return fut, rows
+
+        burn_timeline, census_timeline = [], []
+        goodput_timeline = []
+        t0 = time.perf_counter()
+        stop = threading.Event()
+
+        def sampler():
+            last_flush = 0.0
+            while not stop.wait(0.05):
+                now = time.perf_counter()
+                t = round(now - t0, 3)
+                burn_timeline.append(
+                    (t, tracker.burn_rate(tag, now)))
+                goodput_timeline.append(
+                    (t, tracker.goodput(tag, now)))
+                census_timeline.append((t, ctl.census()))
+                if now - last_flush >= 0.25:
+                    last_flush = now
+                    observe.flush(kind='snapshot')
+
+        threads = [threading.Thread(target=sampler, daemon=True)]
+        chaos_result = {}
+        if chaos is not None:
+            threads.append(threading.Thread(
+                target=lambda: chaos_result.update(chaos(ctl, t0)),
+                daemon=True))
+        for t in threads:
+            t.start()
+        open_loop(submit_request, stats, t0 + duration, qps_spec)
+        ctl.close()                    # stop ticking before teardown
+        for name, rep in router.replicas():
+            rep.shutdown(drain=True)
+        t_end = time.perf_counter() + 15.0
+        while stats.ok + stats.errors < submitted[0] and \
+                time.perf_counter() < t_end:
+            time.sleep(0.01)
+        stop.set()
+        wall = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=10)
+        ctl.close(shutdown_replicas=True)
+        router.close()
+        tracker.publish()
+        observe.flush(kind='snapshot')
+
+        snap1 = observe.snapshot()
+        delta = lambda prefix: (counter_sum(snap1, prefix)  # noqa: E731
+                                - counter_sum(snap0, prefix))
+        accepted = submitted[0]
+        completed = stats.ok + stats.errors
+        # end-of-LOAD burn (samples past `duration` are teardown decay
+        # and would flatter the recovery claim)
+        tail = [b for t, b in burn_timeline
+                if 0.85 * duration <= t <= duration]
+        peak_census = {}
+        for _, c in census_timeline:
+            for k, v in c.items():
+                peak_census[k] = max(peak_census.get(k, 0), v)
+        return dict({
+            'scenario': tag,
+            'duration_s': round(wall, 3),
+            'accepted': accepted,
+            'completed': completed,
+            'lost': accepted - completed,
+            'requests_ok': stats.ok,
+            'requests_rejected': stats.rejected,
+            'requests_errored': stats.errors,
+            'no_replica': no_replica[0],
+            'latency_ms': percentiles(stats.latencies),
+            'warmup_s': round(warmup_s, 3),
+            'burn_peak': round(max([b for _, b in burn_timeline]
+                                   or [0.0]), 4),
+            'burn_end': round(min(tail) if tail else 0.0, 4),
+            'burn_timeline': burn_timeline,
+            'goodput_end_rps': round(
+                sum(g for _, g in goodput_timeline[-6:])
+                / max(1, len(goodput_timeline[-6:])), 2),
+            'census_timeline': census_timeline[::4],
+            'census_peak': peak_census,
+            'scale_outs': delta('controller.scale_out_total'),
+            'scale_ins': delta('controller.scale_in_total'),
+            'heals': delta('controller.heals_total'),
+            'deaths': delta('controller.deaths_total'),
+            'quarantines': delta('controller.quarantines_total'),
+            'spawn_failures':
+                delta('controller.spawn_failures_total'),
+            'drain_timeouts': delta('controller.drain_timeouts_total'),
+            'dispatches': delta('router.dispatch_total'),
+            'hedges': delta('router.hedge_total'),
+            'hedge_mismatches': delta('router.hedge_mismatch_total'),
+            'failovers': delta('router.failover_total'),
+        }, **chaos_result)
+
+    prev = {k: os.environ.get(k) for k in
+            ('PADDLE_TPU_TRACE_SAMPLE', 'PADDLE_TPU_AOT_CACHE',
+             'PADDLE_TPU_AOT_CACHE_DIR')}
+    os.environ['PADDLE_TPU_TRACE_SAMPLE'] = str(trace_sample)
+    # spawns ride the AOT executable cache: the first warmup populates
+    # it, every later spawn (the scale-up path) deserializes
+    os.environ['PADDLE_TPU_AOT_CACHE'] = '1'
+    os.environ['PADDLE_TPU_AOT_CACHE_DIR'] = aot_dir
+    try:
+        # 1 — flash crowd: must scale out before the budget burns away
+        flash = run_scenario(
+            'flash',
+            flash_crowd(flash_steady_qps, flash_spike_qps,
+                        flash_spike_at,
+                        flash_duration - flash_spike_at),
+            flash_duration, n_start=2,
+            ctl_kw=dict(min_replicas=2, max_replicas=6,
+                        interval_s=0.1, burn_high=1.0, queue_high=3.0,
+                        scale_out_cooldown_s=0.35, trough_s=1e9,
+                        scale_step=2),
+            deadline_s=latency_budget_s)
+
+        # 2 — crash loop: repeated kills of ONE slot must quarantine
+        def crash_chaos(ctl, t0):
+            wait = crash_first_kill_at - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            # the lineage-aware resolver: every kill lands on whatever
+            # replacement the controller spawned for slot 'crash2'
+            kills = inject.crash_loop(
+                lambda: ctl.current('crash2'),
+                kills=crash_kills, interval_s=crash_interval_s)
+            return {'kills_performed': kills}
+
+        crash = run_scenario(
+            'crash', crash_qps, crash_duration, n_start=3,
+            ctl_kw=dict(min_replicas=2, max_replicas=4,
+                        interval_s=0.1, backoff_base_s=0.05,
+                        backoff_max_s=0.4, crash_loop_threshold=2,
+                        crash_window_s=10.0, quarantine_s=60.0,
+                        trough_s=1e9, scale_out_cooldown_s=1e9),
+            chaos=crash_chaos)
+
+        # 3 — diurnal trough: scale-in drains with zero request loss
+        trough = run_scenario(
+            'trough',
+            [(0.0, trough_high_qps), (trough_drop_at, trough_low_qps)],
+            trough_duration, n_start=4,
+            ctl_kw=dict(min_replicas=2, max_replicas=4,
+                        interval_s=0.1, burn_low=0.5, queue_low=1.5,
+                        trough_s=0.6, scale_in_cooldown_s=0.5,
+                        scale_out_cooldown_s=1e9, queue_high=1e9,
+                        burn_high=1e9))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # the hedging contract across all three scenarios: retry traffic
+    # (every dispatch past each request's primary) never exceeded the
+    # token budget, and no hedge disagreed with its primary
+    accepted = sum(s['accepted'] for s in (flash, crash, trough))
+    retry_dispatches = sum(s['dispatches'] - s['accepted']
+                           for s in (flash, crash, trough))
+    bound = retry_budget * accepted + 3 * retry_budget_burst
+    return {
+        'workload': 'autoscale',
+        'flash_crowd': flash,
+        'crash_loop': crash,
+        'trough': trough,
+        'hedge': {
+            'accepted': accepted,
+            'hedges': sum(s['hedges'] for s in (flash, crash, trough)),
+            'failovers': sum(s['failovers']
+                             for s in (flash, crash, trough)),
+            'retry_dispatches': retry_dispatches,
+            'retry_budget': retry_budget,
+            'retry_budget_burst': retry_budget_burst,
+            'bound': round(bound, 2),
+            'within_budget': retry_dispatches <= bound,
+            'mismatches': sum(s['hedge_mismatches']
+                              for s in (flash, crash, trough)),
+        },
     }
 
 
@@ -1209,6 +1504,12 @@ def _run_workload_child(workload, backend, reduced):
                   spike_at=1.0, spike_s=1.0, kill_at=1.2,
                   window_s=1.0, max_queue_depth=8) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_fleet(**kw)),
+              flush=True)
+        return
+    if workload == 'autoscale':
+        kw = dict(flash_duration=3.0, crash_duration=3.5,
+                  trough_duration=3.5, window_s=1.0) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_autoscale(**kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':

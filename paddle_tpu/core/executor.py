@@ -360,6 +360,34 @@ class Executor(object):
                 self._cache[key] = compiled
         return compiled, True
 
+    @staticmethod
+    def _donation_safe(loaded):
+        """Wrap a DESERIALIZED executable so its donation cannot
+        corrupt live state. jax-level donated-buffer bookkeeping does
+        not fully survive serialize/deserialize: the executable's
+        baked-in input/output aliasing still writes outputs (and
+        scratch) into the donated input buffers, but the caller-side
+        deleted-array marking that normally fences those buffers off
+        is not re-established — so a buffer the scope (or another
+        in-flight key) still references gets silently overwritten.
+        Observed as replica-weight corruption under concurrent serving
+        with PADDLE_TPU_AOT_CACHE=1; the fleet router's hedge
+        bit-identity check (router.hedge_mismatch_total) is what
+        caught it. Handing the executable a private copy of the
+        donated scope argument makes its in-place writes land in
+        memory nothing else references; the aliased outputs the
+        executor writes back to the scope then own those buffers
+        outright. Costs one params-sized device copy per dispatch on
+        warm keys only — correctness over the last ounce of warm-path
+        throughput."""
+        import jax.numpy as jnp
+
+        def call(scope_vals, *rest):
+            scope_vals = {k: jnp.array(v, copy=True)
+                          for k, v in scope_vals.items()}
+            return loaded(scope_vals, *rest)
+        return call
+
     def _try_warm_start(self, kind, key, fp, compile_fn):
         """Install a disk-cached executable for this key, or None. The
         Python lowering walk (compile_fn) still runs — it supplies the
@@ -376,7 +404,7 @@ class Executor(object):
                     self.aot_stats['load_failures'] += 1
             return None
         compiled = compile_fn()
-        compiled.fn = loaded
+        compiled.fn = self._donation_safe(loaded)
         compiled.aot_fp = fp
         compiled.aot_state = 'warm'
         # the cost probe would compile — the one thing a warm start

@@ -38,7 +38,8 @@ import os
 
 __all__ = ['KILL_EXIT_CODE', 'FaultPlan', 'TransientReaderError',
            'install', 'install_from_env', 'clear', 'active', 'fire',
-           'truncate_file', 'poison_nans', 'flaky', 'kill_replica']
+           'truncate_file', 'poison_nans', 'flaky', 'kill_replica',
+           'crash_loop']
 
 KILL_EXIT_CODE = 42
 _ENV_KILL = 'PADDLE_TPU_FI_KILL_AT_STEP'
@@ -168,6 +169,45 @@ def kill_replica(engine, drain=False):
         except Exception:
             pass
     return engine
+
+
+def crash_loop(engine, kills, interval_s):
+    """Chaos action for the self-healing fleet: kill the same replica
+    SLOT repeatedly — the scenario that must trip the fleet
+    controller's crash-loop circuit breaker (quarantine) instead of
+    thrashing it with doomed restarts.
+
+    ``engine`` is either a live engine (killed once; later iterations
+    find nothing new to kill) or, the interesting form, a zero-arg
+    callable returning the slot's CURRENT live engine or None —
+    ``lambda: controller.current('replica2')`` aims every kill at
+    whatever replacement the controller just spawned. Each iteration
+    waits ``interval_s`` (so heals can land in between), resolves the
+    target, and ``kill_replica``s it with a ``crash_loop_kill`` flight
+    event. Returns the number of kills actually performed (a
+    quarantined slot stops producing victims — fewer kills than asked
+    is the breaker WORKING)."""
+    import time as _time
+    resolve = engine if callable(engine) else (lambda: engine)
+    killed = 0
+    last = None
+    for i in range(int(kills)):
+        if i:
+            _time.sleep(float(interval_s))
+        victim = resolve()
+        if victim is None or victim is last and not victim.ready():
+            continue                 # slot is down/benched: no victim
+        try:
+            from .. import observe as _obs
+            _obs.flight_event('crash_loop_kill', iteration=i,
+                              replica=str(getattr(victim, 'name',
+                                                  '?')))
+        except Exception:
+            pass
+        kill_replica(victim, drain=False)
+        last = victim
+        killed += 1
+    return killed
 
 
 def truncate_file(path, keep_fraction=0.5):

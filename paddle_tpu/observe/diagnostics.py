@@ -294,6 +294,10 @@ def _router_status(snap):
             retries += v
         elif name == 'router.shed_total':
             shed[labels.get('reason', '?')] = v
+    hedges = sum(v for k, v in counters.items()
+                 if parse_rendered(k)[0] == 'router.hedge_total')
+    requests = sum(v for k, v in counters.items()
+                   if parse_rendered(k)[0] == 'router.requests_total')
     return {
         'replicas_ready': gauges.get('router.replicas_ready'),
         'replicas_total': gauges.get('router.replicas_total'),
@@ -302,6 +306,56 @@ def _router_status(snap):
         'retries_total': retries,
         'shed_total': shed,
         'no_replica_total': counters.get('router.no_replica_total'),
+        'hedge_total': hedges,
+        'hedge_fraction': round(hedges / requests, 6) if requests
+        else None,
+        'retry_budget_tokens':
+            gauges.get('router.retry_budget_tokens'),
+    }
+
+
+_FLEET_STATE_NAMES = {0: 'UP', 1: 'DRAINING', 2: 'QUARANTINED',
+                      3: 'DEAD'}
+
+
+def _fleet_status(snap):
+    """Fleet-controller panel (None when no controller.* metric
+    exists): per-replica state machine (UP/DRAINING/QUARANTINED/DEAD
+    from the controller.replica_state gauge codes), the census by
+    state, and the scale/heal/quarantine counters — works against a
+    live controller OR a replayed snapshot."""
+    gauges = snap.get('gauges', {})
+    counters = snap.get('counters', {})
+    if not any(k.startswith('controller.') for k in list(gauges)
+               + list(counters)):
+        return None
+    replicas, census = {}, {}
+    ready = None
+    for rendered, v in gauges.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'controller.replica_state':
+            replicas[labels.get('replica', '?')] = \
+                _FLEET_STATE_NAMES.get(int(v), '?')
+        elif name == 'controller.replicas':
+            census[labels.get('state', '?')] = v
+        elif name == 'controller.replicas_ready':
+            ready = v
+
+    def total(counter):
+        return sum(v for k, v in counters.items()
+                   if parse_rendered(k)[0] == counter)
+
+    return {
+        'replicas': replicas,
+        'census': census,
+        'replicas_ready': ready,
+        'scale_out_total': total('controller.scale_out_total'),
+        'scale_in_total': total('controller.scale_in_total'),
+        'heals_total': total('controller.heals_total'),
+        'deaths_total': total('controller.deaths_total'),
+        'quarantines_total': total('controller.quarantines_total'),
+        'spawn_failures_total':
+            total('controller.spawn_failures_total'),
     }
 
 
@@ -335,6 +389,7 @@ def _statusz_doc():
         'analysis': _analysis_status(snap),
         'slo': _slo_status(snap),
         'router': _router_status(snap),
+        'fleet': _fleet_status(snap),
         'anomalies': anomaly_state(),
         'flight': {'events': total, 'evicted': evicted,
                    'capacity': fr.capacity,

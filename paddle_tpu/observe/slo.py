@@ -231,9 +231,13 @@ class SloTracker(object):
         with self._mu:
             return self._goodput_locked(self._window(route), now)
 
-    def predicted_p99(self, route, now=None):
-        """The rolling window's latency p99 (None with an empty
-        window) — the router's crystal ball for admission."""
+    def predicted_quantile(self, route, q, now=None):
+        """The rolling window's latency quantile ``q`` in [0, 1] (None
+        with an empty window). q=0.99 is the admission crystal ball;
+        q=0.95 is the router's hedge delay — a request that outlives
+        the window's p95 is probably stuck behind a slow replica."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError('quantile must be in [0, 1], got %r' % (q,))
         now = time.perf_counter() if now is None else now
         with self._mu:
             w = self._window(route)
@@ -241,7 +245,12 @@ class SloTracker(object):
             lat = w.latencies(now)
         if not lat:
             return None
-        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+    def predicted_p99(self, route, now=None):
+        """The rolling window's latency p99 (None with an empty
+        window) — the router's crystal ball for admission."""
+        return self.predicted_quantile(route, 0.99, now)
 
     def window_counts(self, route, now=None):
         """(total, bad) currently inside the window."""

@@ -6,16 +6,20 @@ Dynamic micro-batching + shape buckets + AOT warmup over the
 `batch_timeout_ms` deadline, padding up a fixed `BucketLadder` so the
 set of XLA signatures is bounded and precompilable (`warmup()`), and
 full `observe` wiring (queue depth, batch size, padding waste,
-queue/batch/compute latency). `Router` fronts N engines as one fleet
-endpoint (least-loaded + session-affinity placement, failover,
-SLO-aware admission via `observe.slo`); per-request distributed
-tracing (`observe.reqtrace`) follows each sampled request across the
-submit/batcher/dispatcher threads under one trace id. See
-docs/serving.md; load-test with tools/serving_bench.py, chaos-test the
-fleet with `bench.py --workload fleet`.
+queue/batch/compute latency). `Router` fronts a dynamic fleet of
+engines as one endpoint (least-loaded + session-affinity placement,
+failover, hedged requests under a retry budget, SLO-aware admission
+via `observe.slo`); `FleetController` closes the loop over the SLO
+signals (scale out/in, self-heal with exponential backoff, crash-loop
+quarantine); per-request distributed tracing (`observe.reqtrace`)
+follows each sampled request across the submit/batcher/dispatcher
+threads under one trace id. See docs/serving.md; load-test with
+tools/serving_bench.py, chaos-test the fleet with `bench.py
+--workload fleet` and the autoscaler with `--workload autoscale`.
 """
 
 from .buckets import BatchInfo, BucketLadder, pow2_ladder  # noqa: F401
+from .controller import FleetController, ReplicaFactory  # noqa: F401
 from .engine import (EngineClosedError, QueueFullError,  # noqa: F401
                      ServingEngine)
 from .router import (NoReplicaAvailableError, Router,  # noqa: F401

@@ -1,5 +1,6 @@
 """Multi-replica serving router: least-loaded + session-affinity
-dispatch, retry-on-replica-down, and SLO-aware admission.
+dispatch, dynamic membership, hedged requests under a retry budget,
+retry-on-replica-down, and SLO-aware admission.
 
 One ``ServingEngine`` is a single replica; this router fronts N of
 them (any objects with ``submit(feed, ctx=)``, ``ready()``,
@@ -12,28 +13,53 @@ endpoint:
   ``queue_depth()``, the same numbers its /readyz check and
   ``serving.queue_depth`` gauge export). A ``session`` key pins a
   client to a preferred replica (consistent hash) while it stays
-  ready — cache/affinity wins without giving up failover.
+  ready — cache/affinity wins without giving up failover. Replicas
+  that are not ready — including one whose drain/shutdown has begun —
+  are never candidates.
+- **dynamic membership** — ``add_replica``/``remove_replica`` mutate
+  the fleet under the router's lock, so a fleet controller
+  (``serving.controller``) can spawn and retire replicas while
+  traffic flows: a removed replica takes no new work (in-flight
+  requests on it still complete; its drain happens outside the
+  router), a freshly added one joins the candidate ranking on the
+  next submit.
 - **failover** — a replica that dies mid-request fails that request
   with ``EngineClosedError``; the router catches exactly that (it
   means "replica gone", never "bad request") and resubmits to another
-  replica, up to ``retries`` times. A replica that is full at submit
-  time is skipped for the next-least-loaded one. Accepted requests
-  therefore either complete or fail with a typed error — never hang.
+  replica, up to ``retries`` times, spending one retry-budget token
+  per resubmission. A replica that is full at submit time is skipped
+  for the next-least-loaded one. Accepted requests therefore either
+  complete or fail with a typed error — never hang.
+- **hedged requests** — with ``hedge=True``, a request whose elapsed
+  time passes the route's rolling p95 (``slo.predicted_quantile``, or
+  the explicit ``hedge_delay_s`` floor) while deadline budget remains
+  gets a second dispatch to an *untried* replica; first completion
+  wins, the loser is cancelled/ignored. When both complete, their
+  results are compared — ``router.hedge_mismatch_total`` stays 0 for
+  a deterministic model, the bit-identity contract the chaos bench
+  asserts.
+- **retry budget** — hedges and failovers share one token bucket that
+  refills at ``retry_budget`` tokens per accepted request (burst
+  ``retry_budget_burst``), so retries are capped at a small fraction
+  of traffic and can never amplify an overload: when the bucket is
+  empty, hedges are suppressed and failovers surface their error
+  instead of resubmitting.
 - **SLO-aware admission** — with an ``observe.slo.SloTracker``
   attached, each submit compares the route's rolling predicted p99
   against the request's remaining deadline budget (or the route's
   latency budget): when the fleet is predicted to blow the budget the
   router *sheds* (``SLOShedError``, a ``QueueFullError`` subclass so
   existing backpressure handling just works) or *degrades* (admits
-  but tags the request context) instead of queueing doomed work —
-  replacing the blunt per-replica ``QueueFullError`` with a policy
-  that looks at measured behavior.
+  but tags the request context) instead of queueing doomed work. A
+  request whose deadline is already exhausted is shed synchronously
+  before any dispatch or hedge token is spent.
 
 Every decision is observable: ``router.*`` counters/gauges (dispatch
-per replica, retries, sheds by reason, replicas ready), flight events
-for failover and shedding, and per-request trace events on sampled
-``RequestContext``s. No environment reads at import time
-(tools/repo_lint.py enforces this module).
+per replica, hedges/wins/mismatches, retry-budget tokens, sheds by
+reason, replicas ready), flight events for failover and shedding, and
+per-request trace events on sampled ``RequestContext``s. No
+environment reads at import time (tools/repo_lint.py enforces this
+module).
 """
 
 import itertools
@@ -60,34 +86,118 @@ class NoReplicaAvailableError(RuntimeError):
 
 class SLOShedError(QueueFullError):
     """Admission control shed this request: the route's predicted p99
-    exceeds its remaining latency budget. A QueueFullError subclass so
-    callers' existing reject/backoff handling applies unchanged."""
+    exceeds its remaining latency budget, or the deadline budget was
+    already exhausted at submit. A QueueFullError subclass so callers'
+    existing reject/backoff handling applies unchanged."""
+
+
+class _RetryBudget(object):
+    """Token bucket shared by hedges and failovers: each accepted
+    request deposits ``ratio`` tokens (capped at ``burst``), each
+    hedge or failover dispatch spends 1.0 — so retry traffic is
+    bounded by ratio x accepted + burst, by construction."""
+
+    __slots__ = ('ratio', 'burst', 'tokens', '_mu')
+
+    def __init__(self, ratio, burst):
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._mu = threading.Lock()
+
+    def deposit(self):
+        with self._mu:
+            self.tokens = min(self.burst, self.tokens + self.ratio)
+            return self.tokens
+
+    def try_spend(self):
+        with self._mu:
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+    def refund(self):
+        with self._mu:
+            self.tokens = min(self.burst, self.tokens + 1.0)
+
+
+class _InFlight(object):
+    """Per-request dispatch state: which replicas were tried, how many
+    attempts are outstanding (primary + hedge + failovers), and the
+    first-completion-wins settlement. All transitions under ``mu``."""
+
+    __slots__ = ('feed', 'session', 'ctx', 'outer', 'tried', 'mu',
+                 'settled', 'outstanding', 'first_result', 'have_result',
+                 'stashed_exc', 'hedged', 'attempts_left', 'timer')
+
+    def __init__(self, feed, session, ctx, outer, attempts_left):
+        self.feed = feed
+        self.session = session
+        self.ctx = ctx
+        self.outer = outer
+        self.tried = set()
+        self.mu = threading.Lock()
+        self.settled = False
+        self.outstanding = 0
+        self.first_result = None
+        self.have_result = False
+        self.stashed_exc = None
+        self.hedged = False
+        self.attempts_left = attempts_left
+        self.timer = None
+
+
+def _results_equal(a, b):
+    """Best-effort bit-identity check between two fetch lists — the
+    hedging invariant (a hedge re-runs the SAME feed through the SAME
+    model, so any divergence is a real determinism bug)."""
+    try:
+        import numpy as np
+        if type(a) is not type(b):
+            return False
+        seq_a = a if isinstance(a, (list, tuple)) else [a]
+        seq_b = b if isinstance(b, (list, tuple)) else [b]
+        if len(seq_a) != len(seq_b):
+            return False
+        return all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(seq_a, seq_b))
+    except Exception:
+        return True   # uncomparable payloads never count as a mismatch
 
 
 class Router(object):
-    """Least-loaded / session-affinity dispatch over N serving
-    replicas.
+    """Least-loaded / session-affinity dispatch over a dynamic fleet
+    of serving replicas.
 
     ::
 
         replicas = [ServingEngine(pred_i, name='replica%d' % i)
                     for i, pred_i in enumerate(preds)]
         tracker = SloTracker([Objective('serve', latency_budget_s=0.5)])
-        router = Router(replicas, slo=tracker, route='serve')
+        router = Router(replicas, slo=tracker, route='serve',
+                        hedge=True)
         fut = router.submit({'x': batch}, session='user-42')
         outs = router.predict({'x': batch})
+        router.add_replica(new_engine)       # fleet controller's hooks
+        old = router.remove_replica('replica0')
         router.close()        # unregisters health; replicas are yours
 
     ``admission``: 'slo' sheds/degrades on predicted-p99 breach (needs
     ``slo``), 'none' skips the check. ``on_breach``: 'shed' raises
     SLOShedError, 'degrade' admits but tags the request context and
-    counts it. The router owns no threads; completion hooks run on the
-    replicas' dispatcher threads.
+    counts it. ``hedge=True`` needs either ``slo`` (rolling
+    ``hedge_quantile`` delay) or an explicit ``hedge_delay_s``. The
+    router owns no long-lived threads; completion hooks run on the
+    replicas' dispatcher threads and hedge checks on short one-shot
+    timers.
     """
 
     def __init__(self, replicas, slo=None, route='serve',
                  session_affinity=True, retries=2, admission=None,
-                 on_breach='shed'):
+                 on_breach='shed', hedge=False, hedge_quantile=0.95,
+                 hedge_delay_s=None, hedge_min_delay_s=0.002,
+                 retry_budget=0.1, retry_budget_burst=20.0):
         reps = list(replicas)
         if not reps:
             raise ValueError('Router needs at least one replica')
@@ -105,46 +215,100 @@ class Router(object):
             raise ValueError("admission='slo' needs an SloTracker")
         if on_breach not in ('shed', 'degrade'):
             raise ValueError("on_breach must be 'shed' or 'degrade'")
+        if hedge and slo is None and hedge_delay_s is None:
+            raise ValueError('hedge=True needs an SloTracker (rolling '
+                             'p95 delay) or an explicit hedge_delay_s')
         self.admission = admission
         self.on_breach = on_breach
         self.session_affinity = bool(session_affinity)
         self.retries = int(retries)
+        self.hedge = bool(hedge)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_delay_s = hedge_delay_s
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
+        self._budget = _RetryBudget(retry_budget, retry_budget_burst)
         self._mu = threading.Lock()
         self._rr = itertools.count()    # tiebreak for equal depths
+        self._closed = False
         self._health_name = 'serving.router%d' % next(_ROUTER_IDS)
         _obs.register_health_check(self._health_name, self._ready_check,
                                    readiness_only=True)
         _obs.set_gauge('router.replicas_total', len(reps))
+        _obs.set_gauge('router.retry_budget_tokens', self._budget.tokens)
 
     # --------------------------------------------------------- lifecycle
     def ready(self):
         """True while at least one replica is ready — the fleet-level
         /readyz signal."""
-        return any(r.ready() for _, r in self._replicas)
+        return any(r.ready() for _, r in self._members())
 
     def _ready_check(self):
-        n = sum(1 for _, r in self._replicas if r.ready())
+        members = self._members()
+        n = sum(1 for _, r in members if r.ready())
         if n:
-            return True, '%d/%d replicas ready' % (n,
-                                                   len(self._replicas))
-        return False, '0/%d replicas ready' % len(self._replicas)
+            return True, '%d/%d replicas ready' % (n, len(members))
+        return False, '0/%d replicas ready' % len(members)
 
     def close(self, shutdown_replicas=False, drain=True):
         """Unregister the router's health check; optionally shut every
         replica down too."""
+        self._closed = True
         _obs.unregister_health_check(self._health_name)
         if shutdown_replicas:
-            for _, r in self._replicas:
+            for _, r in self._members():
                 r.shutdown(drain=drain)
+
+    def _members(self):
+        with self._mu:
+            return list(self._replicas)
 
     def replicas(self):
         """[(name, replica)] — live view for tests and tooling."""
-        return list(self._replicas)
+        return self._members()
+
+    # -------------------------------------------------------- membership
+    def add_replica(self, replica, name=None):
+        """Register one replica with the fleet (fleet-controller hook).
+        The replica joins the candidate ranking on the next submit; it
+        should already be ready() — the controller only registers
+        replicas after warmup. Names must stay unique."""
+        name = str(name) if name else (getattr(replica, 'name', None)
+                                       or 'replica?')
+        with self._mu:
+            if any(n == name for n, _ in self._replicas):
+                raise ValueError('replica name %r already in the fleet'
+                                 % name)
+            self._replicas.append((name, replica))
+            total = len(self._replicas)
+        _obs.set_gauge('router.replicas_total', total)
+        _obs.inc('router.membership_changes_total', change='add',
+                 route=self.route)
+        return name
+
+    def remove_replica(self, name):
+        """Deregister one replica (fleet-controller hook) and return
+        it. It takes no new work from this router the moment this
+        returns — requests already dispatched to it still complete,
+        and draining/shutdown is the caller's job (scale-in drains
+        BEFORE shutdown so accepted work is never lost)."""
+        with self._mu:
+            for i, (n, r) in enumerate(self._replicas):
+                if n == name:
+                    del self._replicas[i]
+                    total = len(self._replicas)
+                    break
+            else:
+                raise KeyError('no replica named %r in the fleet'
+                               % name)
+        _obs.set_gauge('router.replicas_total', total)
+        _obs.inc('router.membership_changes_total', change='remove',
+                 route=self.route)
+        return r
 
     # --------------------------------------------------------- placement
     def _publish_fleet(self):
         ready = 0
-        for name, r in self._replicas:
+        for name, r in self._members():
             ok = r.ready()
             ready += bool(ok)
             _obs.set_gauge('router.replica_queue_depth',
@@ -154,16 +318,19 @@ class Router(object):
     def _candidates(self, session=None, exclude=()):
         """Ready replicas in dispatch-preference order: the session's
         pinned replica first (when affine and ready), then ascending
-        queue depth."""
-        avail = [(name, r) for name, r in self._replicas
+        queue depth. A replica whose ready() is False — not started,
+        not warmed, full-stop dead, or mid-drain/shutdown — is never a
+        candidate: scale-in must not route new work onto a replica
+        being retired."""
+        members = self._members()
+        avail = [(name, r) for name, r in members
                  if name not in exclude and r.ready()]
         ranked = sorted(avail,
                         key=lambda nr: (nr[1].queue_depth(),
                                         next(self._rr)))
-        if session is not None and self.session_affinity and \
-                self._replicas:
-            pin = self._replicas[
-                zlib.crc32(str(session).encode()) % len(self._replicas)]
+        if session is not None and self.session_affinity and members:
+            pin = members[
+                zlib.crc32(str(session).encode()) % len(members)]
             if pin in ranked:
                 ranked.remove(pin)
                 ranked.insert(0, pin)
@@ -171,15 +338,25 @@ class Router(object):
 
     # --------------------------------------------------------- admission
     def _admission_check(self, ctx):
-        """Shed or degrade when the route's predicted p99 exceeds the
-        request's remaining budget. Returns True when the request was
-        degraded (admitted past a predicted breach)."""
+        """Shed or degrade before any dispatch. An already-exhausted
+        deadline sheds synchronously (no dispatch, no hedge token);
+        otherwise, with SLO admission, a predicted-p99 breach sheds or
+        degrades. Returns True when the request was degraded."""
+        remaining = ctx.remaining()
+        if remaining is not None and remaining <= 0.0:
+            # the fast path: the budget is gone before any work
+            # happened — shed without touching a replica or a token
+            _obs.inc('router.shed_total', reason='deadline_expired',
+                     route=self.route)
+            ctx.event('shed', reason='deadline_expired')
+            raise SLOShedError(
+                'admission shed: deadline budget already exhausted '
+                '(%.4fs past) on route %r' % (-remaining, self.route))
         if self.admission != 'slo':
             return False
         p99 = self._slo.predicted_p99(self.route)
         if p99 is None:
             return False
-        remaining = ctx.remaining()
         budget = remaining if remaining is not None else \
             self._slo.objective(self.route).latency_budget_s
         if p99 <= budget:
@@ -201,45 +378,62 @@ class Router(object):
     # ----------------------------------------------------------- intake
     def submit(self, feed, session=None, deadline_s=None, ctx=None):
         """Route one request to the fleet; returns a Future. Raises
-        SLOShedError (admission), QueueFullError (every ready replica
-        full), NoReplicaAvailableError (no ready replica); after
-        acceptance the future resolves with the result or a typed
-        error — a replica dying mid-request triggers transparent
-        resubmission up to ``retries`` times first."""
+        SLOShedError (admission: predicted breach or expired
+        deadline), QueueFullError (every ready replica full),
+        NoReplicaAvailableError (no ready replica); after acceptance
+        the future resolves with the result or a typed error — a
+        replica dying mid-request triggers transparent resubmission
+        (budget permitting) up to ``retries`` times first, and with
+        hedging on, a request outliving the route's p95 gets a second
+        chance on an untried replica."""
         if ctx is None:
             ctx = _reqtrace.new_context(self.route,
                                         deadline_s=deadline_s)
         _obs.inc('router.requests_total', route=self.route)
         self._admission_check(ctx)
-        outer = Future()
-        self._dispatch(feed, session, ctx, outer, tried=(),
-                       attempts_left=self.retries)
+        state = _InFlight(feed, session, ctx, Future(),
+                          attempts_left=self.retries)
+        # accepted traffic funds the retry budget (shed requests never
+        # reach this line, so they cannot buy hedges)
+        _obs.set_gauge('router.retry_budget_tokens',
+                       self._budget.deposit())
+        self._dispatch(state, hedge=False)
+        self._schedule_hedge(state)
         self._publish_fleet()
-        return outer
+        return state.outer
 
     def predict(self, feed, session=None, deadline_s=None, timeout=None):
         """submit() + wait."""
         return self.submit(feed, session=session,
                            deadline_s=deadline_s).result(timeout)
 
-    def _dispatch(self, feed, session, ctx, outer, tried, attempts_left):
+    # --------------------------------------------------------- dispatch
+    def _dispatch(self, state, hedge):
+        """One placement attempt: submit to the best untried ready
+        replica and hook its completion. Raises QueueFullError /
+        NoReplicaAvailableError when nothing accepts (the caller
+        decides whether that is fatal — it is for the primary, it is
+        not for a hedge or failover)."""
         last_full = None
-        for name, replica in self._candidates(session, exclude=tried):
+        for name, replica in self._candidates(state.session,
+                                              exclude=state.tried):
             try:
-                inner = replica.submit(feed, ctx=ctx)
+                inner = replica.submit(state.feed, ctx=state.ctx)
             except QueueFullError as e:
                 last_full = e
                 continue
             except EngineClosedError:
                 continue   # lost the race with a shutdown: next replica
+            with state.mu:
+                state.tried.add(name)
+                state.outstanding += 1
             _obs.inc('router.dispatch_total', replica=name,
                      route=self.route)
-            ctx.event('routed', replica=name)
+            state.ctx.event('routed', replica=name, hedge=hedge)
             inner.add_done_callback(
-                lambda f, name=name: self._on_done(
-                    f, name, feed, session, ctx, outer, tried + (name,),
-                    attempts_left))
-            return
+                lambda f, name=name: self._on_attempt_done(
+                    f, name, state, hedge))
+            return name
         # nothing accepted it: full everywhere vs nothing ready
         if last_full is not None:
             _obs.inc('router.shed_total', reason='queue_full',
@@ -249,41 +443,177 @@ class Router(object):
         _obs.flight_event('router_no_replica', route=self.route)
         raise NoReplicaAvailableError(
             'no ready replica (fleet of %d) for route %r'
-            % (len(self._replicas), self.route))
+            % (len(self._members()), self.route))
 
-    def _on_done(self, inner, name, feed, session, ctx, outer, tried,
-                 attempts_left):
+    # ----------------------------------------------------------- hedging
+    def _hedge_delay(self):
+        """Seconds to wait before hedging: the route's rolling
+        ``hedge_quantile`` latency (floored at hedge_min_delay_s),
+        falling back to the explicit hedge_delay_s; None disables the
+        hedge for this request (no latency signal yet)."""
+        if self._slo is not None:
+            try:
+                q = self._slo.predicted_quantile(self.route,
+                                                 self.hedge_quantile)
+            except KeyError:
+                q = None
+            if q is not None:
+                return max(q, self.hedge_min_delay_s)
+        if self.hedge_delay_s is not None:
+            return max(float(self.hedge_delay_s), self.hedge_min_delay_s)
+        return None
+
+    def _schedule_hedge(self, state):
+        if not self.hedge:
+            return
+        delay = self._hedge_delay()
+        if delay is None:
+            _obs.inc('router.hedge_suppressed_total', reason='no_signal',
+                     route=self.route)
+            return
+        remaining = state.ctx.remaining()
+        if remaining is not None and remaining <= delay:
+            # the deadline will expire before the hedge would fire —
+            # hedging could never help this request
+            _obs.inc('router.hedge_suppressed_total', reason='deadline',
+                     route=self.route)
+            return
+        t = threading.Timer(delay, self._maybe_hedge, args=(state,))
+        t.daemon = True
+        state.timer = t
+        t.start()
+
+    def _maybe_hedge(self, state):
+        """Timer body: the primary outlived the hedge delay — dispatch
+        a second attempt to an untried replica if deadline budget
+        remains and the retry budget has a token."""
+        if self._closed or state.outer.done():
+            return
+        if state.ctx.expired():
+            _obs.inc('router.hedge_suppressed_total', reason='deadline',
+                     route=self.route)
+            return
+        if not self._budget.try_spend():
+            _obs.inc('router.hedge_suppressed_total', reason='budget',
+                     route=self.route)
+            _obs.inc('router.retry_budget_exhausted_total', kind='hedge',
+                     route=self.route)
+            return
+        _obs.set_gauge('router.retry_budget_tokens', self._budget.tokens)
+        with state.mu:
+            if state.settled:
+                self._budget.refund()
+                return
+            state.hedged = True
+        try:
+            name = self._dispatch(state, hedge=True)
+        except (QueueFullError, NoReplicaAvailableError):
+            # nowhere to hedge to: not an error for the request (the
+            # primary is still running) — refund the token
+            self._budget.refund()
+            with state.mu:
+                state.hedged = state.outstanding > 1
+            _obs.inc('router.hedge_suppressed_total', reason='no_replica',
+                     route=self.route)
+            return
+        _obs.inc('router.hedge_total', route=self.route)
+        state.ctx.event('hedge', replica=name)
+
+    # ------------------------------------------------------- completion
+    def _on_attempt_done(self, inner, name, state, hedge):
         try:
             result = inner.result()
         except EngineClosedError as e:
-            # the replica died under this request — the ONE failure
+            # the replica died under this attempt — the ONE failure
             # class where retrying elsewhere is always safe (the
             # request never computed)
-            _obs.inc('router.failover_total', replica=name,
-                     route=self.route)
-            _obs.flight_event('router_failover', replica=name,
-                              route=self.route,
-                              attempts_left=attempts_left)
-            ctx.event('failover', replica=name)
-            if attempts_left > 0:
-                try:
-                    self._dispatch(feed, session, ctx, outer,
-                                   tried=tried,
-                                   attempts_left=attempts_left - 1)
-                except NoReplicaAvailableError:
-                    # nowhere left to go: the request died with its
-                    # replica — surface THAT, not the fleet census
-                    self._finish(outer, ctx, exc=e)
-                except Exception as redispatch_exc:
-                    self._finish(outer, ctx, exc=redispatch_exc)
-                return
-            self._finish(outer, ctx, exc=e)
+            self._attempt_died(name, state, hedge, e)
         except BaseException as e:
-            self._finish(outer, ctx, exc=e)
+            self._attempt_failed(state, e)
         else:
-            self._finish(outer, ctx, result=result)
+            self._attempt_succeeded(state, name, result, hedge)
 
-    def _finish(self, outer, ctx, result=None, exc=None):
+    def _attempt_died(self, name, state, hedge, exc):
+        _obs.inc('router.failover_total', replica=name, route=self.route)
+        _obs.flight_event('router_failover', replica=name,
+                          route=self.route,
+                          attempts_left=state.attempts_left)
+        state.ctx.event('failover', replica=name)
+        with state.mu:
+            settled = state.settled
+            can_retry = state.attempts_left > 0
+            if can_retry:
+                state.attempts_left -= 1
+        if not settled and can_retry:
+            if not self._budget.try_spend():
+                _obs.inc('router.retry_budget_exhausted_total',
+                         kind='failover', route=self.route)
+                self._attempt_failed(state, exc)
+                return
+            _obs.set_gauge('router.retry_budget_tokens',
+                           self._budget.tokens)
+            try:
+                self._dispatch(state, hedge=hedge)
+            except NoReplicaAvailableError:
+                # nowhere left to go: the request died with its
+                # replica — surface THAT, not the fleet census
+                self._budget.refund()
+                self._attempt_failed(state, exc)
+            except Exception as redispatch_exc:
+                self._budget.refund()
+                self._attempt_failed(state, redispatch_exc)
+            return
+        self._attempt_failed(state, exc)
+
+    def _attempt_succeeded(self, state, name, result, hedge):
+        with state.mu:
+            state.outstanding -= 1
+            if not state.settled:
+                state.settled = True
+                state.first_result = result
+                state.have_result = True
+                won = True
+            else:
+                won = False
+                mismatch = state.have_result and \
+                    not _results_equal(state.first_result, result)
+        if won:
+            if state.hedged:
+                _obs.inc('router.hedge_wins_total',
+                         winner='hedge' if hedge else 'primary',
+                         route=self.route)
+                state.ctx.event('hedge_won',
+                                winner='hedge' if hedge else 'primary',
+                                replica=name)
+            if state.timer is not None:
+                state.timer.cancel()
+            self._finish(state, result=result)
+        elif mismatch:
+            # both attempts completed and disagreed: a determinism bug
+            # worth an alarm, not a silent coin flip
+            _obs.inc('router.hedge_mismatch_total', route=self.route)
+            _obs.flight_event('router_hedge_mismatch', route=self.route,
+                              replica=name)
+
+    def _attempt_failed(self, state, exc):
+        with state.mu:
+            state.outstanding -= 1
+            if state.settled:
+                return                      # a loser failing is noise
+            if state.outstanding > 0:
+                # another attempt (hedge or primary) is still running —
+                # hold the error, it may yet be rescued
+                if state.stashed_exc is None:
+                    state.stashed_exc = exc
+                return
+            state.settled = True
+            exc = state.stashed_exc or exc
+        if state.timer is not None:
+            state.timer.cancel()
+        self._finish(state, exc=exc)
+
+    def _finish(self, state, result=None, exc=None):
+        ctx, outer = state.ctx, state.outer
         latency = time.perf_counter() - ctx.t_start
         ok = exc is None
         _obs.record('router.request_seconds', latency,

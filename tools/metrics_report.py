@@ -184,6 +184,150 @@ def render_slo(rec):
     return '\n'.join(lines)
 
 
+# ---------------------------------------------------------- fleet view
+_FLEET_STATES = {0: 'UP', 1: 'DRAINING', 2: 'QUARANTINED', 3: 'DEAD'}
+
+
+def derive_fleet(records):
+    """Fleet-controller timeline from a metrics JSONL: the replica
+    census over time (from the periodic snapshot records the autoscale
+    bench flushes), scale-out/in/heal/quarantine counter deltas per
+    snapshot, the final per-replica state machine, and the hedge
+    ledger (hedge+failover dispatch rate vs the retry budget). Works
+    on counters/gauges alone — no flight ring needed offline."""
+    parse = _registry_mod().parse_rendered
+
+    def census_of(rec):
+        out = {}
+        for rendered, v in rec.get('gauges', {}).items():
+            name, labels = parse(rendered)
+            if name == 'controller.replicas':
+                out.setdefault(labels.get('route', '?'), {})[
+                    labels.get('state', '?')] = v
+        return out
+
+    def totals_of(rec, names):
+        out = dict.fromkeys(names, 0)
+        for rendered, v in rec.get('counters', {}).items():
+            name, _ = parse(rendered)
+            if name in out:
+                out[name] += v
+        return out
+
+    cnames = ('controller.scale_out_total', 'controller.scale_in_total',
+              'controller.heals_total', 'controller.quarantines_total',
+              'controller.deaths_total',
+              'controller.spawn_failures_total')
+    census_timeline, events = [], []
+    prev = dict.fromkeys(cnames, 0)
+    t0 = None
+    for rec in records:
+        census = census_of(rec)
+        if not census and not any(
+                parse(k)[0].startswith('controller.')
+                for k in rec.get('counters', {})):
+            continue
+        ts = rec.get('ts')
+        if t0 is None:
+            t0 = ts
+        t = round(ts - t0, 3) if (ts is not None and
+                                  t0 is not None) else None
+        if census:
+            census_timeline.append({'t': t, 'census': census})
+        totals = totals_of(rec, cnames)
+        delta = {k.split('.')[1].replace('_total', ''):
+                 totals[k] - prev[k]
+                 for k in cnames if totals[k] != prev[k]}
+        if delta:
+            events.append(dict({'t': t}, **delta))
+        prev = totals
+
+    last = None
+    for rec in records:
+        if any(parse(k)[0].startswith('controller.')
+               for k in list(rec.get('gauges', {}))
+               + list(rec.get('counters', {}))):
+            last = rec
+    replicas, hedge = {}, {}
+    if last is not None:
+        for rendered, v in last.get('gauges', {}).items():
+            name, labels = parse(rendered)
+            if name == 'controller.replica_state':
+                replicas[labels.get('replica', '?')] = \
+                    _FLEET_STATES.get(int(v), '?')
+            elif name == 'router.retry_budget_tokens':
+                hedge['retry_budget_tokens'] = v
+        hedges = requests = dispatches = failovers = mismatches = 0
+        for rendered, v in last.get('counters', {}).items():
+            name, _ = parse(rendered)
+            if name == 'router.hedge_total':
+                hedges += v
+            elif name == 'router.requests_total':
+                requests += v
+            elif name == 'router.dispatch_total':
+                dispatches += v
+            elif name == 'router.failover_total':
+                failovers += v
+            elif name == 'router.hedge_mismatch_total':
+                mismatches += v
+        hedge.update({
+            'hedges': hedges, 'requests': requests,
+            'failovers': failovers, 'mismatches': mismatches,
+            'hedge_fraction': round(hedges / requests, 6)
+            if requests else None,
+        })
+        totals = totals_of(last, cnames)
+    else:
+        totals = dict.fromkeys(cnames, 0)
+    return {
+        'census_timeline': census_timeline,
+        'scale_events': events,
+        'replicas': replicas,
+        'totals': {k.split('.', 1)[1]: v for k, v in totals.items()},
+        'hedge': hedge,
+    }
+
+
+def render_fleet(records):
+    doc = derive_fleet(records)
+    if not doc['census_timeline'] and not doc['replicas'] and \
+            not doc['scale_events']:
+        return 'no controller.* metrics in this JSONL'
+    lines = ['== fleet controller timeline']
+    for ev in doc['scale_events']:
+        what = ', '.join('%s +%d' % (k, v) for k, v in
+                         sorted(ev.items()) if k != 't')
+        lines.append('   t=%-8s %s' % (ev.get('t'), what))
+    if doc['census_timeline']:
+        lines.append('== replica census (state counts over time, '
+                     'per route)')
+        for row in doc['census_timeline']:
+            cells = []
+            for route in sorted(row['census']):
+                c = row['census'][route]
+                cells.append('%s[%s]' % (route, ' '.join(
+                    '%s=%d' % (k, v) for k, v in sorted(c.items()))))
+            lines.append('   t=%-8s %s' % (row['t'], '  '.join(cells)))
+    if doc['replicas']:
+        lines.append('== final replica states')
+        for name in sorted(doc['replicas']):
+            lines.append('   %-24s %s' % (name, doc['replicas'][name]))
+    h = doc['hedge']
+    if h:
+        lines.append('== hedged requests vs retry budget')
+        lines.append('   requests %s   hedges %s (%s of traffic)   '
+                     'failovers %s   mismatches %s   tokens left %s'
+                     % (h.get('requests'), h.get('hedges'),
+                        ('%.2f%%' % (100 * h['hedge_fraction']))
+                        if h.get('hedge_fraction') is not None else '?',
+                        h.get('failovers'), h.get('mismatches'),
+                        h.get('retry_budget_tokens')))
+    t = doc['totals']
+    lines.append('== totals: %s' % '  '.join(
+        '%s=%d' % (k, v) for k, v in sorted(t.items())))
+    return '\n'.join(lines)
+
+
 def render(rec):
     lines = []
     d = derive(rec)
@@ -246,13 +390,18 @@ def main(argv=None):
                    help='render the SLO panel: per-route objectives, '
                         'burn rate, goodput, and the top-5 slowest '
                         'sampled trace ids')
+    p.add_argument('--fleet', action='store_true',
+                   help='render the fleet-controller timeline: replica '
+                        'census and scale/heal/quarantine events over '
+                        'the JSONL\'s snapshots, final per-replica '
+                        'states, and hedge rate vs retry budget')
     args = p.parse_args(argv)
     if args.json and args.prom:
         sys.stderr.write('metrics_report: --json and --prom are '
                          'mutually exclusive\n')
         return 2
-    if args.slo and args.prom:
-        sys.stderr.write('metrics_report: --slo and --prom are '
+    if (args.slo or args.fleet) and args.prom:
+        sys.stderr.write('metrics_report: --slo/--fleet and --prom are '
                          'mutually exclusive\n')
         return 2
 
@@ -274,7 +423,13 @@ def main(argv=None):
         chosen = [pick(records, any_kind=args.snapshot)]
 
     try:
-        if args.slo:
+        if args.fleet:
+            # the timeline wants EVERY record, not one chosen summary
+            if args.json:
+                print(json.dumps(derive_fleet(records)))
+            else:
+                print(render_fleet(records))
+        elif args.slo:
             if args.json:
                 docs = [derive_slo(r) for r in chosen]
                 print(json.dumps(docs[0] if len(docs) == 1 else docs))

@@ -80,6 +80,9 @@ QUEUE = [
     # crowd + replica kill; slo.*/router.* burn-rate/goodput metrics
     # land in the shared metrics JSONL (metrics_report.py --slo)
     ('fleet', 'fleet', None, 700),
+    # self-healing autoscaling fleet (ISSUE 11): flash-crowd scale-up,
+    # crash-loop quarantine, trough scale-in, hedged-request budget
+    ('autoscale', 'autoscale', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),
