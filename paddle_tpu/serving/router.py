@@ -12,8 +12,10 @@ endpoint:
   shallowest admission queue (each engine's ``ready()`` +
   ``queue_depth()``, the same numbers its /readyz check and
   ``serving.queue_depth`` gauge export). A ``session`` key pins a
-  client to a preferred replica (consistent hash) while it stays
-  ready — cache/affinity wins without giving up failover. Replicas
+  client to a preferred replica (rendezvous hash, so membership
+  changes only reassign sessions touching the changed replica) while
+  it stays ready — cache/affinity wins without giving up failover.
+  Replicas
   that are not ready — including one whose drain/shutdown has begun —
   are never candidates.
 - **dynamic membership** — ``add_replica``/``remove_replica`` mutate
@@ -148,20 +150,31 @@ class _InFlight(object):
         self.timer = None
 
 
+def _arrays_equal(x, y):
+    import numpy as np
+    x, y = np.asarray(x), np.asarray(y)
+    if (np.issubdtype(x.dtype, np.inexact)
+            and np.issubdtype(y.dtype, np.inexact)):
+        # NaN == NaN for this check: identical NaN-bearing outputs
+        # (a model that emits NaNs, chaos poison_nans) are not a
+        # determinism mismatch. equal_nan raises on non-float dtypes,
+        # hence the guard.
+        return np.array_equal(x, y, equal_nan=True)
+    return np.array_equal(x, y)
+
+
 def _results_equal(a, b):
     """Best-effort bit-identity check between two fetch lists — the
     hedging invariant (a hedge re-runs the SAME feed through the SAME
     model, so any divergence is a real determinism bug)."""
     try:
-        import numpy as np
         if type(a) is not type(b):
             return False
         seq_a = a if isinstance(a, (list, tuple)) else [a]
         seq_b = b if isinstance(b, (list, tuple)) else [b]
         if len(seq_a) != len(seq_b):
             return False
-        return all(np.array_equal(np.asarray(x), np.asarray(y))
-                   for x, y in zip(seq_a, seq_b))
+        return all(_arrays_equal(x, y) for x, y in zip(seq_a, seq_b))
     except Exception:
         return True   # uncomparable payloads never count as a mismatch
 
@@ -329,8 +342,15 @@ class Router(object):
                         key=lambda nr: (nr[1].queue_depth(),
                                         next(self._rr)))
         if session is not None and self.session_affinity and members:
-            pin = members[
-                zlib.crc32(str(session).encode()) % len(members)]
+            # rendezvous (highest-random-weight) hashing: each session
+            # pins to the member maximizing hash(session, name), so a
+            # membership change only moves the sessions that touch the
+            # added/removed replica — not the whole keyspace the way a
+            # modulus over len(members) would
+            key = str(session).encode()
+            pin = max(members,
+                      key=lambda nr: zlib.crc32(
+                          nr[0].encode() + b'\x00' + key))
             if pin in ranked:
                 ranked.remove(pin)
                 ranked.insert(0, pin)
@@ -540,6 +560,12 @@ class Router(object):
                           attempts_left=state.attempts_left)
         state.ctx.event('failover', replica=name)
         with state.mu:
+            # this attempt is over for good — retire its outstanding
+            # slot HERE, so a successful redispatch (which increments
+            # again) leaves the count balanced and the final attempt's
+            # failure can actually settle the future instead of
+            # stashing the error forever
+            state.outstanding -= 1
             settled = state.settled
             can_retry = state.attempts_left > 0
             if can_retry:
@@ -548,7 +574,7 @@ class Router(object):
             if not self._budget.try_spend():
                 _obs.inc('router.retry_budget_exhausted_total',
                          kind='failover', route=self.route)
-                self._attempt_failed(state, exc)
+                self._settle_failure(state, exc)
                 return
             _obs.set_gauge('router.retry_budget_tokens',
                            self._budget.tokens)
@@ -558,12 +584,12 @@ class Router(object):
                 # nowhere left to go: the request died with its
                 # replica — surface THAT, not the fleet census
                 self._budget.refund()
-                self._attempt_failed(state, exc)
+                self._settle_failure(state, exc)
             except Exception as redispatch_exc:
                 self._budget.refund()
-                self._attempt_failed(state, redispatch_exc)
+                self._settle_failure(state, redispatch_exc)
             return
-        self._attempt_failed(state, exc)
+        self._settle_failure(state, exc)
 
     def _attempt_succeeded(self, state, name, result, hedge):
         with state.mu:
@@ -598,6 +624,13 @@ class Router(object):
     def _attempt_failed(self, state, exc):
         with state.mu:
             state.outstanding -= 1
+        self._settle_failure(state, exc)
+
+    def _settle_failure(self, state, exc):
+        """Settle-only half of failure handling: callers that already
+        retired the attempt's outstanding slot (_attempt_died) land
+        here directly, so no path can double-decrement."""
+        with state.mu:
             if state.settled:
                 return                      # a loser failing is noise
             if state.outstanding > 0:

@@ -252,6 +252,104 @@ def test_router_retry_budget_bounds_hedges():
     r.close()
 
 
+def test_router_failover_chain_deaths_resolve_future():
+    """Regression (review): _attempt_died must retire the dead
+    attempt's outstanding slot even when its redispatch succeeds.
+    With two replicas that BOTH die mid-request, the leaked slot used
+    to make the final failure stash its exception instead of settling
+    — predict() without a timeout blocked forever. The future must
+    resolve with EngineClosedError."""
+    observe.enable()
+    a = FakeReplica('a', manual=True)
+    b = FakeReplica('b', depth=5, manual=True)
+    r = Router([a, b], session_affinity=False, retries=2)
+    fut = r.submit({'x': 1})
+    assert a.submitted == 1
+    a.pending[0].set_exception(EngineClosedError('a died'))
+    assert b.submitted == 1            # failover redispatch landed
+    b.pending[0].set_exception(EngineClosedError('b died'))
+    assert fut.done()                  # the pre-fix repro: stays False
+    assert isinstance(fut.exception(timeout=5.0), EngineClosedError)
+    assert observe.get_counter('router.failover_total', replica='a',
+                               route='serve') == 1
+    assert observe.get_counter('router.failover_total', replica='b',
+                               route='serve') == 1
+    r.close()
+
+
+def test_router_failover_no_retry_paths_resolve_future():
+    """The no-redispatch death paths settle too: retries exhausted,
+    and an empty retry budget."""
+    observe.enable()
+    a = FakeReplica('a', manual=True)
+    r = Router([a], session_affinity=False, retries=0)
+    fut = r.submit({'x': 1})
+    a.pending[0].set_exception(EngineClosedError('gone'))
+    assert isinstance(fut.exception(timeout=5.0), EngineClosedError)
+    r.close()
+    c = FakeReplica('c', manual=True)
+    d = FakeReplica('d', depth=5)
+    r2 = Router([c, d], session_affinity=False, retries=2,
+                retry_budget=0.0, retry_budget_burst=0.0)
+    fut2 = r2.submit({'x': 1})
+    c.pending[0].set_exception(EngineClosedError('gone'))
+    assert isinstance(fut2.exception(timeout=5.0), EngineClosedError)
+    assert d.submitted == 0            # no budget, no redispatch
+    assert observe.get_counter('router.retry_budget_exhausted_total',
+                               kind='failover', route='serve') == 1
+    r2.close()
+
+
+def test_router_hedge_nan_payloads_not_a_mismatch():
+    """Bit-identical NaN-bearing outputs (a model that legitimately
+    emits NaNs, the poison_nans chaos action) must not fire the
+    hedge determinism alarm."""
+    from paddle_tpu.serving.router import _results_equal
+    nan_arr = np.array([1.0, np.nan, 3.0])
+    assert _results_equal([nan_arr.copy()], [nan_arr.copy()])
+    assert not _results_equal([nan_arr], [np.array([1.0, 2.0, 3.0])])
+    # non-float dtypes take the equal_nan-free path (equal_nan raises
+    # on them) and still compare correctly
+    assert _results_equal([np.array(['x'])], [np.array(['x'])])
+    assert not _results_equal([np.array([1, 2])], [np.array([1, 3])])
+    observe.enable()
+    a = FakeReplica('a', manual=True)
+    b = FakeReplica('b', depth=9, manual=True)
+    r = Router([a, b], hedge=True, hedge_delay_s=0.01,
+               session_affinity=False)
+    fut = r.submit({'x': 1})
+    deadline = time.perf_counter() + 5.0
+    while b.submitted == 0 and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    a.pending[0].set_result([nan_arr.copy()])
+    b.pending[0].set_result([nan_arr.copy()])
+    fut.result(5.0)
+    assert observe.get_counter('router.hedge_mismatch_total',
+                               route='serve') in (None, 0)
+    r.close()
+
+
+def test_router_session_pins_stable_across_membership():
+    """Rendezvous session pinning: a scale event only reassigns the
+    sessions that hash onto the changed replica — everyone else keeps
+    their pin (the old modulus scheme churned the whole keyspace)."""
+    observe.enable()
+    reps = {n: FakeReplica(n) for n in ('a', 'b', 'c')}
+    r = Router(list(reps.values()))
+    sessions = ['s%d' % i for i in range(40)]
+    pin0 = {s: r._candidates(session=s)[0][0] for s in sessions}
+    assert len(set(pin0.values())) > 1       # spread across the fleet
+    victim = pin0[sessions[0]]
+    removed = r.remove_replica(victim)
+    for s in sessions:
+        if pin0[s] != victim:                # untouched by the change
+            assert r._candidates(session=s)[0][0] == pin0[s]
+    r.add_replica(removed)                   # and adding it back
+    assert {s: r._candidates(session=s)[0][0]
+            for s in sessions} == pin0       # restores every pin
+    r.close()
+
+
 def test_slo_predicted_quantile():
     t = SloTracker([Objective('q', 1.0, window_s=60.0)])
     now = time.perf_counter()
