@@ -457,12 +457,16 @@ def bench_pipeline_ablation(model='transformer', steps=20, batch=None,
 def bench_decode(duration=8.0, clients=8, max_batch=16, block_size=32,
                  num_blocks=512, pages_per_seq=16, vocab=8000, n_layer=4,
                  n_head=8, d_model=256, d_inner=512, prompt_lo=16,
-                 prompt_hi=64, max_new=64):
+                 prompt_hi=64, max_new=64, shared_prefix=0.95,
+                 shared_prefix_len=None, spec_k=3):
     """Decode-serving scenario: continuous batching + paged KV cache
-    (serving/decode) under closed-loop streaming clients. Reports
-    tokens/sec and inter-token latency; decode.* histograms (and a
-    decode.bench_tokens_per_s gauge) land in the metrics JSONL beside
-    the results store."""
+    (serving/decode) under closed-loop streaming clients, on the
+    fleet-realistic traffic mix (``shared_prefix`` of requests open
+    with one shared system prompt). Two legs ablate speculative
+    decoding off/on over the global prefix cache; cache-hit-rate,
+    prefill-tokens-skipped, and accepted-draft-length land in the
+    metrics JSONL (decode.prefix_* / decode.spec_*) beside tokens/sec
+    and inter-token latency."""
     import threading
 
     from paddle_tpu import observe
@@ -473,63 +477,122 @@ def bench_decode(duration=8.0, clients=8, max_batch=16, block_size=32,
     spec = LMSpec(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
                   d_key=d_head, d_value=d_head, d_model=d_model,
                   d_inner=d_inner)
-    engine = DecodeEngine(spec, max_batch=max_batch,
-                          block_size=block_size, num_blocks=num_blocks,
-                          pages_per_seq=pages_per_seq,
-                          max_queue_depth=4 * clients)
-    prompt_hi = min(prompt_hi, engine.capacity - max_new)
-    t_w0 = time.time()
-    signatures = engine.warmup()
-    warmup_s = time.time() - t_w0
-    engine.start()
+    capacity = pages_per_seq * block_size
+    prompt_hi = min(prompt_hi, capacity - max_new)
+    n_shared = shared_prefix_len or max(block_size,
+                                        (prompt_lo + prompt_hi) // 2)
+    n_shared = min(n_shared, max(1, prompt_hi - 1))
+    shared_ids = np.random.RandomState(1234).randint(
+        0, vocab, n_shared).tolist()
 
-    stats = Stats()
-    gaps, tokens = [], [0]
-    mu = threading.Lock()
+    def counter_delta(after, before, name):
+        return after['counters'].get(name, 0) - \
+            before['counters'].get(name, 0)
 
-    def do_request(rng):
-        plen = int(rng.randint(prompt_lo, prompt_hi + 1))
-        stream = engine.submit(rng.randint(0, vocab, plen).tolist(),
-                               max_new_tokens=max_new)
-        n, t_prev, local = 0, None, []
-        for _tok in stream:
-            now = time.perf_counter()
-            if t_prev is not None:
-                local.append(now - t_prev)
-            t_prev = now
-            n += 1
-        with mu:
-            gaps.extend(local)
-            tokens[0] += n
-        return n
+    def run_leg(leg_spec_k):
+        engine = DecodeEngine(spec, max_batch=max_batch,
+                              block_size=block_size,
+                              num_blocks=num_blocks,
+                              pages_per_seq=pages_per_seq,
+                              max_queue_depth=4 * clients,
+                              prefix_cache=True, spec_k=leg_spec_k)
+        t_w0 = time.time()
+        signatures = engine.warmup()
+        warmup_s = time.time() - t_w0
+        engine.start()
 
-    t0 = time.perf_counter()
-    closed_loop(do_request, stats, t0 + duration, clients)
-    engine.shutdown(drain=True)
-    wall = time.perf_counter() - t0
-    snap = observe.snapshot()
-    occ = snap['histograms'].get('decode.batch_occupancy', {})
-    tps = tokens[0] / wall if wall else 0.0
-    observe.set_gauge('decode.bench_tokens_per_s', tps)
-    return {
+        stats = Stats()
+        gaps, tokens = [], [0]
+        mu = threading.Lock()
+
+        def do_request(rng):
+            plen = int(rng.randint(prompt_lo, prompt_hi + 1))
+            if rng.rand() < shared_prefix:
+                tail = max(1, plen - n_shared)
+                prompt = shared_ids + \
+                    rng.randint(0, vocab, tail).tolist()
+            else:
+                prompt = rng.randint(0, vocab, plen).tolist()
+            stream = engine.submit(prompt, max_new_tokens=max_new)
+            n, t_prev, local = 0, None, []
+            for _tok in stream:
+                now = time.perf_counter()
+                if t_prev is not None:
+                    local.append(now - t_prev)
+                t_prev = now
+                n += 1
+            with mu:
+                gaps.extend(local)
+                tokens[0] += n
+            return n
+
+        before = observe.snapshot()
+        t0 = time.perf_counter()
+        closed_loop(do_request, stats, t0 + duration, clients)
+        engine.shutdown(drain=True)
+        wall = time.perf_counter() - t0
+        snap = observe.snapshot()
+        occ = snap['histograms'].get('decode.batch_occupancy', {})
+        acc = snap['histograms'].get('decode.spec_accepted_len', {})
+        tps = tokens[0] / wall if wall else 0.0
+        hit = counter_delta(
+            snap, before,
+            'decode.prefix_cache_lookups_total{outcome=hit}')
+        miss = counter_delta(
+            snap, before,
+            'decode.prefix_cache_lookups_total{outcome=miss}')
+        spec_steps = counter_delta(snap, before,
+                                   'decode.spec_steps_total')
+        accepted = counter_delta(snap, before,
+                                 'decode.spec_accepted_tokens_total')
+        return {
+            'spec_k': leg_spec_k,
+            'tokens_per_s': round(tps, 2),
+            'tokens': tokens[0],
+            'requests_ok': stats.ok,
+            'duration_s': round(wall, 3),
+            'inter_token_ms': percentiles(gaps),
+            'request_ms': percentiles(stats.latencies),
+            'batch_occupancy_mean': occ.get('mean'),
+            'preemptions': counter_delta(snap, before,
+                                         'decode.preemptions_total'),
+            'cache_hit_rate': round(hit / float(hit + miss), 4)
+            if (hit + miss) else None,
+            'prefill_tokens_skipped': counter_delta(
+                snap, before, 'decode.prefix_tokens_reused_total'),
+            'accepted_draft_len_mean': acc.get('mean')
+            if spec_steps else None,
+            'accepted_draft_len_p50': acc.get('p50')
+            if spec_steps else None,
+            'accepted_tokens_total': accepted,
+            'warmup': {'signatures': signatures,
+                       'seconds': round(warmup_s, 3)},
+        }
+
+    legs = {'spec_off': run_leg(0)}
+    if spec_k:
+        legs['spec_on'] = run_leg(spec_k)
+    head = legs.get('spec_on') or legs['spec_off']
+    observe.set_gauge('decode.bench_tokens_per_s',
+                      head['tokens_per_s'])
+    out = dict(head)
+    out.update({
         'workload': 'decode_transformer',
-        'tokens_per_s': round(tps, 2),
-        'tokens': tokens[0],
-        'requests_ok': stats.ok,
-        'duration_s': round(wall, 3),
-        'inter_token_ms': percentiles(gaps),
-        'request_ms': percentiles(stats.latencies),
-        'batch_occupancy_mean': occ.get('mean'),
-        'preemptions': snap['counters'].get(
-            'decode.preemptions_total', 0),
-        'warmup': {'signatures': signatures,
-                   'seconds': round(warmup_s, 3)},
+        'shared_prefix': shared_prefix,
+        'shared_prefix_len': n_shared,
+        'spec_ablation': legs,
+        'spec_speedup': round(
+            legs['spec_on']['tokens_per_s'] /
+            legs['spec_off']['tokens_per_s'], 3)
+        if 'spec_on' in legs and legs['spec_off']['tokens_per_s']
+        else None,
         'engine': {'max_batch': max_batch, 'block_size': block_size,
                    'num_blocks': num_blocks,
                    'pages_per_seq': pages_per_seq},
         'model': {'vocab': vocab, 'n_layer': n_layer, 'n_head': n_head,
                   'd_model': d_model},
-    }
+    })
+    return out
 
 
 class _ChaosPredictor(object):

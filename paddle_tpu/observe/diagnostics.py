@@ -179,10 +179,16 @@ def _decode_status(snap):
                for k in list(gauges) + list(counters)):
         return None
     finished = {}
+    lookups = {}
     for rendered, v in counters.items():
         name, labels = parse_rendered(rendered)
         if name == 'decode.finished_total':
             finished[labels.get('reason', '?')] = v
+        elif name == 'decode.prefix_cache_lookups_total':
+            lookups[labels.get('outcome', '?')] = v
+    looked = sum(lookups.values())
+    spec_steps = counters.get('decode.spec_steps_total', 0)
+    accepted = counters.get('decode.spec_accepted_tokens_total', 0)
     return {
         'running_seqs': gauges.get('decode.running_seqs'),
         'waiting_seqs': gauges.get('decode.waiting_seqs'),
@@ -196,6 +202,19 @@ def _decode_status(snap):
         'pool_exhausted_total':
             counters.get('decode.pool_exhausted_total'),
         'finished_total': finished,
+        # prefix cache: hit rate over lookups, tokens whose prefill
+        # was skipped, resident cached pages, LRU evictions
+        'prefix_cache_hit_rate':
+            (lookups.get('hit', 0) / float(looked)) if looked else None,
+        'prefix_tokens_reused_total':
+            counters.get('decode.prefix_tokens_reused_total'),
+        'prefix_cache_pages': gauges.get('decode.prefix_cache_pages'),
+        'prefix_evictions_total':
+            counters.get('decode.prefix_evictions_total'),
+        # speculative decoding: mean accepted draft length per step
+        'spec_steps_total': spec_steps or None,
+        'spec_accepted_len_mean':
+            (accepted / float(spec_steps)) if spec_steps else None,
     }
 
 

@@ -1,13 +1,16 @@
 """Paged incremental-decode ops for the decode-serving engine.
 
-Two IR ops over a decoder-only (GPT-block) transformer whose KV cache
-lives in a paged pool (ops/pallas/paged_attention.py layouts):
+Three IR ops over a decoder-only (GPT-block) transformer whose KV
+cache lives in a paged pool (ops/pallas/paged_attention.py layouts):
 
-- ``paged_prefill`` — run ONE padded prompt [1, S] densely through the
-  stack (causal attention, fp32 softmax), write each position's K/V
-  into the sequence's pages through its block table, and emit the
-  first generated token. S is bucketed by the engine so the signature
-  set is small and warmable.
+- ``paged_prefill`` — extend a sequence whose first ``Cached`` tokens
+  already have KV materialized (prefix-cache hit; ``Cached == 0`` is
+  the cold case) by a padded suffix [1, S]: write each suffix
+  position's K/V into the sequence's pages through its block table,
+  attend each suffix query against the table at its own absolute
+  length (one ragged paged-attention pass — S queries, per-query
+  lengths cached+1 .. cached+S), and emit the next token. S is
+  bucketed by the engine so the signature set is small and warmable.
 - ``paged_decode_step`` — one token for EVERY slot of a fixed-size
   decode batch [B]: append each sequence's K/V at its own position
   (scatter through the block table; rows whose table entry is >= NB
@@ -17,19 +20,33 @@ lives in a paged pool (ops/pallas/paged_attention.py layouts):
   sequences occupy which slots — the continuous-batching scheduler
   swaps sequences in and out without ever producing a new XLA
   signature (zero steady-state cache misses).
+- ``paged_spec_verify`` — speculative-decoding verification: score
+  ``k+1`` tokens (the pending token + k draft proposals) for every
+  slot of the [B] batch in ONE ragged paged-attention pass over
+  ``B*(k+1)`` mixed-length rows (row (b, j) attends at length
+  lens[b]+j+1 — exactly the ragged shape the paged kernel was built
+  for). ``k`` is a static attr, so the verify step is one more fixed
+  signature beside the decode step's. Writes K/V for all k+1
+  positions; the engine's longest-accepted-prefix rule decides how
+  many become real (rejected positions sit above the advanced
+  ``cache_len`` and are overwritten before they can be read).
 
 Per-row math mirrors the incremental-decode path in
 transformer_ops.py (``_incremental_layer_scan``): the layer stack is
 one ``lax.scan`` over [L, ...]-stacked weights, residual+LN via
 ``fused_layer_norm``. Every per-row computation is independent of the
-other rows, so a sequence's token stream is bit-identical whether it
-decodes alone or packed into a full batch — the invariant
-tests/test_decode_serving.py's continuous-batching e2e asserts.
+other rows — and all three ops attend through the same
+``paged_attention`` gather over the same [P*bs] extent — so a
+sequence's token stream is bit-identical whether it decodes alone,
+packed into a full batch, resumed from a cached prefix, or advanced
+k-at-a-time under speculation: the invariant
+tests/test_decode_serving.py's e2es assert.
 
 Sampling: token at position i draws from
 ``categorical(fold_in(PRNGKey(seed), i), logits / temp)`` (greedy at
 temp == 0), so a request's stream depends only on (seed, positions),
-never on batch composition or a global step counter.
+never on batch composition, speculation depth, or a global step
+counter.
 """
 
 import jax
@@ -39,8 +56,6 @@ from ..core.registry import register
 from .transformer_ops import ENC_SLOTS, _slot_to_input
 
 LM_SLOTS = ENC_SLOTS   # decoder-only block reuses the encoder slot layout
-
-_NEG_INF = -1e9
 
 
 def _split_heads(x, n_head):
@@ -91,12 +106,8 @@ def _lm_inputs(ctx):
 
 @register('paged_decode_step')
 def _paged_decode_step(ctx):
-    from .pallas.paged_attention import paged_attention
-
     emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
     n_head = ctx.attr('n_head', 1)
-    bs = kcs.shape[3]
-    d_model = emb.shape[-1]
 
     tokens = ctx.input('Tokens').reshape(-1).astype(jnp.int32)     # [B]
     lens = ctx.input('SeqLens').reshape(-1).astype(jnp.int32)      # [B]
@@ -104,30 +115,12 @@ def _paged_decode_step(ctx):
     temps = ctx.input('Temps').reshape(-1).astype(jnp.float32)
     seeds = ctx.input('Seeds').reshape(-1).astype(jnp.int32)
 
-    # this token's page: logical block lens // bs through the table
-    # (empty slots feed all->NB tables, so phys lands out of bounds and
-    # every write below drops)
-    logical = jnp.clip(lens // bs, 0, tables.shape[1] - 1)
-    phys = jnp.take_along_axis(tables, logical[:, None], axis=1)[:, 0]
-    off = lens % bs
-
-    x = jnp.take(emb, tokens, axis=0) * (d_model ** 0.5) + \
-        jnp.take(pos_enc, lens, axis=0)
-
-    def body(h, sl):
-        p, kc, vc = sl
-        k_new = _split_heads(h @ p['slf_k'], n_head)       # [B, H, dk]
-        v_new = _split_heads(h @ p['slf_v'], n_head)
-        kc = _write_positions(kc, k_new, phys, off)
-        vc = _write_positions(vc, v_new, phys, off)
-        q = _split_heads(h @ p['slf_q'], n_head)
-        attn = paged_attention(q, kc, vc, tables, lens + 1)
-        h = _ln(h + attn.reshape(h.shape[0], -1) @ p['slf_o'], p, 'ln1')
-        h = _ln(h + _ffn(h, p), p, 'ln2')
-        return h, (kc, vc)
-
-    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
-    logits = (h @ wout).astype(jnp.float32)                # [B, V]
+    # one new token per row at position lens (empty slots feed all->NB
+    # tables, so phys lands out of bounds and every write drops)
+    live = jnp.ones(lens.shape, dtype=bool)
+    logits, kcs, vcs = _extend_rows(
+        emb, pos_enc, wout, params, kcs, vcs, n_head,
+        tokens, lens, live, tables)
     nxt = jax.vmap(_sample_token)(logits, seeds, lens + 1, temps)
     ctx.set_output('NextTokens',
                    nxt.astype(ctx.out_dtype('NextTokens', 'int64')))
@@ -135,53 +128,108 @@ def _paged_decode_step(ctx):
     ctx.set_output('VCacheOut', vcs)
 
 
+def _extend_rows(emb, pos_enc, wout, params, kcs, vcs, n_head,
+                 tokens, pos, live, tables):
+    """Shared core of prefill and spec-verify: write N new tokens'
+    K/V at absolute positions ``pos`` through per-row block
+    ``tables`` [N, P], attend each row at its own ragged length
+    (``pos + 1``), and return fp32 logits [N, V] plus the updated
+    arenas. Rows that are not ``live``, sit past the table's capacity,
+    or hit a table entry >= NB drop their writes (padded tails /
+    empty batch slots)."""
+    from .pallas.paged_attention import paged_attention
+    bs = kcs.shape[3]
+    nb = kcs.shape[1]
+    d_model = emb.shape[-1]
+    p_cap = tables.shape[1]
+
+    logical = jnp.clip(pos // bs, 0, p_cap - 1)
+    phys = jnp.take_along_axis(tables, logical[:, None], axis=1)[:, 0]
+    phys = jnp.where((pos < p_cap * bs) & live, phys, nb)
+    off = pos % bs
+
+    x = jnp.take(emb, tokens, axis=0) * (d_model ** 0.5) + \
+        jnp.take(pos_enc, pos, axis=0, mode='clip')
+    att_lens = pos + 1
+
+    def body(h, sl):
+        p, kc, vc = sl
+        k_new = _split_heads(h @ p['slf_k'], n_head)       # [N, H, dk]
+        v_new = _split_heads(h @ p['slf_v'], n_head)
+        kc = _write_positions(kc, k_new, phys, off)
+        vc = _write_positions(vc, v_new, phys, off)
+        q = _split_heads(h @ p['slf_q'], n_head)
+        attn = paged_attention(q, kc, vc, tables, att_lens)
+        h = _ln(h + attn.reshape(h.shape[0], -1) @ p['slf_o'], p, 'ln1')
+        h = _ln(h + _ffn(h, p), p, 'ln2')
+        return h, (kc, vc)
+
+    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
+    return (h @ wout).astype(jnp.float32), kcs, vcs
+
+
 @register('paged_prefill')
 def _paged_prefill(ctx):
     emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
     n_head = ctx.attr('n_head', 1)
-    bs = kcs.shape[3]
-    nb = kcs.shape[1]
-    d_model = emb.shape[-1]
-    dk = params['slf_q'].shape[-1] // n_head
 
     ids = ctx.input('Ids').reshape(-1).astype(jnp.int32)   # [S] (padded)
     length = ctx.input('Len').reshape(()).astype(jnp.int32)
+    cached = ctx.input('Cached').reshape(()).astype(jnp.int32)
     table = ctx.input('BlockTable').astype(jnp.int32).reshape(-1)  # [P]
     temp = ctx.input('Temp').reshape(()).astype(jnp.float32)
     seed = ctx.input('Seed').reshape(()).astype(jnp.int32)
     s = ids.shape[0]
 
+    # suffix position t lives at absolute position cached + t; its
+    # query attends to everything at or below it — the cached pages
+    # plus this step's own earlier writes — through the table gather
     t_idx = jnp.arange(s, dtype=jnp.int32)
-    logical = jnp.clip(t_idx // bs, 0, table.shape[0] - 1)
-    phys = jnp.where(t_idx < length, table[logical], nb)   # nb => drop
-    off = t_idx % bs
+    pos = cached + t_idx
+    tables = jnp.broadcast_to(table, (s, table.shape[0]))
+    logits, kcs, vcs = _extend_rows(
+        emb, pos_enc, wout, params, kcs, vcs, n_head,
+        ids, pos, t_idx < length, tables)
 
-    x = jnp.take(emb, ids, axis=0) * (d_model ** 0.5) + pos_enc[:s]
-
-    causal = t_idx[:, None] >= t_idx[None, :]              # [S, S]
-
-    def body(h, sl):
-        p, kc, vc = sl
-        k3 = _split_heads(h @ p['slf_k'], n_head)          # [S, H, dk]
-        v3 = _split_heads(h @ p['slf_v'], n_head)
-        kc = _write_positions(kc, k3, phys, off)
-        vc = _write_positions(vc, v3, phys, off)
-        q3 = _split_heads(h @ p['slf_q'], n_head)
-        logits = jnp.einsum('qhd,khd->hqk', q3 * (dk ** -0.5), k3)
-        logits = jnp.where(causal[None], logits, _NEG_INF)
-        w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        attn = jnp.einsum('hqk,khd->qhd', w.astype(v3.dtype), v3)
-        h = _ln(h + attn.reshape(s, -1) @ p['slf_o'], p, 'ln1')
-        h = _ln(h + _ffn(h, p), p, 'ln2')
-        return h, (kc, vc)
-
-    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
-    h_last = jax.lax.dynamic_index_in_dim(
-        h, jnp.maximum(length - 1, 0), keepdims=False)
-    logits = (h_last @ wout).astype(jnp.float32)           # [V]
-    nxt = _sample_token(logits, seed, length, temp)
+    logits_last = jax.lax.dynamic_index_in_dim(
+        logits, jnp.maximum(length - 1, 0), keepdims=False)     # [V]
+    nxt = _sample_token(logits_last, seed, cached + length, temp)
     ctx.set_output('NextToken',
                    nxt.reshape(1).astype(ctx.out_dtype('NextToken',
                                                        'int64')))
+    ctx.set_output('KCacheOut', kcs)
+    ctx.set_output('VCacheOut', vcs)
+
+
+@register('paged_spec_verify')
+def _paged_spec_verify(ctx):
+    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    n_head = ctx.attr('n_head', 1)
+
+    tokens = ctx.input('Tokens').astype(jnp.int32)         # [B, K1]
+    lens = ctx.input('SeqLens').reshape(-1).astype(jnp.int32)   # [B]
+    tables = ctx.input('BlockTables').astype(jnp.int32)    # [B, P]
+    temps = ctx.input('Temps').reshape(-1).astype(jnp.float32)
+    seeds = ctx.input('Seeds').reshape(-1).astype(jnp.int32)
+    b, k1 = tokens.shape
+
+    # flatten to B*K1 single-token rows: row (b, j) holds the j-th
+    # speculative token at absolute position lens[b] + j and attends
+    # at its own length — one ragged paged-attention batch scores the
+    # whole tree of proposals (empty slots ride along exactly as in
+    # the decode step: all-NB tables drop every write)
+    j = jnp.arange(k1, dtype=jnp.int32)
+    pos = (lens[:, None] + j[None, :]).reshape(-1)         # [B*K1]
+    tables_rep = jnp.repeat(tables, k1, axis=0)            # [B*K1, P]
+    live = jnp.ones(pos.shape, dtype=bool)
+    logits, kcs, vcs = _extend_rows(
+        emb, pos_enc, wout, params, kcs, vcs, n_head,
+        tokens.reshape(-1), pos, live, tables_rep)
+
+    nxt = jax.vmap(_sample_token)(
+        logits, jnp.repeat(seeds, k1), pos + 1, jnp.repeat(temps, k1))
+    ctx.set_output('NextTokens',
+                   nxt.reshape(b, k1).astype(
+                       ctx.out_dtype('NextTokens', 'int64')))
     ctx.set_output('KCacheOut', kcs)
     ctx.set_output('VCacheOut', vcs)

@@ -21,12 +21,25 @@ workload — over a decoder-only LM with a paged KV cache:
 - **pool exhaustion** preempts the youngest running sequence
   (recompute-style requeue, scheduler.py) rather than failing it;
   flight events + counters make the resulting latency spikes
-  explainable post-hoc (tools/flight_report.py).
+  explainable post-hoc (tools/flight_report.py). The prefix cache's
+  LRU evictor runs first — reclaimable cached pages feed the free
+  list before any victim is chosen.
+- **global prefix cache** (`prefix_cache=True` or
+  PADDLE_TPU_PREFIX_CACHE=1): frozen full pages are published to a
+  radix trie keyed by token chains; a new request whose prompt hits a
+  cached chain maps the shared pages and prefills only the uncached
+  suffix — time-to-first-token drops by the shared span's cost.
+- **speculative decoding** (`spec_k=K` or PADDLE_TPU_SPEC_K=K): a
+  host-side draft (prompt-lookup n-gram by default, pluggable via
+  ``draft=``) proposes k tokens per running sequence and ONE
+  `paged_spec_verify` dispatch — a fixed [max_batch, k+1] signature —
+  scores every proposal; longest-accepted-prefix acceptance emits
+  up to k+1 tokens per step, bit-identical to plain decode.
 
 Per-row device math is batch-composition-independent, so each
 request's token stream is bit-identical to running it alone —
-continuous batching is a pure throughput win, never a correctness
-trade.
+continuous batching, prefix caching, and speculation are pure
+throughput wins, never a correctness trade.
 """
 
 import itertools
@@ -44,7 +57,9 @@ from ..buckets import pow2_ladder
 from ..engine import EngineClosedError, QueueFullError
 from .kv_pool import KVPool
 from .model import LMSpec, build_lm_programs
+from .prefix_cache import PrefixCache, prefix_cache_enabled
 from .scheduler import RUNNING, Scheduler, Sequence
+from .spec import NgramDraft, accept_drafts, spec_k_from_env
 
 __all__ = ['DecodeEngine', 'LMSpec']
 
@@ -72,16 +87,27 @@ class DecodeEngine(object):
 
     def __init__(self, spec, max_batch=8, block_size=16, num_blocks=64,
                  pages_per_seq=8, max_queue_depth=64, max_prompt_len=None,
-                 place=None, weights=None):
+                 place=None, weights=None, prefix_cache=None, spec_k=None,
+                 draft=None):
         self.spec = spec
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.pages_per_seq = int(pages_per_seq)
         self.max_queue_depth = int(max_queue_depth)
+        # feature knobs: explicit constructor args win, else the env
+        # (PADDLE_TPU_PREFIX_CACHE / PADDLE_TPU_SPEC_K, read here — at
+        # call time — never at import). spec_k is folded into the
+        # verify Program as a static attr: one extra fixed signature,
+        # zero recompiles however the scheduler batches.
+        self.prefix_cache_on = prefix_cache_enabled(prefix_cache)
+        self.spec_k = spec_k_from_env(spec_k)
+        self.draft = draft if draft is not None else \
+            (NgramDraft() if self.spec_k > 0 else None)
         self._progs = build_lm_programs(spec, self.max_batch,
                                         self.block_size, self.num_blocks,
-                                        self.pages_per_seq)
+                                        self.pages_per_seq,
+                                        spec_k=self.spec_k)
         # static IR verification of all three programs before anything
         # compiles (default warn; PADDLE_TPU_VERIFY=strict refuses a
         # broken graph at construction, not mid-traffic)
@@ -96,6 +122,11 @@ class DecodeEngine(object):
             self._progs.decode,
             fetch_names=[self._progs.decode_fetch],
             label='decode_step')
+        if self._progs.verify is not None:
+            _analysis.startup_verify(
+                self._progs.verify,
+                fetch_names=[self._progs.verify_fetch],
+                label='decode_spec_verify')
         self.capacity = self._progs.capacity
         self.max_prompt_len = int(max_prompt_len) if max_prompt_len \
             else self.capacity - 1
@@ -109,7 +140,10 @@ class DecodeEngine(object):
             self.load_weights(weights)
 
         self.pool = KVPool(self.num_blocks, self.block_size)
-        self._sched = Scheduler(self.pool, self.max_batch)
+        self.prefix_cache = PrefixCache(self.pool) \
+            if self.prefix_cache_on else None
+        self._sched = Scheduler(self.pool, self.max_batch,
+                                cache=self.prefix_cache)
         self._mu = threading.Condition(threading.Lock())
         self._done_cv = threading.Condition(threading.Lock())
         self._unfinished = 0
@@ -235,12 +269,13 @@ class DecodeEngine(object):
 
     def warmup(self):
         """AOT-compile every signature live traffic can produce: one
-        prefill per prompt bucket plus the single decode-step key.
-        Warmup feeds point every block-table entry past the pool (all
-        writes drop), so device state is untouched. Returns the
-        signature count."""
+        prefill per prompt bucket, the single decode-step key, and —
+        with speculation on — the single spec-verify key. Warmup feeds
+        point every block-table entry past the pool (all writes drop),
+        so device state is untouched. Returns the signature count."""
         t_all = time.perf_counter()
         nb = self.num_blocks
+        mb, pps = self.max_batch, self.pages_per_seq
         # AOT warm start: every warmup dispatch consults the serialized-
         # executable cache (core/aot_cache.py); a restarted replica
         # deserializes its prefill buckets + decode key instead of
@@ -248,21 +283,32 @@ class DecodeEngine(object):
         aot0 = dict(self._exe.aot_stats)
         for b in self.prompt_buckets:
             t0 = time.perf_counter()
-            self._run_prefill(np.zeros((1, b), 'int64'), 1,
-                              np.full((1, self.pages_per_seq), nb, 'int32'),
-                              0.0, 0)
+            self._run_prefill(np.zeros((1, b), 'int64'), 1, 0,
+                              np.full((1, pps), nb, 'int32'), 0.0, 0)
             _obs.record('decode.warmup_seconds',
                         time.perf_counter() - t0, kind='prefill', bucket=b)
         t0 = time.perf_counter()
         self._run_decode(
-            np.zeros((self.max_batch,), 'int64'),
-            np.zeros((self.max_batch,), 'int32'),
-            np.full((self.max_batch, self.pages_per_seq), nb, 'int32'),
-            np.zeros((self.max_batch,), 'float32'),
-            np.zeros((self.max_batch,), 'int32'))
+            np.zeros((mb,), 'int64'),
+            np.zeros((mb,), 'int32'),
+            np.full((mb, pps), nb, 'int32'),
+            np.zeros((mb,), 'float32'),
+            np.zeros((mb,), 'int32'))
         _obs.record('decode.warmup_seconds', time.perf_counter() - t0,
                     kind='decode', bucket='')
         self.warmup_signatures = len(self.prompt_buckets) + 1
+        if self.spec_k > 0:
+            t0 = time.perf_counter()
+            self._run_verify(
+                np.zeros((mb, self.spec_k + 1), 'int64'),
+                np.zeros((mb,), 'int32'),
+                np.full((mb, pps), nb, 'int32'),
+                np.zeros((mb,), 'float32'),
+                np.zeros((mb,), 'int32'))
+            _obs.record('decode.warmup_seconds',
+                        time.perf_counter() - t0, kind='spec_verify',
+                        bucket='')
+            self.warmup_signatures += 1
         self._warmed = True
         _obs.set_gauge('decode.warmup_signatures', self.warmup_signatures)
         _obs.set_gauge('decode.warmup_total_seconds',
@@ -307,6 +353,10 @@ class DecodeEngine(object):
         if not drain or not self._started:
             self._fail_remaining(EngineClosedError(
                 'DecodeEngine shut down without draining'))
+        if self.prefix_cache is not None:
+            # drop the cache's page references so the pool drains to
+            # its initial free count (the cache dies with the engine)
+            self.prefix_cache.clear()
 
     def close(self):
         self.shutdown(drain=True)
@@ -374,17 +424,28 @@ class DecodeEngine(object):
             self._prefill(seq)
 
     # ----------------------------------------------------------- dispatch
-    def _run_prefill(self, ids, length, table, temp, seed):
+    def _run_prefill(self, ids, length, cached, table, temp, seed):
         with scope_guard(self._scope):
             out = self._exe.run(
                 program=self._progs.prefill,
                 feed={'pf_ids': ids,
                       'pf_len': np.asarray([length], 'int32'),
+                      'pf_cached': np.asarray([cached], 'int32'),
                       'pf_table': table,
                       'pf_temp': np.asarray([temp], 'float32'),
                       'pf_seed': np.asarray([seed], 'int32')},
                 fetch_list=[self._progs.prefill_fetch])
         return int(np.asarray(out[0]).reshape(-1)[0])
+
+    def _run_verify(self, tokens, lens, tables, temps, seeds):
+        with scope_guard(self._scope):
+            out = self._exe.run(
+                program=self._progs.verify,
+                feed={'sv_tokens': tokens, 'sv_lens': lens,
+                      'sv_tables': tables, 'sv_temps': temps,
+                      'sv_seeds': seeds},
+                fetch_list=[self._progs.verify_fetch])
+        return np.asarray(out[0]).reshape(tokens.shape)
 
     def _run_decode(self, tokens, lens, tables, temps, seeds):
         with scope_guard(self._scope):
@@ -410,27 +471,54 @@ class DecodeEngine(object):
         return row
 
     def _prefill(self, seq):
+        """Prefill the uncached suffix of ``seq.prefix()`` — the whole
+        prefix on a cache miss, only the tokens past the matched span
+        on a hit (the hit's pages are already mapped in the block
+        table; the suffix bucket, not the prompt bucket, sets the
+        dispatch cost — that is the TTFT win)."""
         prefix = seq.prefix()
         s = len(prefix)
-        bucket = self._bucket(s)
+        cached = seq.cached_len
+        suffix = prefix[cached:]
+        bucket = self._bucket(len(suffix))
         ids = np.zeros((1, bucket), 'int64')
-        ids[0, :s] = prefix
+        ids[0, :len(suffix)] = suffix
         t0 = time.perf_counter()
-        tok = self._run_prefill(ids, s, self._table_row(seq)[None, :],
+        tok = self._run_prefill(ids, len(suffix), cached,
+                                self._table_row(seq)[None, :],
                                 seq.temperature, seq.seed)
         t1 = time.perf_counter()
         _obs.record('decode.prefill_seconds', t1 - t0, bucket=bucket)
         _obs.inc('decode.prefills_total')
+        if cached:
+            _obs.flight_event('decode_prefix_hit',
+                              request_id=seq.request_id,
+                              cached_tokens=cached, prefix_tokens=s)
         if seq.ctx is not None and seq.ctx.sampled:
             seq.ctx.stage('prefill', t0, t1, bucket=bucket,
-                          prefix_tokens=s)
+                          prefix_tokens=s, cached_tokens=cached)
         seq.cache_len = s
+        self._maybe_publish(seq)
         self._emit(seq, tok, time.perf_counter())
         reason = seq.finished()
         if reason:
             self._finish(seq, reason)
 
+    def _maybe_publish(self, seq):
+        """Offer every newly frozen (full) page to the prefix cache.
+        Called whenever cache_len may have crossed a page boundary;
+        cheap no-op otherwise."""
+        if self.prefix_cache is None:
+            return
+        full = seq.cache_len // self.block_size
+        if full > seq.published_pages:
+            self.prefix_cache.publish(seq.prefix(), seq.table,
+                                      seq.cache_len)
+            seq.published_pages = full
+
     def _decode_step(self):
+        if self.spec_k > 0 and self._spec_step():
+            return
         for seq in list(self._sched.running):
             if seq.state is not RUNNING:
                 continue   # preempted as a victim earlier in this pass
@@ -458,14 +546,87 @@ class DecodeEngine(object):
         _obs.inc('decode.steps_total')
         for i, seq in enumerate(batch):
             seq.cache_len += 1
+            self._maybe_publish(seq)
             self._emit(seq, int(nxt[i]), now)
             reason = seq.finished()
             if reason:
                 self._finish(seq, reason)
 
+    def _spec_step(self):
+        """Draft-and-verify decode: the draft proposes up to k tokens
+        per running sequence, one fixed-signature ``paged_spec_verify``
+        dispatch scores all k+1 positions per row, and the longest
+        accepted prefix (plus the target's own bonus token) is emitted
+        — up to k+1 tokens per sequence per step, bit-identical to
+        one-at-a-time decode because sampling is (seed, position)-
+        keyed. Returns False (step not taken) when no sequence has a
+        live proposal — the plain decode step is the cheaper warmed
+        signature for that case."""
+        k = self.spec_k
+        pairs = [(s, list(self.draft.propose(s.prefix(), k))[:k])
+                 for s in self._sched.running if s.state is RUNNING]
+        if not any(d for _, d in pairs):
+            return False
+        for seq, _ in pairs:
+            if seq.state is not RUNNING:
+                continue   # preempted as a victim earlier in this pass
+            self._sched.ensure_growth(
+                seq, min(seq.cache_len + k + 1, self.capacity))
+        # ensure_growth may have preempted members of this very batch
+        pairs = [(s, d) for s, d in pairs if s.state is RUNNING]
+        if not pairs:
+            return True
+        mb, pps, nb = self.max_batch, self.pages_per_seq, self.num_blocks
+        tokens = np.zeros((mb, k + 1), 'int64')
+        lens = np.zeros((mb,), 'int32')
+        tables = np.full((mb, pps), nb, 'int32')
+        temps = np.zeros((mb,), 'float32')
+        seeds = np.zeros((mb,), 'int32')
+        drafts = []
+        for i, (seq, d) in enumerate(pairs):
+            _obs.inc('decode.spec_draft_tokens_total', len(d))
+            d = d + [0] * (k - len(d))  # padded rows verify for free
+            drafts.append(d)
+            tokens[i, 0] = seq.pending_token
+            tokens[i, 1:] = d
+            lens[i] = seq.cache_len
+            tables[i] = self._table_row(seq)
+            temps[i] = seq.temperature
+            seeds[i] = seq.seed
+        t0 = time.perf_counter()
+        nxt = self._run_verify(tokens, lens, tables, temps, seeds)
+        now = time.perf_counter()
+        _obs.record('decode.step_seconds', now - t0)
+        _obs.record('decode.batch_occupancy', len(pairs) / float(mb))
+        _obs.inc('decode.steps_total')
+        _obs.inc('decode.spec_steps_total')
+        for i, (seq, _) in enumerate(pairs):
+            emit = accept_drafts(drafts[i], nxt[i])
+            _obs.record('decode.spec_accepted_len', len(emit) - 1)
+            _obs.inc('decode.spec_accepted_tokens_total', len(emit) - 1)
+            for tok in emit:
+                seq.cache_len += 1
+                self._maybe_publish(seq)
+                self._emit(seq, int(tok), now)
+                reason = seq.finished()
+                if reason:
+                    self._finish(seq, reason)
+                    break
+        return True
+
     def _emit(self, seq, token, now):
         seq.generated.append(token)
         seq.pending_token = token
+        if self.draft is not None and hasattr(self.draft, 'observe'):
+            # online draft training: every target emission teaches the
+            # draft what follows this context window
+            g = seq.generated
+            tail = g[-4:] if len(g) >= 4 else (seq.prompt + g)[-4:]
+            self.draft.observe(tail)
+        if seq.t_first_token is None:
+            seq.t_first_token = now
+            _obs.record('decode.ttft_seconds', now - seq.t_submit,
+                        cached='1' if seq.cached_len else '0')
         if seq.t_last_token is not None:
             _obs.record('decode.inter_token_seconds',
                         now - seq.t_last_token)

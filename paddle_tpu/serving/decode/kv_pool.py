@@ -17,6 +17,14 @@ touched pages are reused first (warm in cache).
 Exhaustion is a normal state, not an error: ``alloc`` returns None and
 the continuous-batching scheduler reacts by preempting a victim
 sequence (freeing its pages, requeueing it) — see scheduler.py.
+
+Cache integration: a global prefix cache (prefix_cache.py) parks
+frozen pages at refcount 1 so future requests can map them instead of
+re-prefilling. Those pages are *reclaimable*, not free — ``alloc``
+consults the installed ``set_reclaimer`` callback before reporting
+exhaustion, so cached pages are LRU-evicted back into the free list on
+demand and the cache can never starve admission (and the scheduler
+only preempts a victim once the cache has nothing left to give).
 """
 
 import threading
@@ -55,7 +63,15 @@ class KVPool(object):
         self._mu = threading.Lock()
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._refs = [0] * self.num_blocks
+        self._reclaimer = None
         self._publish()
+
+    def set_reclaimer(self, fn):
+        """Install ``fn(n) -> freed_count``, consulted by ``alloc``
+        when fewer than ``n`` pages are free. The prefix cache installs
+        its LRU evictor here; ``fn`` is called OUTSIDE the pool lock
+        (it frees pages through ``free``, which takes it)."""
+        self._reclaimer = fn
 
     # ------------------------------------------------------------ stats
     def free_blocks(self):
@@ -83,20 +99,29 @@ class KVPool(object):
         return max(0, (int(n_tokens) + self.block_size - 1)
                    // self.block_size)
 
+    def refcount(self, page_id):
+        with self._mu:
+            return self._refs[page_id]
+
     # ------------------------------------------------------- alloc/free
     def alloc(self, n):
         """Claim ``n`` pages (refcount 1 each). Returns the page-id list,
         or None when fewer than ``n`` are free — the caller decides
-        whether that means preempt, wait, or reject."""
+        whether that means preempt, wait, or reject. A shortfall first
+        asks the installed reclaimer (prefix-cache LRU eviction) to top
+        the free list back up before giving up."""
         n = int(n)
-        with self._mu:
-            if n > len(self._free):
+        while True:
+            with self._mu:
+                if n <= len(self._free):
+                    ids = [self._free.pop() for _ in range(n)]
+                    for i in ids:
+                        self._refs[i] = 1
+                    self._publish()
+                    return ids
+                short = n - len(self._free)
+            if self._reclaimer is None or self._reclaimer(short) <= 0:
                 return None
-            ids = [self._free.pop() for _ in range(n)]
-            for i in ids:
-                self._refs[i] = 1
-            self._publish()
-            return ids
 
     def grow(self, table, n_tokens):
         """Ensure ``table`` covers ``n_tokens`` positions, allocating
@@ -135,12 +160,22 @@ class KVPool(object):
         ids, table.block_ids = table.block_ids, []
         self.free(ids)
 
-    def fork(self, table):
+    def fork(self, table, frozen_tokens=None):
         """A new BlockTable sharing ``table``'s pages (copy-on-nothing:
         pages are append-only per position, so sharing a frozen prefix
         is safe; the new sequence must grow into fresh pages before
-        writing past the shared prefix)."""
-        self.incref(table.block_ids)
+        writing past the shared prefix).
+
+        ``frozen_tokens`` caps sharing at the last *full* page boundary
+        below it: a page still being appended to (the donor's partial
+        last page) must never be shared — the donor's next decode write
+        would land inside the child's view. With ``frozen_tokens=None``
+        every page is shared and the CALLER promises the donor is
+        frozen (finished, or forked exactly at a page boundary)."""
+        ids = table.block_ids
+        if frozen_tokens is not None:
+            ids = ids[:int(frozen_tokens) // self.block_size]
+        self.incref(ids)
         t = BlockTable()
-        t.block_ids = list(table.block_ids)
+        t.block_ids = list(ids)
         return t

@@ -12,11 +12,17 @@ Scope, all sharing one parameter namespace (prefix ``lm_``):
   through executor donation — in-place HBM updates, the same
   whole-program-state contract the trainer uses for params.
 - **prefill** — the ``paged_prefill`` op over feeds
-  (ids [1, S], len, block table row, temp, seed). S varies by prompt
-  bucket; each bucket is one compile-cache key, enumerated by
-  ``DecodeEngine.warmup()``.
+  (ids [1, S], len, cached-prefix length, block table row, temp,
+  seed). S varies by prompt bucket; each bucket is one compile-cache
+  key, enumerated by ``DecodeEngine.warmup()``. ``pf_cached`` carries
+  the prefix-cache hit length (0 on a miss) — a traced feed, so cache
+  hits of any depth share the bucket's one signature.
 - **decode** — the ``paged_decode_step`` op over fixed [max_batch]
   feeds: ONE signature for the engine's whole lifetime.
+- **verify** (only when ``spec_k > 0``) — the ``paged_spec_verify``
+  op over fixed [max_batch, spec_k+1] feeds: speculative-decoding
+  verification as one more lifetime-fixed signature (k is a static
+  attr, never a shape the scheduler can vary).
 
 A scope trained elsewhere can be served by passing its weights to
 ``DecodeEngine(weights=...)`` — names here are stable and listed in
@@ -56,8 +62,9 @@ class LMSpec(object):
 
 DecodePrograms = collections.namedtuple(
     'DecodePrograms',
-    ['startup', 'prefill', 'decode', 'prefill_fetch', 'decode_fetch',
-     'param_names', 'arena_names', 'capacity'])
+    ['startup', 'prefill', 'decode', 'verify', 'prefill_fetch',
+     'decode_fetch', 'verify_fetch', 'param_names', 'arena_names',
+     'capacity'])
 
 
 def _lm_params(spec, capacity):
@@ -110,10 +117,13 @@ def _common_inputs(stacked, emb, pos, wout, kc, vc):
 
 
 def build_lm_programs(spec, max_batch, block_size, num_blocks,
-                      pages_per_seq):
+                      pages_per_seq, spec_k=0):
     """Returns DecodePrograms. ``capacity`` (= pages_per_seq *
-    block_size) bounds prompt_len + max_new_tokens per sequence."""
+    block_size) bounds prompt_len + max_new_tokens per sequence.
+    ``spec_k > 0`` additionally builds the speculative-decoding
+    verify Program ([max_batch, spec_k+1], one fixed signature)."""
     capacity = int(pages_per_seq) * int(block_size)
+    spec_k = int(spec_k)
     startup = Program()
     prefill_prog = Program()
     decode_prog = Program()
@@ -123,6 +133,7 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
         kc, vc = _arenas(spec, num_blocks, block_size)
         ids = layers.data(name='pf_ids', shape=[-1], dtype='int64')
         length = layers.data(name='pf_len', shape=[], dtype='int32')
+        cached = layers.data(name='pf_cached', shape=[], dtype='int32')
         table = layers.data(name='pf_table', shape=[pages_per_seq],
                             dtype='int32')
         temp = layers.data(name='pf_temp', shape=[], dtype='float32')
@@ -131,7 +142,7 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
         nxt = helper.create_variable_for_type_inference('int64')
         nxt.shape = (1,)
         inputs = _common_inputs(stacked, emb, pos, wout, kc, vc)
-        inputs.update({'Ids': [ids], 'Len': [length],
+        inputs.update({'Ids': [ids], 'Len': [length], 'Cached': [cached],
                        'BlockTable': [table], 'Temp': [temp],
                        'Seed': [seed]})
         helper.append_op(type='paged_prefill', inputs=inputs,
@@ -164,12 +175,45 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
                                 'block_size': int(block_size)})
         decode_fetch = nxt.name
 
+    verify_prog, verify_fetch = None, None
+    if spec_k > 0:
+        verify_prog = Program()
+        with program_guard(verify_prog, startup):
+            stacked, emb, pos, wout = _lm_params(spec, capacity)
+            kc, vc = _arenas(spec, num_blocks, block_size)
+            tokens = layers.data(name='sv_tokens', shape=[spec_k + 1],
+                                 dtype='int64')
+            lens = layers.data(name='sv_lens', shape=[], dtype='int32')
+            tables = layers.data(name='sv_tables', shape=[pages_per_seq],
+                                 dtype='int32')
+            temps = layers.data(name='sv_temps', shape=[],
+                                dtype='float32')
+            seeds = layers.data(name='sv_seeds', shape=[], dtype='int32')
+            helper = LayerHelper('paged_spec_verify',
+                                 name='paged_spec_verify')
+            nxt = helper.create_variable_for_type_inference('int64')
+            nxt.shape = (max_batch, spec_k + 1)
+            inputs = _common_inputs(stacked, emb, pos, wout, kc, vc)
+            inputs.update({'Tokens': [tokens], 'SeqLens': [lens],
+                           'BlockTables': [tables], 'Temps': [temps],
+                           'Seeds': [seeds]})
+            helper.append_op(type='paged_spec_verify', inputs=inputs,
+                             outputs={'NextTokens': [nxt],
+                                      'KCacheOut': [kc],
+                                      'VCacheOut': [vc]},
+                             attrs={'n_head': spec.n_head,
+                                    'block_size': int(block_size),
+                                    'k': spec_k})
+            verify_fetch = nxt.name
+
     param_names = sorted(
         {'lm_emb', 'lm_pos_enc', 'lm_out_proj.w'} |
         {p.name for p in stacked.values()})
     return DecodePrograms(
         startup=startup, prefill=prefill_prog, decode=decode_prog,
+        verify=verify_prog,
         prefill_fetch=prefill_fetch, decode_fetch=decode_fetch,
+        verify_fetch=verify_fetch,
         param_names=param_names,
         arena_names=('lm_kcache', 'lm_vcache'),
         capacity=capacity)

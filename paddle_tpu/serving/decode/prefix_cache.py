@@ -1,0 +1,250 @@
+"""Global radix prefix cache over the paged KV arena.
+
+At production scale most requests share long system prompts and
+few-shot prefixes. ``BlockTable.fork()`` already shares frozen pages
+between *explicit* siblings; this module generalizes that into a
+cache every request consults: a radix tree (trie at page granularity)
+keyed by token chains, where each node is one FULL page of
+``block_size`` tokens and maps to the physical page in the KV pool
+that holds the K/V for exactly those tokens *in that prefix context*.
+Because attention is causal, a page's K/V content is a pure function
+of the token chain from the root — so any request whose prompt starts
+with the same chain can map the same physical pages and skip prefill
+for the whole shared span.
+
+Lifecycle of a cached page:
+
+- **publish**: when a sequence's ``cache_len`` crosses a page
+  boundary the page is frozen (its ``block_size`` slots are written
+  and will never be written again — appends go to the next page).
+  The engine publishes it: the trie gains a node and the cache takes
+  one pool reference, so the page survives the sequence.
+- **match**: at admission the scheduler walks the trie with the new
+  request's prefill prefix. Matching stops at the last full page
+  boundary STRICTLY below the prefix end (at least one token always
+  prefills — the next-token sample needs a live forward pass — and a
+  partial page is never shared). Matched pages are increfed into the
+  request's block table; prefill runs only on the uncached suffix.
+- **evict**: a cached page whose refcount is 1 (cache is the sole
+  owner) is *reclaimable*. The cache registers itself as the pool's
+  reclaimer, so allocation pressure LRU-evicts leaf pages back into
+  the free list before the scheduler ever preempts a victim — the
+  cache accelerates, never starves, admission.
+
+All state is host-side Python guarded by one lock; the device never
+sees the trie, only block tables that happen to share page ids.
+
+Knob: ``PADDLE_TPU_PREFIX_CACHE=1|0`` (read per call via
+``prefix_cache_enabled``, never at import — this file is in
+tools/repo_lint.py's ENV_SCOPED_FILES).
+"""
+
+import itertools
+import os
+import threading
+
+from ... import observe as _obs
+
+__all__ = ['PrefixCache', 'prefix_cache_enabled']
+
+
+def prefix_cache_enabled(default=None):
+    """Resolve the prefix-cache knob: an explicit ``default`` (the
+    engine constructor arg) wins; otherwise PADDLE_TPU_PREFIX_CACHE
+    (off when unset)."""
+    if default is not None:
+        return bool(default)
+    return os.environ.get('PADDLE_TPU_PREFIX_CACHE', '0') \
+        not in ('0', 'false', 'False', '')
+
+
+class _Node(object):
+    """One full page of the radix tree. ``key`` is the page's token
+    tuple (edge label from the parent); the chain of keys from the
+    root IS the token prefix the page's K/V encodes."""
+
+    __slots__ = ('key', 'page_id', 'parent', 'children', 'last_used')
+
+    def __init__(self, key, page_id, parent, tick):
+        self.key = key
+        self.page_id = page_id
+        self.parent = parent
+        self.children = {}
+        self.last_used = tick
+
+
+class PrefixCache(object):
+    """Radix/trie index of frozen KV pages, keyed by token chains at
+    page granularity. Thread-safe; installs itself as ``pool``'s
+    reclaimer so eviction integrates with the free list."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._root = _Node(None, None, None, 0)
+        self._pages = 0
+        self._mu = threading.Lock()
+        self._tick = itertools.count(1)
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+        pool.set_reclaimer(self.reclaim)
+
+    # ------------------------------------------------------------- stats
+    def cached_pages(self):
+        with self._mu:
+            return self._pages
+
+    def hit_rate(self):
+        with self._mu:
+            n = self.hits + self.misses
+            return self.hits / float(n) if n else 0.0
+
+    def _publish_gauges(self):
+        if _obs.enabled():
+            _obs.set_gauge('decode.prefix_cache_pages', self._pages)
+
+    # ------------------------------------------------------------- match
+    def match(self, tokens, table):
+        """Walk the trie with ``tokens`` and map every matched page
+        into ``table`` (refcount bumped — the pages are pinned against
+        eviction until the sequence releases them). Returns the number
+        of tokens covered: a multiple of block_size, capped at the
+        last full page boundary strictly below len(tokens) so at least
+        one token always remains for prefill. ``table`` must be empty.
+        A touched chain is LRU-refreshed root-to-leaf."""
+        assert not table.block_ids, 'match() needs an empty block table'
+        bs = self.block_size
+        max_pages = max(0, (len(tokens) - 1) // bs)
+        matched = []
+        with self._mu:
+            node = self._root
+            tick = next(self._tick)
+            for p in range(max_pages):
+                key = tuple(tokens[p * bs:(p + 1) * bs])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.last_used = tick
+                matched.append(child.page_id)
+                node = child
+            if matched:
+                self.pool.incref(matched)
+                table.block_ids.extend(matched)
+                self.hits += 1
+                self.tokens_reused += len(matched) * bs
+            else:
+                self.misses += 1
+        n = len(matched) * bs
+        if _obs.enabled():
+            _obs.inc('decode.prefix_cache_lookups_total',
+                     outcome='hit' if matched else 'miss')
+            if n:
+                _obs.inc('decode.prefix_tokens_reused_total', n)
+        return n
+
+    def unmatch(self, table, matched_tokens):
+        """Roll back a ``match`` whose admission failed: drop the
+        sequence's references on the shared pages (the cache's own
+        reference keeps them resident and evictable)."""
+        n_pages = int(matched_tokens) // self.block_size
+        ids, table.block_ids = table.block_ids[:n_pages], []
+        if ids:
+            self.pool.free(ids)
+
+    # ----------------------------------------------------------- publish
+    def publish(self, tokens, table, upto_tokens):
+        """Publish every FULL page of ``table`` below ``upto_tokens``
+        (the sequence's materialized KV length). For each full page
+        whose chain is not yet cached, the trie gains a node and the
+        cache takes one pool reference. Chains already cached under a
+        *different* physical page are deduplicated: the walk descends
+        the existing node and the sequence's twin page stays private.
+        Returns the number of newly published pages."""
+        bs = self.block_size
+        n_full = min(int(upto_tokens) // bs, len(table.block_ids))
+        added = 0
+        with self._mu:
+            node = self._root
+            tick = next(self._tick)
+            for p in range(n_full):
+                key = tuple(tokens[p * bs:(p + 1) * bs])
+                child = node.children.get(key)
+                if child is None:
+                    page = table.block_ids[p]
+                    self.pool.incref([page])
+                    child = _Node(key, page, node, tick)
+                    node.children[key] = child
+                    self._pages += 1
+                    added += 1
+                child.last_used = tick
+                node = child
+            self._publish_gauges()
+        if added and _obs.enabled():
+            _obs.inc('decode.prefix_pages_published_total', added)
+        return added
+
+    # ----------------------------------------------------------- evict
+    def _evictable_leaves(self):
+        """Leaf nodes whose page the cache solely owns (refcount 1),
+        oldest-touched first. Interior nodes become leaves as their
+        children evict, so repeated calls drain whole chains."""
+        out = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            kids = list(node.children.values())
+            if node is not self._root and not kids and \
+                    self.pool.refcount(node.page_id) == 1:
+                out.append(node)
+            stack.extend(kids)
+        out.sort(key=lambda n: n.last_used)
+        return out
+
+    def _drop(self, node):
+        del node.parent.children[node.key]
+        self._pages -= 1
+        self.evictions += 1
+        self.pool.free([node.page_id])
+
+    def reclaim(self, n):
+        """LRU-evict up to ``n`` refcount-1 cached pages back to the
+        pool's free list; returns how many were freed. Installed as the
+        pool's reclaimer, so every alloc under pressure lands here
+        before the scheduler resorts to preemption."""
+        freed = 0
+        with self._mu:
+            while freed < n:
+                leaves = self._evictable_leaves()
+                if not leaves:
+                    break
+                for node in leaves:
+                    self._drop(node)
+                    freed += 1
+                    if freed >= n:
+                        break
+            self._publish_gauges()
+        if freed and _obs.enabled():
+            _obs.inc('decode.prefix_evictions_total', freed)
+            _obs.flight_event('prefix_cache_evict', pages=freed,
+                              cached_pages=self._pages)
+        return freed
+
+    def clear(self):
+        """Drop the cache's reference on every cached page (engine
+        shutdown): pages with no other owner return to the free list,
+        restoring the pool-drains-to-initial invariant."""
+        with self._mu:
+            stack = [self._root]
+            nodes = []
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if node is not self._root:
+                    nodes.append(node)
+            for node in nodes:
+                self.pool.free([node.page_id])
+            self._root.children.clear()
+            self._pages = 0
+            self._publish_gauges()

@@ -99,8 +99,8 @@ class Sequence(object):
     __slots__ = ('request_id', 'prompt', 'max_new_tokens', 'temperature',
                  'seed', 'eos_id', 'table', 'generated', 'streamed',
                  'state', 'stream', 'cache_len', 'pending_token',
-                 't_submit', 't_admit', 't_last_token', 'preemptions',
-                 'ctx')
+                 't_submit', 't_admit', 't_first_token', 't_last_token',
+                 'preemptions', 'cached_len', 'published_pages', 'ctx')
 
     def __init__(self, request_id, prompt, max_new_tokens, temperature,
                  seed, eos_id, ctx=None):
@@ -119,8 +119,11 @@ class Sequence(object):
         self.pending_token = None
         self.t_submit = time.perf_counter()
         self.t_admit = None
+        self.t_first_token = None
         self.t_last_token = None
         self.preemptions = 0
+        self.cached_len = 0        # prefix-cache hit span (this prefill)
+        self.published_pages = 0   # full pages already offered to cache
         self.ctx = ctx      # reqtrace.RequestContext (trace correlation)
 
     def prefix(self):
@@ -140,11 +143,16 @@ class Sequence(object):
 class Scheduler(object):
     """Owns the waiting queue, the running set, and the page budget.
     All mutation happens on the engine worker thread except ``add``
-    (submit path, engine-locked)."""
+    (submit path, engine-locked). With a ``cache`` (prefix_cache.py),
+    admission first maps the prompt's cached pages into the block
+    table — and because the cache is the pool's reclaimer, every grow
+    below LRU-evicts reclaimable cached pages before this scheduler
+    ever preempts a running victim."""
 
-    def __init__(self, pool, max_batch):
+    def __init__(self, pool, max_batch, cache=None):
         self.pool = pool
         self.max_batch = int(max_batch)
+        self.cache = cache
         self.waiting = collections.deque()
         self.running = []          # admission order (oldest first)
         self._mu = threading.Lock()
@@ -168,14 +176,26 @@ class Scheduler(object):
     # --------------------------------------------------------- admission
     def pop_admittable(self):
         """Admit the next waiting sequence if a batch slot is free and
-        the pool covers its prefill prefix plus one decode write.
+        the pool covers its prefill prefix plus one decode write. A
+        prefix-cache hit maps the shared pages first (refcount bump,
+        frozen), so only the uncached suffix needs fresh pages.
         Returns the Sequence (pages allocated, state RUNNING) or None."""
         with self._mu:
             if len(self.running) >= self.max_batch or not self.waiting:
                 return None
             seq = self.waiting[0]
-            need = len(seq.prefix()) + 1
-            if not self.pool.grow(seq.table, need):
+            prefix = seq.prefix()
+            if self.cache is not None and not seq.table.block_ids:
+                seq.cached_len = self.cache.match(prefix, seq.table)
+                seq.published_pages = seq.cached_len // \
+                    self.pool.block_size
+            if not self.pool.grow(seq.table, len(prefix) + 1):
+                if seq.cached_len:
+                    # roll the match back: pinned cache pages would
+                    # block the very evictions admission is waiting on
+                    self.cache.unmatch(seq.table, seq.cached_len)
+                    seq.cached_len = 0
+                    seq.published_pages = 0
                 _obs.inc('decode.admission_blocked_total')
                 return None
             self.waiting.popleft()
@@ -186,11 +206,17 @@ class Scheduler(object):
         return seq
 
     # ----------------------------------------------------------- growth
-    def ensure_growth(self, seq):
-        """Make sure ``seq`` owns the page its next decode write lands
-        in, preempting victims on exhaustion. False when ``seq`` itself
+    def ensure_growth(self, seq, need_tokens=None):
+        """Make sure ``seq`` owns the pages its next decode write lands
+        in (``need_tokens`` positions — default one write; speculative
+        steps need cache_len + k + 1), preempting victims on
+        exhaustion. Cache-reclaimable pages are consulted first: grow
+        only fails once the prefix cache's LRU evictor (the pool's
+        reclaimer) has nothing left to give. False when ``seq`` itself
         was preempted (caller must drop it from this step)."""
-        while not self.pool.grow(seq.table, seq.cache_len + 1):
+        if need_tokens is None:
+            need_tokens = seq.cache_len + 1
+        while not self.pool.grow(seq.table, need_tokens):
             _obs.inc('decode.pool_exhausted_total')
             _obs.flight_event('decode_pool_exhausted',
                               request_id=seq.request_id,
@@ -218,6 +244,11 @@ class Scheduler(object):
         seq.state = WAITING
         seq.cache_len = 0
         seq.pending_token = None
+        # shared cached pages just lost this sequence's reference —
+        # refcount-1 survivors are demoted back to evictable, and the
+        # re-prefill will re-match whatever is still cached
+        seq.cached_len = 0
+        seq.published_pages = 0
         seq.preemptions += 1
         _obs.inc('decode.preemptions_total')
         _obs.flight_event('decode_preempt', request_id=seq.request_id,

@@ -108,10 +108,12 @@ def test_transformer_and_moe_builders_verify():
 def test_decode_model_builders_verify():
     from paddle_tpu.serving.decode.model import (LMSpec,
                                                  build_lm_programs)
-    progs = build_lm_programs(LMSpec(vocab_size=128), 4, 8, 16, 4)
+    progs = build_lm_programs(LMSpec(vocab_size=128), 4, 8, 16, 4,
+                              spec_k=3)
     _strict('decode_startup', progs.startup)
     _strict('decode_prefill', progs.prefill, [progs.prefill_fetch])
     _strict('decode_step', progs.decode, [progs.decode_fetch])
+    _strict('decode_spec_verify', progs.verify, [progs.verify_fetch])
 
 
 def test_seq2seq_graphs_verify():

@@ -301,24 +301,35 @@ def test_decode_bench_json_schema(tmp_path):
     decode_transformer scenario builds on) cannot rot."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, 'tools', 'decode_bench.py'),
-         '--duration', '0.5', '--clients', '2', '--vocab', '60',
+         '--duration', '1.0', '--clients', '2', '--vocab', '60',
          '--n-layer', '1', '--n-head', '2', '--d-model', '16',
          '--d-inner', '32', '--block-size', '4', '--num-blocks', '32',
-         '--pages-per-seq', '4', '--prompt-lo', '1', '--prompt-hi', '6',
-         '--max-new', '4', '--json'],
+         '--pages-per-seq', '6', '--prompt-lo', '1', '--prompt-hi', '12',
+         '--max-new', '8', '--prefix-cache', '--spec-k', '2',
+         '--shared-prefix', '0.9', '--shared-prefix-len', '9', '--json'],
         capture_output=True, text=True, timeout=300,
         env=dict(os.environ, JAX_PLATFORMS='cpu'))
     assert out.returncode == 0, out.stderr[-2000:]
     doc = json.loads(out.stdout.strip().splitlines()[-1])
     for key in ('tokens_per_s', 'inter_token_ms', 'request_ms',
                 'requests_ok', 'preemptions', 'warmup', 'executor',
-                'engine', 'kv_blocks_free_end'):
+                'engine', 'kv_blocks_free_end', 'cache_hit_rate',
+                'prefill_tokens_skipped', 'accepted_draft_length',
+                'ttft_ms', 'spec_steps'):
         assert key in doc, key
     assert doc['requests_ok'] > 0
     assert doc['inter_token_ms']['p99'] is not None
     assert doc['executor']['cache_misses'] <= \
         doc['warmup']['signatures'] + 1   # +1: startup program compile
     assert doc['kv_blocks_free_end'] == doc['engine']['num_blocks']
+    # the shared-prefix mix must actually exercise the new machinery
+    assert doc['cache_hit_rate'] > 0
+    assert doc['prefill_tokens_skipped'] > 0
+    assert doc['ttft_ms']['cached'] is not None
+    for k in ('p50', 'mean'):
+        assert k in doc['accepted_draft_length'], k
+    assert doc['engine']['prefix_cache'] is True
+    assert doc['engine']['spec_k'] == 2
 
 
 @pytest.mark.slow

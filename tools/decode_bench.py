@@ -15,6 +15,15 @@ caller actually sees — including stalls from prefill insertions and
 pool-exhaustion preemptions (visible as p99 spikes; cross-check the
 flight recorder / decode.preemptions_total).
 
+Prefix caching and speculative decoding are first-class here:
+`--shared-prefix 0.95 --shared-prefix-len 24` makes 95% of requests
+open with one shared system prompt (the fleet-realistic mix),
+`--prefix-cache` turns the radix prefix cache on (watch
+`cache_hit_rate`, `prefill_tokens_skipped`, and the cached-vs-cold
+`ttft_ms` split), and `--spec-k K` turns on draft-and-verify decoding
+(watch `accepted_draft_length` p50/mean and tokens/sec vs the k=0
+baseline).
+
 Metrics land in the standard observe pipeline (--metrics-jsonl /
 PADDLE_TPU_METRICS_JSONL -> tools/metrics_report.py). --json emits one
 machine-readable object; its schema is asserted by
@@ -50,6 +59,17 @@ def main(argv=None):
     p.add_argument('--max-new', type=int, default=32,
                    help='max generated tokens per request')
     p.add_argument('--temperature', type=float, default=0.0)
+    p.add_argument('--prefix-cache', action='store_true',
+                   help='enable the global radix prefix cache')
+    p.add_argument('--spec-k', type=int, default=0,
+                   help='speculative decoding draft length (0 = off)')
+    p.add_argument('--shared-prefix', type=float, default=0.0,
+                   help='fraction of requests opening with one shared '
+                        'system prompt (0..1)')
+    p.add_argument('--shared-prefix-len', type=int, default=0,
+                   help='shared system prompt length in tokens '
+                        '(default: half the per-sequence capacity '
+                        'headroom)')
     p.add_argument('--vocab', type=int, default=1000)
     p.add_argument('--n-layer', type=int, default=2)
     p.add_argument('--n-head', type=int, default=4)
@@ -80,10 +100,19 @@ def main(argv=None):
                           block_size=args.block_size,
                           num_blocks=args.num_blocks,
                           pages_per_seq=args.pages_per_seq,
-                          max_queue_depth=args.max_queue_depth)
+                          max_queue_depth=args.max_queue_depth,
+                          prefix_cache=args.prefix_cache or None,
+                          spec_k=args.spec_k or None)
     capacity = engine.capacity
     prompt_hi = min(args.prompt_hi, max(args.prompt_lo,
                                         capacity - args.max_new))
+    shared = []
+    if args.shared_prefix > 0.0:
+        n_shared = args.shared_prefix_len or \
+            max(args.block_size, (prompt_hi - args.prompt_lo) // 2)
+        n_shared = min(n_shared, max(1, prompt_hi - 1))
+        shared = np.random.RandomState(1234).randint(
+            0, args.vocab, n_shared).tolist()
 
     t_w0 = time.perf_counter()
     signatures = 0 if args.no_warmup else engine.warmup()
@@ -97,7 +126,11 @@ def main(argv=None):
 
     def do_request(rng):
         plen = int(rng.randint(args.prompt_lo, prompt_hi + 1))
-        prompt = rng.randint(0, args.vocab, plen).tolist()
+        if shared and rng.rand() < args.shared_prefix:
+            tail = max(1, plen - len(shared))
+            prompt = shared + rng.randint(0, args.vocab, tail).tolist()
+        else:
+            prompt = rng.randint(0, args.vocab, plen).tolist()
         stream = engine.submit(prompt, max_new_tokens=args.max_new,
                                temperature=args.temperature,
                                seed=int(rng.randint(1 << 30)))
@@ -120,9 +153,22 @@ def main(argv=None):
 
     snap = observe.snapshot()
     counters = snap['counters']
+    hists = snap['histograms']
     misses = sum(v for k, v in counters.items()
                  if k.startswith('executor.cache_miss_total'))
-    occ = snap['histograms'].get('decode.batch_occupancy', {})
+    occ = hists.get('decode.batch_occupancy', {})
+
+    hit = counters.get('decode.prefix_cache_lookups_total{outcome=hit}',
+                       0)
+    miss = counters.get(
+        'decode.prefix_cache_lookups_total{outcome=miss}', 0)
+    acc = hists.get('decode.spec_accepted_len', {})
+
+    def _ms(h):
+        return {k: (round(h[k] * 1000.0, 3)
+                    if h.get(k) is not None else None)
+                for k in ('p50', 'p95', 'p99', 'mean') if k in h} \
+            if h else None
 
     report = {
         'duration_s': round(wall, 4),
@@ -139,6 +185,27 @@ def main(argv=None):
         'preemptions': counters.get('decode.preemptions_total', 0),
         'pool_exhausted': counters.get('decode.pool_exhausted_total', 0),
         'kv_blocks_free_end': engine.pool.free_blocks(),
+        # prefix cache: lookup hit rate, tokens whose prefill was
+        # skipped (the shared spans mapped from cached pages), and
+        # time-to-first-token split by hit/miss — the TTFT delta IS
+        # the cache's latency win
+        'cache_hit_rate': round(hit / float(hit + miss), 4)
+        if (hit + miss) else None,
+        'prefill_tokens_skipped':
+            counters.get('decode.prefix_tokens_reused_total', 0),
+        'prefix_evictions':
+            counters.get('decode.prefix_evictions_total', 0),
+        'ttft_ms': {
+            'cached': _ms(hists.get('decode.ttft_seconds{cached=1}')),
+            'cold': _ms(hists.get('decode.ttft_seconds{cached=0}')),
+        },
+        # speculative decoding: accepted draft tokens per verify step
+        # (0 means the draft never helped; > 1 means multi-token steps)
+        'accepted_draft_length': {
+            'p50': acc.get('p50'), 'mean': acc.get('mean'),
+            'max': acc.get('max'),
+        } if acc else None,
+        'spec_steps': counters.get('decode.spec_steps_total', 0),
         'warmup': {'signatures': signatures,
                    'seconds': round(warmup_s, 4)},
         'executor': {'cache_misses': misses},
@@ -147,7 +214,11 @@ def main(argv=None):
                    'num_blocks': args.num_blocks,
                    'pages_per_seq': args.pages_per_seq,
                    'capacity_tokens': capacity,
-                   'prompt_buckets': engine.prompt_buckets},
+                   'prompt_buckets': engine.prompt_buckets,
+                   'prefix_cache': engine.prefix_cache_on,
+                   'spec_k': engine.spec_k},
+        'workload': {'shared_prefix': args.shared_prefix,
+                     'shared_prefix_len': len(shared)},
         'model': {'vocab': args.vocab, 'n_layer': args.n_layer,
                   'n_head': args.n_head, 'd_model': args.d_model},
     }
@@ -175,6 +246,22 @@ def main(argv=None):
               'free-at-end=%d/%d'
               % (report['preemptions'], report['pool_exhausted'],
                  engine.pool.free_blocks(), args.num_blocks))
+        if report['cache_hit_rate'] is not None:
+            tt = report['ttft_ms']
+
+            def fmt(h):
+                return '%.2f' % h['p50'] if h and \
+                    h.get('p50') is not None else '-'
+            print('  prefix     hit-rate=%.2f prefill-tokens-skipped=%d '
+                  'evictions=%d ttft-p50 cached=%sms cold=%sms'
+                  % (report['cache_hit_rate'],
+                     report['prefill_tokens_skipped'],
+                     report['prefix_evictions'],
+                     fmt(tt['cached']), fmt(tt['cold'])))
+        if report['accepted_draft_length']:
+            a = report['accepted_draft_length']
+            print('  spec       k=%d accepted-draft-len p50=%s mean=%.2f'
+                  % (engine.spec_k, a['p50'], a['mean'] or 0.0))
         print('  compiles   %d warmup signatures in %.2fs; %d total '
               'misses' % (signatures, warmup_s, misses))
     return 0

@@ -73,8 +73,11 @@ QUEUE = [
     ('pipeline_transformer', 'pipeline_transformer', None, 700),
     ('pipeline_resnet50', 'pipeline_resnet50', None, 700),
     # decode serving: continuous batching + paged KV cache tokens/sec
-    # (PR 6); inter-token percentiles + decode.* metrics land in the
-    # shared metrics JSONL
+    # (PR 6), now on the shared-prefix traffic mix (95% shared system
+    # prompt) with the prefix cache on and a spec-decode off/on
+    # ablation (ISSUE 12) — cache-hit-rate, prefill-tokens-skipped,
+    # and accepted-draft-length land in the shared metrics JSONL
+    # beside inter-token percentiles
     ('decode_transformer', 'decode_transformer', None, 700),
     # fleet chaos scenario (ISSUE 10): 3-replica router under flash
     # crowd + replica kill; slo.*/router.* burn-rate/goodput metrics
