@@ -814,6 +814,267 @@ def bench_fleet(replicas=3, duration=6.0, steady_qps=40.0,
     }
 
 
+def bench_quant(dp=8, steps=150, hidden=256, in_dim=64,
+                kv_duration=2.5, kv_block_size=8, kv_pages_per_seq=8,
+                kv_blocks_fp32=16, fleet_ab=True, fleet_duration=4.0,
+                reduced=False):
+    """Quantization ablation (ISSUE 13), three asserted legs:
+
+    1. **int8 gradient allreduce** — the same MLP regression trained
+       twice on a dp mesh, fp32 vs quantized grads
+       (ParallelStrategy(quantized_allreduce=True)); asserts the
+       simulated dp comm bytes drop >= 3x (quant.allreduce_* gauges
+       from the executor's wire model) with final-loss delta within
+       tolerance, off-leg bit-identical to baseline, and the REAL
+       shard_map quantized_all_reduce within rel-err of exact psum.
+    2. **quantized KV arena** — equal ARENA BYTES, fp32 pages vs the
+       int8 pages that budget buys; closed-loop decode load measures
+       resident_seqs_peak on each (assert >= 1.8x), decode outputs
+       pass the parity bound (paged-attention cosine vs fp32 + token
+       agreement), and kv_dtype off is bit-identical to default.
+    3. **fleet A/B** — the chaos fleet scenario with baseline replicas
+       vs 'quantized' replicas whose per-replica concurrency ceiling
+       is scaled by the capacity ratio leg 2 MEASURED (decode replicas
+       are HBM-bound: resident sequences == batch ceiling) — goodput
+       and burn rate under the same flash-crowd + kill schedule, so
+       the win is judged on fleet SLOs, not microbenchmarks.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import observe, quant
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import (ParallelStrategy,
+                                                transpile)
+
+    out = {'workload': 'quant'}
+    dp = max(1, min(int(dp), jax.device_count()))
+
+    # ---- leg 1: int8 gradient allreduce on the trainer path --------
+    def train_leg(quant_on):
+        fluid = _fresh()
+        np.random.seed(0)
+        true_w = np.random.randn(in_dim, 1).astype('float32')
+        x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=hidden, act='relu',
+                            param_attr=fluid.ParamAttr(name='q_w1'))
+        h = fluid.layers.fc(input=h, size=64, act='relu')
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.02).minimize(cost)
+        if dp > 1:
+            transpile(fluid.default_main_program(), make_mesh(dp=dp),
+                      ParallelStrategy(data_parallel=True,
+                                       quantized_allreduce=quant_on))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(steps):
+            xs = np.random.randn(8 * dp, in_dim).astype('float32')
+            ys = xs @ true_w
+            got = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[cost])
+            losses.append(float(np.asarray(got[0]).reshape(())))
+        w1 = np.asarray(fluid.global_scope().find('q_w1'))
+        return losses, w1
+
+    loss_f, w_f = train_leg(False)
+    loss_f2, w_f2 = train_leg(False)     # off-leg determinism baseline
+    loss_q, w_q = train_leg(True)
+    snap = observe.snapshot()
+    g = snap['gauges']
+    bytes_fp32 = g.get('quant.allreduce_bytes_fp32', 0)
+    bytes_quant = g.get('quant.allreduce_bytes_quant', 1)
+    compression = g.get('quant.allreduce_compression', 0)
+    loss_delta = abs(loss_q[-1] - loss_f[-1])
+    loss_tol = max(0.05, 0.25 * abs(loss_f[-1]))
+    assert np.array_equal(w_f, w_f2), \
+        'quantized_allreduce=False must stay bit-identical run to run'
+    if dp > 1:
+        assert compression >= 3.0, \
+            'int8 allreduce compression %.2fx < 3x' % compression
+        assert loss_delta <= loss_tol, \
+            'quantized final loss %.4f vs fp32 %.4f (tol %.4f)' \
+            % (loss_q[-1], loss_f[-1], loss_tol)
+
+    # the REAL two-leg schedule vs exact psum, over the same mesh
+    qar = {'dp': dp}
+    if dp > 1:
+        from jax.sharding import Mesh, PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from paddle_tpu.parallel import collective
+        mesh = Mesh(np.array(jax.devices()[:dp]).reshape(dp), ('dp',))
+        xs = np.random.RandomState(1).randn(dp, 1 << 14) \
+            .astype('float32')
+        f = shard_map(
+            lambda a: collective.quantized_all_reduce(
+                a.reshape(-1), 'dp',
+                key=jax.random.PRNGKey(3)).reshape(a.shape),
+            mesh=mesh, in_specs=(P('dp', None),),
+            out_specs=P('dp', None))
+        got = np.asarray(jax.jit(f)(xs))
+        exact = np.tile(xs.sum(0, keepdims=True), (dp, 1))
+        rel = float(np.abs(got - exact).max() / np.abs(exact).max())
+        assert rel < 0.05, 'quantized_all_reduce rel err %.4f' % rel
+        qar['rel_err_vs_psum'] = round(rel, 6)
+    out['allreduce'] = {
+        'dp': dp, 'steps': steps,
+        'final_loss_fp32': round(loss_f[-1], 6),
+        'final_loss_int8': round(loss_q[-1], 6),
+        'loss_delta': round(loss_delta, 6),
+        'bytes_fp32_per_step': bytes_fp32,
+        'bytes_int8_per_step': bytes_quant,
+        'compression_x': round(compression, 3),
+        'collective': qar,
+        'off_leg_bit_identical': True,
+    }
+    observe.set_gauge('quant.bench_allreduce_compression', compression)
+
+    # ---- leg 2: quantized KV arena at equal bytes ------------------
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention_reference)
+    from paddle_tpu.serving.decode import (DecodeEngine, LMSpec,
+                                           random_weights)
+    from paddle_tpu.serving.decode.model import (arena_bytes,
+                                                 kv_bytes_per_token,
+                                                 num_blocks_for_budget)
+    from paddle_tpu.serving.loadgen import Stats, closed_loop
+
+    spec = LMSpec(vocab_size=256, n_layer=2, n_head=2, d_key=16,
+                  d_value=16, d_model=32, d_inner=64)
+    weights = random_weights(spec, seed=3)
+    budget = arena_bytes(spec, kv_blocks_fp32, kv_block_size, 'float32')
+    nb_int8 = num_blocks_for_budget(budget, spec, kv_block_size, 'int8')
+    capacity_ratio = nb_int8 / float(kv_blocks_fp32)
+
+    def kv_leg(kv_dtype, num_blocks):
+        eng = DecodeEngine(spec, max_batch=12, block_size=kv_block_size,
+                           num_blocks=num_blocks,
+                           pages_per_seq=kv_pages_per_seq,
+                           max_queue_depth=64, weights=weights,
+                           kv_dtype=kv_dtype)
+        eng.warmup()
+        eng.start()
+        stats = Stats()
+
+        def do_request(rng):
+            plen = int(rng.randint(16, 25))
+            prompt = rng.randint(0, 256, plen).tolist()
+            return len(eng.submit(prompt, max_new_tokens=24)
+                       .result(120))
+
+        closed_loop(do_request, stats,
+                    time.perf_counter() + kv_duration, 10)
+        eng.shutdown(drain=True)
+        return {'kv_dtype': eng.kv_dtype, 'num_blocks': num_blocks,
+                'arena_bytes': arena_bytes(spec, num_blocks,
+                                           kv_block_size, eng.kv_dtype),
+                'kv_bytes_per_token': eng.kv_bytes_per_token,
+                'resident_seqs_peak': eng.resident_seqs_peak,
+                'requests_ok': stats.ok}
+
+    leg_f = kv_leg('fp32', kv_blocks_fp32)
+    leg_q = kv_leg('int8', nb_int8)
+    resident_ratio = leg_q['resident_seqs_peak'] / \
+        max(1.0, leg_f['resident_seqs_peak'])
+    assert leg_q['arena_bytes'] <= budget, 'equal-bytes violated'
+    assert resident_ratio >= 1.8, \
+        'resident seqs %.2fx < 1.8x at equal arena bytes (fp32 peak ' \
+        '%d, int8 peak %d)' % (resident_ratio,
+                               leg_f['resident_seqs_peak'],
+                               leg_q['resident_seqs_peak'])
+
+    # parity bound: the dequantized attention path vs fp32, and token
+    # agreement between fp32/int8 engines on identical prompts
+    rng = np.random.RandomState(7)
+    nb, h_, bs, d = 8, 2, kv_block_size, 16
+    kf = rng.randn(nb, h_, bs, d).astype('float32')
+    vf = rng.randn(nb, h_, bs, d).astype('float32')
+    kq, ks = quant.quantize_rows(jnp.asarray(kf), 'int8')
+    vq, vs = quant.quantize_rows(jnp.asarray(vf), 'int8')
+    q = rng.randn(3, h_, d).astype('float32')
+    tables = np.array([[0, 1, 2, 7], [3, 4, 8, 8], [5, 6, 8, 8]],
+                      'int32')
+    lens = np.array([4 * bs - 2, 2 * bs, bs + 3], 'int32')
+    ref = np.asarray(paged_attention_reference(q, kf, vf, tables, lens))
+    got = np.asarray(paged_attention_reference(
+        q, np.asarray(kq), np.asarray(vq), tables, lens,
+        k_scales=np.asarray(ks), v_scales=np.asarray(vs)))
+    cos = float((ref * got).sum() /
+                (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-12))
+    assert cos >= 0.99, 'paged-attention parity cosine %.5f' % cos
+
+    def token_streams(kv_dtype):
+        eng = DecodeEngine(spec, max_batch=4, block_size=kv_block_size,
+                           num_blocks=kv_blocks_fp32,
+                           pages_per_seq=kv_pages_per_seq,
+                           weights=weights, kv_dtype=kv_dtype)
+        eng.start()
+        prng = np.random.RandomState(11)
+        outs = [eng.generate(prng.randint(0, 256, 12).tolist(),
+                             max_new_tokens=12, timeout=120)
+                for _ in range(6)]
+        eng.shutdown()
+        return outs
+
+    tok_f = token_streams('fp32')
+    tok_default = token_streams(None)      # knob off == fp32, bit-exact
+    tok_q = token_streams('int8')
+    assert tok_f == tok_default, 'kv_dtype off must be bit-identical'
+    agree = []
+    for a, b in zip(tok_f, tok_q):
+        n = sum(1 for t_a, t_b in zip(a, b) if t_a == t_b)
+        agree.append(n / float(max(len(a), 1)))
+    token_match = float(np.mean(agree))
+    out['kv'] = {
+        'arena_budget_bytes': budget,
+        'fp32': leg_f, 'int8': leg_q,
+        'capacity_ratio_pages': round(capacity_ratio, 3),
+        'resident_seqs_ratio': round(resident_ratio, 3),
+        'parity': {'attention_cosine': round(cos, 6),
+                   'token_match_mean': round(token_match, 4)},
+        'off_bit_identical': True,
+    }
+    observe.set_gauge('quant.bench_kv_resident_ratio', resident_ratio)
+    observe.set_gauge('quant.bench_kv_parity_cosine', cos)
+
+    # ---- leg 3: fleet A/B on goodput + burn rate -------------------
+    if fleet_ab:
+        fleet_kw = dict(duration=fleet_duration, steady_qps=30.0,
+                        spike_qps=500.0, spike_at=1.0, spike_s=1.0,
+                        kill_at=1.2, window_s=1.0, max_queue_depth=10)
+        base = bench_fleet(max_batch=8, **fleet_kw)
+        # quantized replicas: the measured KV capacity ratio raises the
+        # per-replica concurrency ceiling (decode replicas are
+        # HBM-bound — resident sequences ARE the batch ceiling)
+        q_batch = int(round(8 * min(resident_ratio, 2.5)))
+        quant_leg = bench_fleet(max_batch=q_batch, **fleet_kw)
+
+        def trim(r):
+            return {k: r[k] for k in
+                    ('accepted', 'completed', 'lost', 'requests_ok',
+                     'requests_rejected', 'goodput_end_rps',
+                     'burn_during_kill', 'latency_ms')}
+
+        assert base['lost'] == 0 and quant_leg['lost'] == 0
+        out['fleet_ab'] = {
+            'baseline_max_batch': 8,
+            'quantized_max_batch': q_batch,
+            'baseline': trim(base),
+            'quantized': trim(quant_leg),
+            'goodput_delta_rps': round(
+                quant_leg['goodput_end_rps'] - base['goodput_end_rps'],
+                2),
+            'burn_delta': round(quant_leg['burn_during_kill'] -
+                                base['burn_during_kill'], 4),
+        }
+    return out
+
+
 def bench_autoscale(in_dim=8, max_batch=8, max_queue_depth=12,
                     compute_delay_ms=10.0, latency_budget_s=0.05,
                     availability=0.95, window_s=1.5,
@@ -1494,7 +1755,9 @@ def _run_workload_child(workload, backend, reduced):
                    trace=os.environ.get('PADDLE_TPU_TRACE_JSON'))
     if backend == 'cpu':
         from paddle_tpu.core.platform_boot import force_host_cpu
-        force_host_cpu()
+        # the quant ablation needs a dp mesh even off-chip: 8 virtual
+        # CPU devices, same as the test suite's conftest
+        force_host_cpu(8 if workload == 'quant' else None)
     # one home for the cache-arming quirk (env alone does not arm it on
     # this jax build); a workload killed mid-compile then restarts from
     # the cached executable instead of re-burning its watchdog budget
@@ -1573,6 +1836,12 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(flash_duration=3.0, crash_duration=3.5,
                   trough_duration=3.5, window_s=1.0) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_autoscale(**kw)),
+              flush=True)
+        return
+    if workload == 'quant':
+        kw = dict(steps=60, kv_duration=1.5, fleet_duration=3.0,
+                  reduced=True) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_quant(**kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':
@@ -2116,6 +2385,7 @@ if __name__ == '__main__':
                                 'pipeline_transformer',
                                 'pipeline_resnet50',
                                 'decode_transformer', 'fleet',
+                                'autoscale', 'quant',
                                 'autotune', 'autotune_child', 'verify'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')

@@ -3,7 +3,7 @@
 Whole-program XLA compilation means graph bugs otherwise surface as
 opaque tracer exceptions (or silent recompiles) deep inside `jit`, far
 from the user code that appended the op. This package runs BEFORE any
-trace: five static-analysis passes over Program/Block/Operator IR,
+trace: six static-analysis passes over Program/Block/Operator IR,
 each emitting structured diagnostics with severity, op index, and the
 op's construction provenance (`file.py:line`, captured at append_op).
 
@@ -21,6 +21,9 @@ Passes (see docs/static_analysis.md for the full catalog):
   persistable state (params, optimizer accumulators, KV arenas),
 - ``recompile``  — attrs embedding per-process values/object ids and
   unbound feed dims: the executor-cache signature-churn class.
+- ``quant``      — quantization dtype/scale contracts: int8 PTQ
+  weights must pair with fp32 per-channel scale vars (fp32
+  accumulation), quantized KV arenas with per-row scale arenas.
 
 Three ways in:
 

@@ -21,6 +21,10 @@ _CANONICAL = {
     'double': 'float64',
     'int8': 'int8',
     'uint8': 'uint8',
+    # fp8 (serving KV arenas; gated on jax support — see to_jnp_dtype)
+    'fp8': 'float8_e4m3fn',
+    'float8': 'float8_e4m3fn',
+    'float8_e4m3fn': 'float8_e4m3fn',
     'int16': 'int16',
     'int32': 'int32',
     'int': 'int32',
@@ -59,11 +63,17 @@ def to_jnp_dtype(dtype):
     name = canonical_dtype(dtype)
     if name == 'bfloat16':
         return jnp.bfloat16
+    if name == 'float8_e4m3fn':
+        if not hasattr(jnp, 'float8_e4m3fn'):
+            raise ValueError('dtype float8_e4m3fn is not supported by '
+                             'this jax build')
+        return jnp.float8_e4m3fn
     return jax.dtypes.canonicalize_dtype(np.dtype(name))
 
 
 def is_float_dtype(dtype):
-    return canonical_dtype(dtype) in ('float16', 'bfloat16', 'float32', 'float64')
+    return canonical_dtype(dtype) in ('float16', 'bfloat16', 'float32',
+                                      'float64', 'float8_e4m3fn')
 
 
 def canonical_int():

@@ -481,18 +481,24 @@ class Executor(object):
         feed_vals = self._normalize_feed(block, feed)
         feed_sig = tuple(sorted((n, v.shape, str(v.dtype))
                                 for n, v in feed_vals.items()))
+        # read per call and folded into the cache key: flipping the
+        # PADDLE_TPU_QUANT_ALLREDUCE knob mid-process recompiles
+        # instead of silently reusing the other mode's executable
+        from ..quant.core import grad_allreduce_policy
+        qpolicy = grad_allreduce_policy(program)
         key = (id(program), program._version, program.amp,
-               program.remat_policy, feed_sig, tuple(fetch_names))
+               program.remat_policy, qpolicy, feed_sig,
+               tuple(fetch_names))
         self._maybe_verify('single', key, program, feed_vals,
                            fetch_names)
         self.last_warm_from_disk = False
         compiled, missed = self._lookup_or_compile(
             'single', key, use_program_cache,
             lambda: self._compile(program, sorted(feed_vals),
-                                  fetch_names),
+                                  fetch_names, quant_allreduce=qpolicy),
             program=program,
             aot_parts=('single', program.amp, program.remat_policy,
-                       feed_sig, tuple(fetch_names)))
+                       qpolicy, feed_sig, tuple(fetch_names)))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='single',
@@ -578,13 +584,16 @@ class Executor(object):
                      for n, v in feed_vals.items()}
         feed_sig = tuple(sorted((n, sig_shape[n], str(v.dtype))
                                 for n, v in feed_vals.items()))
+        from ..quant.core import grad_allreduce_policy
+        qpolicy = grad_allreduce_policy(program)
         key = ('multi', id(program), program._version, program.amp,
-               program.remat_policy, feed_sig, tuple(fetch_names),
-               steps, stacked_feed)
+               program.remat_policy, qpolicy, feed_sig,
+               tuple(fetch_names), steps, stacked_feed)
         self._maybe_verify('multi', key, program, feed_vals, fetch_names)
 
         def _build_multi():
-            base = self._compile(program, sorted(feed_vals), fetch_names)
+            base = self._compile(program, sorted(feed_vals), fetch_names,
+                                 quant_allreduce=qpolicy)
 
             # state that is read each step chains through the scan carry;
             # written-only persistables (no reader) are ALSO carried —
@@ -628,7 +637,7 @@ class Executor(object):
             'multi', key, True, _build_multi,
             program=program,
             aot_parts=('multi', program.amp, program.remat_policy,
-                       feed_sig, tuple(fetch_names), steps,
+                       qpolicy, feed_sig, tuple(fetch_names), steps,
                        stacked_feed))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
@@ -774,7 +783,8 @@ class Executor(object):
                 out[name] = jax.device_put(value, sharding)
         return out
 
-    def _compile(self, program, feed_names, fetch_names):
+    def _compile(self, program, feed_names, fetch_names,
+                 quant_allreduce=None):
         import jax
 
         block = program.global_block()
@@ -818,6 +828,41 @@ class Executor(object):
         shardings = program.var_shardings
         amp = program.amp
         error_clips = collect_error_clips(block, ops)
+
+        # Quantized dp gradient aggregation (EQuARX wire format): under
+        # GSPMD the dp allreduce is inserted by XLA inside the grad
+        # contraction, so the compressed schedule is modeled by passing
+        # each dense dp-reduced gradient through the int8 per-block
+        # quantize/dequantize with stochastic rounding (quant/core.qdq
+        # — the requantized-shard leg; the explicit two-leg schedule is
+        # collective.quantized_all_reduce, proven against psum in
+        # tests/test_quant.py). Active only where the compressed
+        # collective would exist: a training step on a dp>1 mesh.
+        quant_grads = None
+        if quant_allreduce is not None and marker_idx is not None and \
+                mesh is not None and dict(mesh.shape).get('dp', 1) > 1:
+            quant_grads = {'block': int(quant_allreduce[1])}
+            if _obs.enabled():
+                from ..quant import core as _quant
+                n_dp = dict(mesh.shape).get('dp', 1)
+                marker = ops[marker_idx]
+                n_elems = 0
+                for pn in marker.attrs['param_names']:
+                    v = block._find_var_recursive(pn)
+                    if v is not None and v.shape:
+                        sz = 1
+                        for d in v.shape:
+                            sz *= int(d)
+                        n_elems += sz
+                fp32_b = _quant.allreduce_wire_bytes(n_elems, n_dp)
+                q_b = _quant.quantized_allreduce_wire_bytes(
+                    n_elems, n_dp, quant_grads['block'])
+                _obs.set_gauge('quant.allreduce_grad_elements', n_elems)
+                _obs.set_gauge('quant.allreduce_bytes_fp32', fp32_b)
+                _obs.set_gauge('quant.allreduce_bytes_quant', q_b)
+                _obs.set_gauge('quant.allreduce_compression',
+                               fp32_b / max(q_b, 1.0))
+                _obs.inc('quant.allreduce_compiles_total')
 
         def run_ops(op_list, env, base_key, start_index=0):
             import jax as _jax
@@ -929,11 +974,21 @@ class Executor(object):
                 (_, kept), grads = jax.value_and_grad(
                     fwd, has_aux=True)(params)
                 env.update(kept)
-                for pn, gn in zip(param_names, grad_names):
+                for pi, (pn, gn) in enumerate(zip(param_names,
+                                                  grad_names)):
                     if pn in sparse_info:
+                        # sparse row grads scatter in place; they never
+                        # ride the dense allreduce, so no wire format
                         rows = grads[SPARSE_SEED_PREFIX +
                                      sparse_info[pn]['out']]
                         env[gn] = rows.reshape(-1, rows.shape[-1])
+                    elif quant_grads is not None:
+                        from ..quant.core import qdq as _qdq
+                        gkey = jax.random.fold_in(
+                            jax.random.fold_in(base_key, 0x5172), pi)
+                        env[gn] = _qdq(grads[pn],
+                                       block=quant_grads['block'],
+                                       key=gkey)
                     else:
                         env[gn] = grads[pn]
                 env = run_ops(post, env, base_key,
@@ -969,7 +1024,10 @@ class Executor(object):
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
         feed_vals = self._normalize_feed(block, feed or {})
-        compiled = self._compile(program, sorted(feed_vals), fetch_names)
+        from ..quant.core import grad_allreduce_policy
+        compiled = self._compile(
+            program, sorted(feed_vals), fetch_names,
+            quant_allreduce=grad_allreduce_policy(program))
         scope_vals, feed_vals = self._prepare_inputs(
             'Executor.compile_step', program, compiled, scope, feed_vals)
         return compiled.raw_fn, scope_vals, feed_vals

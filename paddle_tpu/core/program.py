@@ -301,6 +301,11 @@ class Program(object):
         # Rematerialization policy set by memory_optimize(): None, 'full',
         # 'dots_saveable', or 'nothing_saveable' (jax.checkpoint).
         self.remat_policy = None
+        # Quantized gradient allreduce (EQuARX wire format) over the dp
+        # axis, set by ParallelStrategy(quantized_allreduce=True); the
+        # per-call PADDLE_TPU_QUANT_ALLREDUCE env knob overrides in
+        # either direction (quant/core.grad_allreduce_policy).
+        self.quant_allreduce = None
 
     def _bump_version(self):
         self._version += 1
@@ -355,6 +360,7 @@ class Program(object):
         p.var_shardings = dict(self.var_shardings)
         p.mesh = self.mesh
         p.pipeline = dict(self.pipeline) if self.pipeline else None
+        p.quant_allreduce = self.quant_allreduce
         for i, b in enumerate(self.blocks):
             nb = p.blocks[0] if i == 0 else p.create_block(b.parent_idx)
             for name, v in b.vars.items():

@@ -9,7 +9,6 @@ parity with the reference's Send/Recv surface.
 """
 
 import jax
-import jax.numpy as jnp
 
 from ..core.registry import register
 
@@ -64,8 +63,21 @@ def _c_ppermute(ctx):
 
 @register('c_broadcast')
 def _c_broadcast(ctx):
-    x = ctx.input('X')
-    root = ctx.attr('root', 0)
-    idx = jax.lax.axis_index(_axis(ctx))
-    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    ctx.set_output('Out', jax.lax.psum(masked, _axis(ctx)))
+    # recursive-doubling ppermute/select (O(1) compute per element)
+    # instead of the old psum(where(...)) full reduction
+    from ..parallel.collective import broadcast
+    ctx.set_output('Out', broadcast(ctx.input('X'), _axis(ctx),
+                                    root=ctx.attr('root', 0)))
+
+
+@register('c_quant_allreduce')
+def _c_quant_allreduce(ctx):
+    """Block-scaled int8 allreduce (EQuARX schedule) as an IR op for
+    shard_map-style programs; see collective.quantized_all_reduce."""
+    from ..parallel.collective import quantized_all_reduce
+    key = None
+    if ctx.attr('stochastic', False):
+        key = ctx.rng_key()
+    ctx.set_output('Out', quantized_all_reduce(
+        ctx.input('X'), _axis(ctx), op=ctx.attr('op', 'sum'),
+        block=ctx.attr('block', 256), key=key))

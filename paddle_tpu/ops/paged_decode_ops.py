@@ -47,6 +47,14 @@ Sampling: token at position i draws from
 temp == 0), so a request's stream depends only on (seed, positions),
 never on batch composition, speculation depth, or a global step
 counter.
+
+Quantized arenas (docs/quantization.md): when the K/V arenas are int8
+or fp8, ``_extend_rows`` quantizes each written row independently
+(one fp32 scale per (token, head) row into the KScale/VScale arenas,
+deterministic rounding) and the attention gather dequantizes through
+the same table indices — so every invariant above, including
+bit-consistency across batching/speculation/caching, holds unchanged
+at the quantized dtypes.
 """
 
 import jax
@@ -83,6 +91,21 @@ def _write_positions(pages, new, phys, off):
                     off[:, None]].set(new, mode='drop')
 
 
+def _write_scales(scales, new, phys, off):
+    """Scatter per-row scales beside a quantized arena write.
+    scales [NB, H, bs]; new [N, H]; same drop semantics as the pages."""
+    n_head = new.shape[1]
+    return scales.at[phys[:, None], jnp.arange(n_head)[None, :],
+                     off[:, None]].set(new, mode='drop')
+
+
+def _arena_kv_dtype(kc):
+    """Canonical quantized-arena dtype from the arena's jnp dtype, or
+    None for the unquantized (fp32 / bf16) arenas."""
+    name = str(kc.dtype)
+    return name if name in ('int8', 'float8_e4m3fn') else None
+
+
 def _sample_token(logits, seed, pos, temp):
     """logits [V] fp32 -> int32 token. temp == 0 is greedy; otherwise
     categorical at temperature with a (seed, position)-derived key."""
@@ -101,12 +124,22 @@ def _lm_inputs(ctx):
               for s in LM_SLOTS}
     kc = ctx.input('KCache')            # [L, NB, H, bs, dk]
     vc = ctx.input('VCache')
-    return emb, pos_enc, wout, params, kc, vc
+    ks = ctx.input('KScale') if ctx.has_input('KScale') else None
+    vs = ctx.input('VScale') if ctx.has_input('VScale') else None
+    return emb, pos_enc, wout, params, kc, vc, ks, vs
+
+
+def _set_arena_outputs(ctx, kcs, vcs, kss, vss):
+    ctx.set_output('KCacheOut', kcs)
+    ctx.set_output('VCacheOut', vcs)
+    if kss is not None:
+        ctx.set_output('KScaleOut', kss)
+        ctx.set_output('VScaleOut', vss)
 
 
 @register('paged_decode_step')
 def _paged_decode_step(ctx):
-    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    emb, pos_enc, wout, params, kcs, vcs, kss, vss = _lm_inputs(ctx)
     n_head = ctx.attr('n_head', 1)
 
     tokens = ctx.input('Tokens').reshape(-1).astype(jnp.int32)     # [B]
@@ -118,30 +151,41 @@ def _paged_decode_step(ctx):
     # one new token per row at position lens (empty slots feed all->NB
     # tables, so phys lands out of bounds and every write drops)
     live = jnp.ones(lens.shape, dtype=bool)
-    logits, kcs, vcs = _extend_rows(
+    logits, kcs, vcs, kss, vss = _extend_rows(
         emb, pos_enc, wout, params, kcs, vcs, n_head,
-        tokens, lens, live, tables)
+        tokens, lens, live, tables, kss, vss)
     nxt = jax.vmap(_sample_token)(logits, seeds, lens + 1, temps)
     ctx.set_output('NextTokens',
                    nxt.astype(ctx.out_dtype('NextTokens', 'int64')))
-    ctx.set_output('KCacheOut', kcs)
-    ctx.set_output('VCacheOut', vcs)
+    _set_arena_outputs(ctx, kcs, vcs, kss, vss)
 
 
 def _extend_rows(emb, pos_enc, wout, params, kcs, vcs, n_head,
-                 tokens, pos, live, tables):
+                 tokens, pos, live, tables, kscales=None, vscales=None):
     """Shared core of prefill and spec-verify: write N new tokens'
     K/V at absolute positions ``pos`` through per-row block
     ``tables`` [N, P], attend each row at its own ragged length
     (``pos + 1``), and return fp32 logits [N, V] plus the updated
     arenas. Rows that are not ``live``, sit past the table's capacity,
     or hit a table entry >= NB drop their writes (padded tails /
-    empty batch slots)."""
+    empty batch slots).
+
+    Quantized arenas (``kscales``/``vscales`` [L, NB, H, bs] given):
+    each new K/V row is quantized independently (one fp32 scale per
+    (token, head) row, deterministic rounding — quant.core
+    quantize_rows) before the scatter, and the attention gather
+    dequantizes through the same table indices. Because rows quantize
+    independently, every path (prefill, decode, spec-verify, cache
+    hits) stores identical bits for identical tokens — the
+    concurrent == sequential invariant survives at int8/fp8."""
+    from ..quant.core import quantize_rows
     from .pallas.paged_attention import paged_attention
     bs = kcs.shape[3]
     nb = kcs.shape[1]
     d_model = emb.shape[-1]
     p_cap = tables.shape[1]
+    kv_q = _arena_kv_dtype(kcs)
+    quantized = kv_q is not None
 
     logical = jnp.clip(pos // bs, 0, p_cap - 1)
     phys = jnp.take_along_axis(tables, logical[:, None], axis=1)[:, 0]
@@ -153,24 +197,44 @@ def _extend_rows(emb, pos_enc, wout, params, kcs, vcs, n_head,
     att_lens = pos + 1
 
     def body(h, sl):
-        p, kc, vc = sl
+        if quantized:
+            p, kc, vc, ksc, vsc = sl
+        else:
+            p, kc, vc = sl
+            ksc = vsc = None
         k_new = _split_heads(h @ p['slf_k'], n_head)       # [N, H, dk]
         v_new = _split_heads(h @ p['slf_v'], n_head)
-        kc = _write_positions(kc, k_new, phys, off)
-        vc = _write_positions(vc, v_new, phys, off)
+        if quantized:
+            kq, ks_row = quantize_rows(k_new, kv_q)
+            vq, vs_row = quantize_rows(v_new, kv_q)
+            kc = _write_positions(kc, kq, phys, off)
+            vc = _write_positions(vc, vq, phys, off)
+            ksc = _write_scales(ksc, ks_row, phys, off)
+            vsc = _write_scales(vsc, vs_row, phys, off)
+        else:
+            kc = _write_positions(kc, k_new.astype(kc.dtype), phys, off)
+            vc = _write_positions(vc, v_new.astype(vc.dtype), phys, off)
         q = _split_heads(h @ p['slf_q'], n_head)
-        attn = paged_attention(q, kc, vc, tables, att_lens)
-        h = _ln(h + attn.reshape(h.shape[0], -1) @ p['slf_o'], p, 'ln1')
+        attn = paged_attention(q, kc, vc, tables, att_lens,
+                               k_scales=ksc, v_scales=vsc)
+        h = _ln(h + attn.reshape(h.shape[0], -1).astype(h.dtype)
+                @ p['slf_o'], p, 'ln1')
         h = _ln(h + _ffn(h, p), p, 'ln2')
+        if quantized:
+            return h, (kc, vc, ksc, vsc)
         return h, (kc, vc)
 
-    h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
-    return (h @ wout).astype(jnp.float32), kcs, vcs
+    if quantized:
+        h, (kcs, vcs, kscales, vscales) = jax.lax.scan(
+            body, x, (params, kcs, vcs, kscales, vscales))
+    else:
+        h, (kcs, vcs) = jax.lax.scan(body, x, (params, kcs, vcs))
+    return (h @ wout).astype(jnp.float32), kcs, vcs, kscales, vscales
 
 
 @register('paged_prefill')
 def _paged_prefill(ctx):
-    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    emb, pos_enc, wout, params, kcs, vcs, kss, vss = _lm_inputs(ctx)
     n_head = ctx.attr('n_head', 1)
 
     ids = ctx.input('Ids').reshape(-1).astype(jnp.int32)   # [S] (padded)
@@ -187,9 +251,9 @@ def _paged_prefill(ctx):
     t_idx = jnp.arange(s, dtype=jnp.int32)
     pos = cached + t_idx
     tables = jnp.broadcast_to(table, (s, table.shape[0]))
-    logits, kcs, vcs = _extend_rows(
+    logits, kcs, vcs, kss, vss = _extend_rows(
         emb, pos_enc, wout, params, kcs, vcs, n_head,
-        ids, pos, t_idx < length, tables)
+        ids, pos, t_idx < length, tables, kss, vss)
 
     logits_last = jax.lax.dynamic_index_in_dim(
         logits, jnp.maximum(length - 1, 0), keepdims=False)     # [V]
@@ -197,13 +261,12 @@ def _paged_prefill(ctx):
     ctx.set_output('NextToken',
                    nxt.reshape(1).astype(ctx.out_dtype('NextToken',
                                                        'int64')))
-    ctx.set_output('KCacheOut', kcs)
-    ctx.set_output('VCacheOut', vcs)
+    _set_arena_outputs(ctx, kcs, vcs, kss, vss)
 
 
 @register('paged_spec_verify')
 def _paged_spec_verify(ctx):
-    emb, pos_enc, wout, params, kcs, vcs = _lm_inputs(ctx)
+    emb, pos_enc, wout, params, kcs, vcs, kss, vss = _lm_inputs(ctx)
     n_head = ctx.attr('n_head', 1)
 
     tokens = ctx.input('Tokens').astype(jnp.int32)         # [B, K1]
@@ -222,14 +285,13 @@ def _paged_spec_verify(ctx):
     pos = (lens[:, None] + j[None, :]).reshape(-1)         # [B*K1]
     tables_rep = jnp.repeat(tables, k1, axis=0)            # [B*K1, P]
     live = jnp.ones(pos.shape, dtype=bool)
-    logits, kcs, vcs = _extend_rows(
+    logits, kcs, vcs, kss, vss = _extend_rows(
         emb, pos_enc, wout, params, kcs, vcs, n_head,
-        tokens.reshape(-1), pos, live, tables_rep)
+        tokens.reshape(-1), pos, live, tables_rep, kss, vss)
 
     nxt = jax.vmap(_sample_token)(
         logits, jnp.repeat(seeds, k1), pos + 1, jnp.repeat(temps, k1))
     ctx.set_output('NextTokens',
                    nxt.reshape(b, k1).astype(
                        ctx.out_dtype('NextTokens', 'int64')))
-    ctx.set_output('KCacheOut', kcs)
-    ctx.set_output('VCacheOut', vcs)
+    _set_arena_outputs(ctx, kcs, vcs, kss, vss)

@@ -48,11 +48,18 @@ _NEG_INF = -1e9
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
-                              sm_scale=None):
+                              sm_scale=None, k_scales=None,
+                              v_scales=None):
     """XLA gather path. Bit-stable contract with the Pallas kernel's
     masking: columns >= seq_lens[b] contribute exactly 0 (exp of a
     large-negative underflows), so the result is independent of the
-    garbage content of unowned/partial pages."""
+    garbage content of unowned/partial pages.
+
+    Quantized arenas: ``k_scales``/``v_scales`` [NB, H, bs] carry one
+    fp32 scale per stored (page, head, slot) K/V row; the gather
+    dequantizes to fp32 through the same table indices before the
+    attention math (fp32 accumulation — int8/fp8 only ever live in
+    HBM)."""
     nb, h, bs, d = k_pages.shape
     b, p = block_tables.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
@@ -62,6 +69,13 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
         .reshape(b, h, p * bs, d)
     v = jnp.transpose(v_pages[tables], (0, 2, 1, 3, 4)) \
         .reshape(b, h, p * bs, v_pages.shape[-1])
+    if k_scales is not None:
+        ks = jnp.transpose(k_scales[tables], (0, 2, 1, 3)) \
+            .reshape(b, h, p * bs)
+        vs = jnp.transpose(v_scales[tables], (0, 2, 1, 3)) \
+            .reshape(b, h, p * bs)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     logits = jnp.einsum('bhd,bhkd->bhk', (q * scale), k)
     mask = jnp.arange(p * bs)[None, :] < seq_lens.reshape(-1, 1)
     logits = jnp.where(mask[:, None, :], logits, _NEG_INF)
@@ -184,15 +198,21 @@ def _use_pallas(q, k_pages, v_pages, block_tables):
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                    sm_scale=None):
+                    sm_scale=None, k_scales=None, v_scales=None):
     """Ragged paged attention: one query per sequence against its paged
     KV cache. q [B, H, D]; pages [NB, H, bs, D*]; block_tables [B, P]
     int32 (entries >= NB mean "no page" and are never read); seq_lens
-    [B] int32. Returns [B, H, Dv]."""
+    [B] int32. Quantized arenas pass their per-row fp32 scale arenas
+    as ``k_scales``/``v_scales`` [NB, H, bs] and take the gather path
+    (which dequantizes inline; the Pallas kernel stays fp32/bf16).
+    Returns [B, H, Dv]."""
     nb, h, bs, d = k_pages.shape
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    if _use_pallas(q, k_pages, v_pages, block_tables):
+    if k_scales is None and str(k_pages.dtype) in ('float32', 'bfloat16') \
+            and _use_pallas(q, k_pages, v_pages, block_tables):
         return _paged_pallas(q, k_pages, v_pages, block_tables, seq_lens,
                              scale)
     return paged_attention_reference(q, k_pages, v_pages, block_tables,
-                                     seq_lens, sm_scale=scale)
+                                     seq_lens, sm_scale=scale,
+                                     k_scales=k_scales,
+                                     v_scales=v_scales)

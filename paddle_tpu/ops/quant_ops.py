@@ -1,0 +1,62 @@
+"""Weight-only int8 inference ops — the PTQ rewrite's targets.
+
+Each op consumes an int8 weight plus its per-channel fp32 ``Scale``
+var (quant/ptq.py pairs them; the ``quant`` analysis pass enforces the
+pairing statically). Accumulation is fp32: the int8 weight upcasts at
+the use site, the matmul runs in fp32, and the per-channel scale
+multiplies the OUTPUT — algebraically identical to dequantizing the
+weight first (``x @ (q * s) == (x @ q) * s`` for per-output-channel
+scales) but keeps the weight int8 in HBM, which is the entire point.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register
+from .math_ops import _flatten_2d
+
+
+@register('quant_mul')
+def _quant_mul(ctx):
+    """mul with an int8 Y: out = flatten(x) @ fp32(y_int8) * scale."""
+    x = ctx.input('X')
+    w = ctx.input('Y')
+    scale = ctx.input('Scale')
+    xd = ctx.attr('x_num_col_dims', 1)
+    x2 = _flatten_2d(x, xd).astype(jnp.float32)
+    out = (x2 @ w.astype(jnp.float32)) * scale[None, :]
+    ctx.set_output('Out', out.reshape(x.shape[:xd] + (w.shape[1],)))
+
+
+@register('quant_matmul')
+def _quant_matmul(ctx):
+    """matmul with an int8 2-D Y (per-output-column scales)."""
+    x = ctx.input('X').astype(jnp.float32)
+    w = ctx.input('Y')
+    scale = ctx.input('Scale')
+    if ctx.attr('transpose_X', False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    out = jnp.matmul(x, w.astype(jnp.float32)) * scale
+    alpha = ctx.attr('alpha', 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output('Out', out)
+
+
+@register('quant_lookup_table')
+def _quant_lookup_table(ctx):
+    """Embedding lookup over an int8 table with per-row scales.
+    Inference-only (the PTQ rewrite runs on pruned infer programs), so
+    the sparse-grad seed machinery of the fp32 lookup does not apply."""
+    w = ctx.input('W')
+    scale = ctx.input('Scale')
+    ids = ctx.input('Ids')
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.squeeze(-1)
+    rows = jnp.take(w, ids, axis=0).astype(jnp.float32) * \
+        jnp.take(scale, ids, axis=0)[..., None]
+    padding_idx = ctx.attr('padding_idx', -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        rows = rows * mask.astype(rows.dtype)
+    ctx.set_output('Out', rows)

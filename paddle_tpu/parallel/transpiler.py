@@ -29,8 +29,17 @@ class ParallelStrategy(object):
                  sequence_parallel=False, tp_rules=None, sp_vars=None,
                  shard_embeddings=True, pipeline_parallel=False,
                  pipeline_microbatches=None, shard_optimizer_states=False,
-                 fully_shard_parameters=False):
+                 fully_shard_parameters=False, quantized_allreduce=False):
         self.data_parallel = data_parallel
+        # Quantized gradient allreduce (PAPERS "EQuARX"): dense dp
+        # gradients cross the wire as per-block-scaled int8 with
+        # stochastic rounding instead of fp32 — ~3.9x less ICI traffic
+        # on the training path's dominant collective. The executor
+        # models the wire format on each dp-reduced gradient (see
+        # quant/core.qdq); the explicit two-leg schedule lives in
+        # collective.quantized_all_reduce. PADDLE_TPU_QUANT_ALLREDUCE
+        # overrides per call.
+        self.quantized_allreduce = quantized_allreduce
         # ZeRO-1 (beyond reference; the scaling-book optimizer-state
         # recipe): optimizer accumulators additionally shard over 'dp'
         # on their first free divisible axis. GSPMD then derives the
@@ -340,6 +349,7 @@ def transpile(program, mesh, strategy=None):
 
     program.var_shardings.update(shardings)
     program.mesh = mesh
+    program.quant_allreduce = bool(strategy.quantized_allreduce) or None
     # invalidate compiled-step caches: a step compiled BEFORE transpile
     # has no sharding constraints (and no pipeline schedule) traced in —
     # reusing it would silently train without the requested layout

@@ -1,0 +1,43 @@
+"""paddle_tpu.quant — quantization end-to-end.
+
+Three layers share the numerics in :mod:`core`:
+
+- **Compressed collectives** (ROADMAP item 1 / PAPERS "EQuARX"):
+  ``parallel.collective.quantized_all_reduce`` moves gradient traffic
+  over the dp axis as per-block-scaled int8 with stochastic rounding —
+  reduce_scatter in int8, fp32 accumulation at the owning shard, then
+  an all_gather of the requantized shards. The trainer path applies
+  the same wire format to every dense dp gradient when
+  ``ParallelStrategy(quantized_allreduce=True)`` (or the per-call
+  ``PADDLE_TPU_QUANT_ALLREDUCE`` env knob) is set.
+- **Post-training int8 inference** (:mod:`ptq`): a Program→Program
+  rewrite that turns fp32 matmul / embedding weights into int8 with
+  per-channel fp32 scales and fp32 accumulation, calibrated against a
+  sample feed. The ``quant`` analysis pass (analysis/quant.py) locks
+  the dtype/scale contracts statically.
+- **Quantized paged KV arenas**: int8 / fp8 K/V pages with per-token
+  per-head scales in serving/decode (``DecodeEngine(kv_dtype=...)`` /
+  ``PADDLE_TPU_KV_DTYPE``), dequantized inside the shared ragged
+  paged-attention path.
+
+Everything is off by default and bit-identical to the unquantized
+paths when disabled. See docs/quantization.md.
+"""
+
+from .core import (QMAX_FP8, QMAX_INT8,  # noqa: F401
+                   allreduce_wire_bytes, dequantize_blockwise,
+                   grad_allreduce_policy, kv_fp8_supported, kv_itemsize,
+                   kv_quantized, qdq, quantize_blockwise,
+                   quantize_per_channel_np, quantize_rows,
+                   quantized_allreduce_wire_bytes, resolve_kv_dtype)
+from .ptq import (INT8_SUFFIX, SCALE_SUFFIX,  # noqa: F401
+                  quantize_inference_program)
+
+__all__ = [
+    'QMAX_INT8', 'QMAX_FP8', 'quantize_blockwise', 'dequantize_blockwise',
+    'qdq', 'quantize_rows', 'quantize_per_channel_np',
+    'grad_allreduce_policy', 'resolve_kv_dtype', 'kv_itemsize',
+    'kv_quantized', 'kv_fp8_supported', 'allreduce_wire_bytes',
+    'quantized_allreduce_wire_bytes', 'quantize_inference_program',
+    'INT8_SUFFIX', 'SCALE_SUFFIX',
+]

@@ -1,0 +1,225 @@
+"""Quantization numerics shared by all three quantization layers
+(compressed collectives, PTQ inference, quantized KV arenas).
+
+Conventions:
+
+- **Blockwise** (gradients on the wire): the tensor is flattened and
+  cut into fixed-size blocks; each block carries one fp32 scale =
+  absmax/127. Stochastic rounding (``key`` given) makes the quantizer
+  unbiased — E[dequant(quant(x))] == x — which is what lets SGD
+  tolerate int8 gradient traffic (EQuARX's argument).
+- **Per-channel** (PTQ weights): one fp32 scale per output channel of
+  a matmul weight (axis 1) or per row of an embedding table (axis 0),
+  computed in numpy at rewrite time. Deterministic rounding — weights
+  are quantized once, not averaged over steps.
+- **Per-row** (KV pages): one fp32 scale per written (token, head) K/V
+  row, so a page's content is a pure function of the tokens written
+  into it — batch composition, speculation depth, and cache sharing
+  cannot perturb it (the bit-consistency invariant the decode e2es
+  assert). Deterministic rounding for the same reason.
+
+Env knobs (read per call, never at import — repo_lint enforced):
+``PADDLE_TPU_QUANT_ALLREDUCE`` (+ ``PADDLE_TPU_QUANT_BLOCK``) for the
+gradient path, ``PADDLE_TPU_KV_DTYPE`` for the KV arenas.
+"""
+
+import os
+
+import numpy as np
+
+QMAX_INT8 = 127.0
+QMAX_FP8 = 448.0          # float8_e4m3fn finite max
+_EPS = 1e-30              # scale floor: an all-zero block stays zero
+
+__all__ = [
+    'QMAX_INT8', 'QMAX_FP8', 'quantize_blockwise', 'dequantize_blockwise',
+    'qdq', 'quantize_rows', 'quantize_per_channel_np',
+    'grad_allreduce_policy', 'resolve_kv_dtype', 'kv_itemsize',
+    'kv_quantized', 'kv_fp8_supported', 'allreduce_wire_bytes',
+    'quantized_allreduce_wire_bytes',
+]
+
+
+# --------------------------------------------------------------- knobs
+def grad_allreduce_policy(program=None):
+    """Per-call resolver for the gradient-allreduce quantization knob.
+
+    Precedence: an explicit ``PADDLE_TPU_QUANT_ALLREDUCE`` env value
+    wins in either direction; when unset, the program's
+    ``quant_allreduce`` flag (set by
+    ``ParallelStrategy(quantized_allreduce=True)``) decides. Returns a
+    hashable policy tuple ``('int8', block)`` — folded into the
+    executor's compile-cache key so flipping the env recompiles
+    instead of silently reusing the other mode — or None when off."""
+    raw = os.environ.get('PADDLE_TPU_QUANT_ALLREDUCE')
+    if raw is None or raw.strip() == '':
+        enabled = bool(getattr(program, 'quant_allreduce', False))
+    else:
+        enabled = raw.strip().lower() not in ('0', 'off', 'false')
+    if not enabled:
+        return None
+    block = int(os.environ.get('PADDLE_TPU_QUANT_BLOCK', '') or 256)
+    if block < 8:
+        raise ValueError('PADDLE_TPU_QUANT_BLOCK=%d: blocks below 8 '
+                         'spend more bytes on scales than payload'
+                         % block)
+    return ('int8', block)
+
+
+_KV_ALIASES = {
+    '': 'float32', 'fp32': 'float32', 'float32': 'float32',
+    'f32': 'float32', 'bf16': 'bfloat16', 'bfloat16': 'bfloat16',
+    'int8': 'int8', 'i8': 'int8',
+    'fp8': 'float8_e4m3fn', 'f8': 'float8_e4m3fn',
+    'float8': 'float8_e4m3fn', 'float8_e4m3fn': 'float8_e4m3fn',
+}
+
+
+def resolve_kv_dtype(arg=None):
+    """Canonical KV-arena dtype: an explicit ``arg`` (engine ctor /
+    CLI) wins, else ``PADDLE_TPU_KV_DTYPE`` (read here, per call),
+    else fp32 — the unquantized default, bit-identical to the
+    pre-quantization engine."""
+    raw = arg if arg is not None else \
+        os.environ.get('PADDLE_TPU_KV_DTYPE', '')
+    key = str(raw).strip().lower()
+    if key not in _KV_ALIASES:
+        raise ValueError(
+            'kv_dtype %r (expected fp32|bf16|int8|fp8)' % (raw,))
+    out = _KV_ALIASES[key]
+    if out == 'float8_e4m3fn' and not kv_fp8_supported():
+        raise ValueError(
+            'kv_dtype fp8 requested but this jax build has no '
+            'float8_e4m3fn — use int8 (same bytes/token + scales)')
+    return out
+
+
+def kv_fp8_supported():
+    import jax.numpy as jnp
+    return hasattr(jnp, 'float8_e4m3fn')
+
+
+def kv_itemsize(kv_dtype):
+    return {'float32': 4, 'bfloat16': 2, 'int8': 1,
+            'float8_e4m3fn': 1}[kv_dtype]
+
+
+def kv_quantized(kv_dtype):
+    """True when the arena dtype needs a scale arena alongside."""
+    return kv_dtype in ('int8', 'float8_e4m3fn')
+
+
+# ------------------------------------------------------ wire-byte model
+def allreduce_wire_bytes(n_elements, axis_size, itemsize=4):
+    """Per-device bytes a ring allreduce moves for one ``n_elements``
+    tensor: reduce_scatter + all_gather each send (n-1)/n of the
+    payload (the standard bidirectional-ring accounting the MULTICHIP
+    benches use)."""
+    n = int(axis_size)
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * int(n_elements) * itemsize
+
+
+def quantized_allreduce_wire_bytes(n_elements, axis_size, block=256):
+    """Per-device bytes of the quantized schedule: both legs move int8
+    payload plus one fp32 scale per block (the sideband). Compression
+    vs fp32 is ~``4 * block / (block + 4)`` — 3.94x at block=256."""
+    n = int(axis_size)
+    if n <= 1:
+        return 0.0
+    nblocks = -(-int(n_elements) // int(block))
+    per_leg = nblocks * (int(block) * 1 + 4)
+    return 2.0 * (n - 1) / n * per_leg
+
+
+# -------------------------------------------------- blockwise (grads)
+def quantize_blockwise(x, block=256, key=None):
+    """Flatten ``x`` and quantize per-``block`` to int8 with fp32
+    absmax scales. ``key`` switches round-to-nearest to stochastic
+    rounding (unbiased). Returns ``(q [nblocks, block] int8,
+    scales [nblocks] fp32)`` — the padded tail quantizes as zeros."""
+    import jax.numpy as jnp
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    nblocks = -(-n // block)
+    pad = nblocks * block - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(nblocks, block)
+    scales = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1), _EPS) \
+        / QMAX_INT8
+    return _round_int8(blocks / scales[:, None], key), scales
+
+
+def _round_int8(v, key=None):
+    """Round to int8 in [-127, 127]. With ``key``: stochastic —
+    floor(v + u), u ~ U[0,1), so E[round(v)] == v exactly."""
+    import jax
+    import jax.numpy as jnp
+    if key is None:
+        r = jnp.round(v)
+    else:
+        r = jnp.floor(v + jax.random.uniform(key, v.shape))
+    return jnp.clip(r, -QMAX_INT8, QMAX_INT8).astype(jnp.int8)
+
+
+def dequantize_blockwise(q, scales, shape=None, dtype=None):
+    """Inverse of :func:`quantize_blockwise`; ``shape`` trims the pad
+    and restores the original layout."""
+    import jax.numpy as jnp
+    out = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
+    if shape is not None:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out = out[:n].reshape(shape)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def qdq(x, block=256, key=None):
+    """Quantize-dequantize through the int8 wire format — the noise a
+    tensor picks up crossing one quantized hop. The trainer's
+    gradient-aggregation path applies this to each dp-reduced dense
+    gradient, modeling the requantized-shard leg of the EQuARX
+    schedule (the per-shard reduce_scatter leg runs for real in
+    ``parallel.collective.quantized_all_reduce``)."""
+    q, scales = quantize_blockwise(x, block=block, key=key)
+    return dequantize_blockwise(q, scales, shape=x.shape, dtype=x.dtype)
+
+
+# ------------------------------------------------------ per-row (KV)
+def quantize_rows(x, kv_dtype):
+    """Quantize ``[..., D]`` rows independently: one fp32 scale per
+    leading index (per written token per head for KV pages).
+    Deterministic rounding — a row's stored bits depend only on the
+    row's values, never on batch composition."""
+    import jax.numpy as jnp
+    amax = jnp.maximum(jnp.max(jnp.abs(x), axis=-1), _EPS)
+    if kv_dtype == 'int8':
+        s = amax / QMAX_INT8
+        q = jnp.clip(jnp.round(x / s[..., None]),
+                     -QMAX_INT8, QMAX_INT8).astype(jnp.int8)
+    elif kv_dtype == 'float8_e4m3fn':
+        s = amax / QMAX_FP8
+        q = (x / s[..., None]).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError('quantize_rows: %r is not a quantized kv '
+                         'dtype' % (kv_dtype,))
+    return q, s.astype(jnp.float32)
+
+
+# -------------------------------------------------- per-channel (PTQ)
+def quantize_per_channel_np(w, axis):
+    """Numpy per-channel int8 quantization for the PTQ rewrite: one
+    fp32 scale per index of ``axis`` (absmax/127 over the rest).
+    Returns ``(int8 weights, fp32 scales [w.shape[axis]])``."""
+    w = np.asarray(w, dtype='float32')
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.maximum(np.abs(w).max(axis=reduce_axes), 1e-12)
+    scale = (amax / QMAX_INT8).astype('float32')
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    q = np.clip(np.round(w / scale.reshape(shape)),
+                -QMAX_INT8, QMAX_INT8).astype('int8')
+    return q, scale

@@ -1,0 +1,191 @@
+"""Post-training quantization as a Program→Program rewrite.
+
+``quantize_inference_program`` takes an inference Program plus the
+Scope holding its weights and returns a NEW program in which every
+eligible matmul / embedding consumes an int8 copy of its weight paired
+with a per-channel fp32 scale var, accumulating in fp32 (the weights
+are upcast at the use site — weight-only quantization: the HBM/bytes
+win is in storage and weight streaming, the arithmetic stays fp32).
+The original program is never mutated, so a server can hold both and
+A/B them.
+
+Calibration: with a ``sample_feed`` (+ executor), the ORIGINAL program
+runs once and each candidate op's live input activation is fetched;
+the rewrite then measures, per op, the relative output error its int8
+weight would introduce on that activation and skips any op whose
+error exceeds ``max_rel_err`` (None = quantize everything and just
+report). This is what "calibrated from a sample feed" means here: the
+scales themselves are per-channel absmax (exact for weights); the
+feed decides WHERE quantization is safe.
+
+Contracts (statically enforced by the ``quant`` analysis pass):
+int8 weight ⇔ fp32 scale var shaped like the quantized axis, and
+``accum_dtype`` == 'float32' on every rewritten op.
+"""
+
+import numpy as np
+
+from ..core.program import Parameter
+from . import core as qcore
+
+INT8_SUFFIX = '.int8'
+SCALE_SUFFIX = '.quant_scale'
+
+# op type -> (weight slot, activation slot, per-channel axis, rewrite)
+_TARGETS = {
+    'mul': ('Y', 'X', 1, 'quant_mul'),
+    'matmul': ('Y', 'X', 1, 'quant_matmul'),
+    'lookup_table': ('W', 'Ids', 0, 'quant_lookup_table'),
+}
+
+__all__ = ['quantize_inference_program', 'INT8_SUFFIX', 'SCALE_SUFFIX']
+
+
+def _candidates(program, op_types):
+    block = program.global_block()
+    out = []
+    for i, op in enumerate(block.ops):
+        if op.type not in op_types or op.type not in _TARGETS:
+            continue
+        wslot, xslot, axis, qtype = _TARGETS[op.type]
+        wname = op.input(wslot)
+        wvar = block._find_var_recursive(wname) if wname else None
+        if not isinstance(wvar, Parameter) or wvar.dtype != 'float32':
+            continue
+        if wvar.shape is None or len(wvar.shape) != 2:
+            continue
+        if op.type == 'mul' and (op.attr('x_num_col_dims', 1) < 1 or
+                                 op.attr('y_num_col_dims', 1) != 1):
+            continue
+        if op.type == 'matmul' and op.attr('transpose_Y', False):
+            continue   # quant axis would flip; not worth the surface
+        out.append({'index': i, 'op': op, 'wname': wname, 'wvar': wvar,
+                    'wslot': wslot, 'xslot': xslot, 'axis': axis,
+                    'qtype': qtype})
+    return out
+
+
+def _scope_value(scope, name):
+    v = scope.find(name)
+    if v is None:
+        raise ValueError('PTQ: weight %r is not initialized in scope — '
+                         'run the startup program (or load params) '
+                         'first' % name)
+    return np.asarray(v, dtype='float32')
+
+
+def _rel_err(got, ref):
+    denom = float(np.linalg.norm(ref.reshape(-1))) + 1e-12
+    return float(np.linalg.norm((got - ref).reshape(-1))) / denom
+
+
+def _calibrate(program, scope, sample_feed, executor, cands):
+    """One run of the ORIGINAL program over the sample feed, fetching
+    each candidate's live input; returns {op index: rel output error
+    of the int8 weight on that activation}."""
+    fetch = [c['op'].input(c['xslot']) for c in cands]
+    outs = executor.run(program=program, feed=sample_feed,
+                        fetch_list=fetch, scope=scope)
+    errs = {}
+    for c, x in zip(cands, outs):
+        w = _scope_value(scope, c['wname'])
+        qw, scale = qcore.quantize_per_channel_np(w, c['axis'])
+        if c['op'].type == 'lookup_table':
+            ids = np.asarray(x).reshape(-1).astype('int64')
+            ids = np.clip(ids, 0, w.shape[0] - 1)
+            ref = w[ids]
+            got = qw[ids].astype('float32') * scale[ids][:, None]
+        else:
+            x2 = np.asarray(x, dtype='float32').reshape(-1, w.shape[0])
+            ref = x2 @ w
+            got = (x2 @ qw.astype('float32')) * scale[None, :]
+        errs[c['index']] = _rel_err(got, ref)
+    return errs
+
+
+def quantize_inference_program(program, scope, sample_feed=None,
+                               executor=None, max_rel_err=None,
+                               op_types=('mul', 'matmul',
+                                         'lookup_table')):
+    """Rewrite ``program`` for int8 weight-only inference.
+
+    Returns ``(quantized_program, report)``. The int8 weights and
+    their scales are installed into ``scope`` under
+    ``<name>.int8`` / ``<name>.quant_scale``; fp32 weights no op still
+    references are dropped from the new program's var table (and so
+    from what ``save_inference_model`` persists). ``report`` lists
+    every candidate with its calibrated relative error and whether it
+    was quantized, plus the weight-byte ledger."""
+    from .. import observe as _obs
+    cands = _candidates(program, set(op_types))
+    errs = {}
+    if sample_feed is not None:
+        if executor is None:
+            raise ValueError('PTQ calibration needs the executor that '
+                             'can run the program on sample_feed')
+        errs = _calibrate(program, scope, sample_feed, executor, cands)
+
+    q = program.clone()
+    qblock = q.global_block()
+    ops_report, quantized_names = [], set()
+    bytes_fp32 = bytes_quant = 0
+    for c in cands:
+        rel = errs.get(c['index'])
+        keep = not (max_rel_err is not None and rel is not None and
+                    rel > max_rel_err)
+        w = _scope_value(scope, c['wname'])
+        ops_report.append({'op': c['op'].type, 'param': c['wname'],
+                           'rel_err': rel, 'quantized': keep})
+        if not keep:
+            _obs.inc('quant.ptq_ops_total', outcome='skipped')
+            continue
+        qname = c['wname'] + INT8_SUFFIX
+        sname = c['wname'] + SCALE_SUFFIX
+        if not qblock.has_var(qname):
+            qw, scale = qcore.quantize_per_channel_np(w, c['axis'])
+            wp = qblock.create_parameter(qname, shape=list(w.shape),
+                                         dtype='int8', trainable=False)
+            wp.stop_gradient = True
+            sp = qblock.create_parameter(
+                sname, shape=[int(w.shape[c['axis']])], dtype='float32',
+                trainable=False)
+            sp.stop_gradient = True
+            scope.set(qname, qw)
+            scope.set(sname, scale)
+            bytes_fp32 += w.size * 4
+            bytes_quant += w.size * 1 + int(w.shape[c['axis']]) * 4
+        qop = qblock.ops[c['index']]   # clone preserves op order
+        qop.type = c['qtype']
+        qop.inputs[c['wslot']] = [qname]
+        qop.inputs['Scale'] = [sname]
+        qop.attrs['accum_dtype'] = 'float32'
+        qop.attrs['quant_axis'] = c['axis']
+        quantized_names.add(c['wname'])
+        _obs.inc('quant.ptq_ops_total', outcome='quantized')
+
+    # drop fp32 originals nothing references anymore, so the quantized
+    # program (and anything serialized from it) carries int8-only
+    referenced = set()
+    for b in q.blocks:
+        for op in b.ops:
+            referenced.update(op.input_names())
+            referenced.update(op.output_names())
+    for name in quantized_names:
+        if name not in referenced:
+            for b in q.blocks:
+                b.vars.pop(name, None)
+    q._bump_version()
+
+    if _obs.enabled() and bytes_fp32:
+        _obs.set_gauge('quant.ptq_weight_bytes', bytes_fp32,
+                       dtype='float32')
+        _obs.set_gauge('quant.ptq_weight_bytes', bytes_quant,
+                       dtype='int8')
+    report = {
+        'ops': ops_report,
+        'quantized': sum(1 for o in ops_report if o['quantized']),
+        'skipped': sum(1 for o in ops_report if not o['quantized']),
+        'weight_bytes_fp32': bytes_fp32,
+        'weight_bytes_int8': bytes_quant,
+    }
+    return q, report

@@ -88,7 +88,9 @@ class DecodeEngine(object):
     def __init__(self, spec, max_batch=8, block_size=16, num_blocks=64,
                  pages_per_seq=8, max_queue_depth=64, max_prompt_len=None,
                  place=None, weights=None, prefix_cache=None, spec_k=None,
-                 draft=None):
+                 draft=None, kv_dtype=None):
+        from ...quant.core import resolve_kv_dtype
+        from .model import kv_bytes_per_token
         self.spec = spec
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
@@ -96,18 +98,25 @@ class DecodeEngine(object):
         self.pages_per_seq = int(pages_per_seq)
         self.max_queue_depth = int(max_queue_depth)
         # feature knobs: explicit constructor args win, else the env
-        # (PADDLE_TPU_PREFIX_CACHE / PADDLE_TPU_SPEC_K, read here — at
-        # call time — never at import). spec_k is folded into the
-        # verify Program as a static attr: one extra fixed signature,
-        # zero recompiles however the scheduler batches.
+        # (PADDLE_TPU_PREFIX_CACHE / PADDLE_TPU_SPEC_K /
+        # PADDLE_TPU_KV_DTYPE, read here — at call time — never at
+        # import). spec_k is folded into the verify Program as a
+        # static attr: one extra fixed signature, zero recompiles
+        # however the scheduler batches. kv_dtype sets the arena
+        # storage dtype (fp32 default = bit-identical to the
+        # unquantized engine; int8/fp8 halve-to-quarter bytes/token,
+        # which is more resident sequences per chip at equal HBM).
         self.prefix_cache_on = prefix_cache_enabled(prefix_cache)
         self.spec_k = spec_k_from_env(spec_k)
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.kv_bytes_per_token = kv_bytes_per_token(spec, self.kv_dtype)
         self.draft = draft if draft is not None else \
             (NgramDraft() if self.spec_k > 0 else None)
         self._progs = build_lm_programs(spec, self.max_batch,
                                         self.block_size, self.num_blocks,
                                         self.pages_per_seq,
-                                        spec_k=self.spec_k)
+                                        spec_k=self.spec_k,
+                                        kv_dtype=self.kv_dtype)
         # static IR verification of all three programs before anything
         # compiles (default warn; PADDLE_TPU_VERIFY=strict refuses a
         # broken graph at construction, not mid-traffic)
@@ -140,6 +149,10 @@ class DecodeEngine(object):
             self.load_weights(weights)
 
         self.pool = KVPool(self.num_blocks, self.block_size)
+        if _obs.enabled():
+            _obs.set_gauge('decode.kv_bytes_per_token',
+                           self.kv_bytes_per_token,
+                           kv_dtype=self.kv_dtype)
         self.prefix_cache = PrefixCache(self.pool) \
             if self.prefix_cache_on else None
         self._sched = Scheduler(self.pool, self.max_batch,
@@ -236,6 +249,12 @@ class DecodeEngine(object):
         """submit() + wait: returns the generated token list."""
         timeout = kwargs.pop('timeout', None)
         return self.submit(prompt_ids, **kwargs).result(timeout)
+
+    @property
+    def resident_seqs_peak(self):
+        """Most sequences ever concurrently RUNNING (page-resident) —
+        the capacity number the quantized-KV ablation measures."""
+        return self._sched.peak_running
 
     # ---------------------------------------------------------- lifecycle
     def ready(self):

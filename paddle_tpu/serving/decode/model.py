@@ -64,7 +64,34 @@ DecodePrograms = collections.namedtuple(
     'DecodePrograms',
     ['startup', 'prefill', 'decode', 'verify', 'prefill_fetch',
      'decode_fetch', 'verify_fetch', 'param_names', 'arena_names',
-     'capacity'])
+     'capacity', 'kv_dtype'])
+
+
+def kv_bytes_per_token(spec, kv_dtype='float32'):
+    """HBM bytes one cached token costs across all layers: the K/V
+    rows at the arena dtype plus (for quantized arenas) the per-token
+    per-head fp32 scale pair. This is the number the ISSUE's capacity
+    claim rides on: int8 at d_head=128 is ~3.9x less than fp32."""
+    from ...quant.core import kv_itemsize, kv_quantized
+    item = kv_itemsize(kv_dtype)
+    b = spec.n_layer * spec.n_head * (spec.d_key + spec.d_value) * item
+    if kv_quantized(kv_dtype):
+        b += spec.n_layer * spec.n_head * 2 * 4   # k + v scale rows
+    return b
+
+
+def arena_bytes(spec, num_blocks, block_size, kv_dtype='float32'):
+    """Total bytes of the K/V (+ scale) arenas."""
+    return kv_bytes_per_token(spec, kv_dtype) * int(num_blocks) * \
+        int(block_size)
+
+
+def num_blocks_for_budget(budget_bytes, spec, block_size,
+                          kv_dtype='float32'):
+    """Pages an arena byte budget buys at ``kv_dtype`` — how bench.py
+    sizes the equal-bytes capacity ablation."""
+    page = kv_bytes_per_token(spec, kv_dtype) * int(block_size)
+    return max(1, int(budget_bytes) // page)
 
 
 def _lm_params(spec, capacity):
@@ -92,7 +119,14 @@ def _lm_params(spec, capacity):
     return stacked, emb, pos, wout
 
 
-def _arenas(spec, num_blocks, block_size):
+def _arenas(spec, num_blocks, block_size, kv_dtype='float32'):
+    """K/V page arenas at ``kv_dtype``; quantized dtypes (int8 / fp8)
+    additionally get per-(page, head, slot) fp32 scale arenas — one
+    scale per written K/V row, so a page's stored bits are a pure
+    function of the tokens written into it (the bit-consistency
+    invariant) and prefix-cache sharing carries the scales for free
+    (same physical page index)."""
+    from ...quant.core import kv_quantized
     shapes = {
         'lm_kcache': [spec.n_layer, num_blocks, spec.n_head, block_size,
                       spec.d_key],
@@ -102,26 +136,52 @@ def _arenas(spec, num_blocks, block_size):
     out = {}
     for name, shape in shapes.items():
         out[name] = layers.create_parameter(
-            shape=shape, dtype='float32', name=name,
+            shape=shape, dtype=kv_dtype, name=name,
             attr=ParamAttr(name=name, initializer=Constant(0.0),
                            trainable=False))
-    return out['lm_kcache'], out['lm_vcache']
+    ks = vs = None
+    if kv_quantized(kv_dtype):
+        sshape = [spec.n_layer, num_blocks, spec.n_head, block_size]
+        ks, vs = [layers.create_parameter(
+            shape=sshape, dtype='float32', name=name,
+            attr=ParamAttr(name=name, initializer=Constant(1.0),
+                           trainable=False))
+            for name in ('lm_kscale', 'lm_vscale')]
+    return out['lm_kcache'], out['lm_vcache'], ks, vs
 
 
-def _common_inputs(stacked, emb, pos, wout, kc, vc):
+def _common_inputs(stacked, emb, pos, wout, kc, vc, ks=None, vs=None):
     inputs = {'Emb': [emb], 'PosEnc': [pos], 'OutProj': [wout],
               'KCache': [kc], 'VCache': [vc]}
+    if ks is not None:
+        inputs['KScale'] = [ks]
+        inputs['VScale'] = [vs]
     for slot, param in stacked.items():
         inputs[_slot_to_input(slot)] = [param]
     return inputs
 
 
+def _arena_outputs(kc, vc, ks=None, vs=None):
+    outputs = {'KCacheOut': [kc], 'VCacheOut': [vc]}
+    if ks is not None:
+        outputs['KScaleOut'] = [ks]
+        outputs['VScaleOut'] = [vs]
+    return outputs
+
+
 def build_lm_programs(spec, max_batch, block_size, num_blocks,
-                      pages_per_seq, spec_k=0):
+                      pages_per_seq, spec_k=0, kv_dtype='float32'):
     """Returns DecodePrograms. ``capacity`` (= pages_per_seq *
     block_size) bounds prompt_len + max_new_tokens per sequence.
     ``spec_k > 0`` additionally builds the speculative-decoding
-    verify Program ([max_batch, spec_k+1], one fixed signature)."""
+    verify Program ([max_batch, spec_k+1], one fixed signature).
+    ``kv_dtype`` (fp32 default / bf16 / int8 / fp8) sets the arena
+    storage dtype; quantized arenas carry fp32 scale arenas alongside
+    and dequantize inside the shared paged-attention path, so every
+    feed signature is unchanged — the zero-recompile contract holds at
+    any dtype."""
+    from ...quant.core import resolve_kv_dtype
+    kv_dtype = resolve_kv_dtype(kv_dtype)
     capacity = int(pages_per_seq) * int(block_size)
     spec_k = int(spec_k)
     startup = Program()
@@ -130,7 +190,7 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
 
     with program_guard(prefill_prog, startup):
         stacked, emb, pos, wout = _lm_params(spec, capacity)
-        kc, vc = _arenas(spec, num_blocks, block_size)
+        kc, vc, ks, vs = _arenas(spec, num_blocks, block_size, kv_dtype)
         ids = layers.data(name='pf_ids', shape=[-1], dtype='int64')
         length = layers.data(name='pf_len', shape=[], dtype='int32')
         cached = layers.data(name='pf_cached', shape=[], dtype='int32')
@@ -141,20 +201,21 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
         helper = LayerHelper('paged_prefill', name='paged_prefill')
         nxt = helper.create_variable_for_type_inference('int64')
         nxt.shape = (1,)
-        inputs = _common_inputs(stacked, emb, pos, wout, kc, vc)
+        inputs = _common_inputs(stacked, emb, pos, wout, kc, vc, ks, vs)
         inputs.update({'Ids': [ids], 'Len': [length], 'Cached': [cached],
                        'BlockTable': [table], 'Temp': [temp],
                        'Seed': [seed]})
+        outputs = dict(_arena_outputs(kc, vc, ks, vs),
+                       NextToken=[nxt])
         helper.append_op(type='paged_prefill', inputs=inputs,
-                         outputs={'NextToken': [nxt],
-                                  'KCacheOut': [kc], 'VCacheOut': [vc]},
+                         outputs=outputs,
                          attrs={'n_head': spec.n_head,
                                 'block_size': int(block_size)})
         prefill_fetch = nxt.name
 
     with program_guard(decode_prog, startup):
         stacked, emb, pos, wout = _lm_params(spec, capacity)
-        kc, vc = _arenas(spec, num_blocks, block_size)
+        kc, vc, ks, vs = _arenas(spec, num_blocks, block_size, kv_dtype)
         tokens = layers.data(name='dec_tokens', shape=[], dtype='int64')
         lens = layers.data(name='dec_lens', shape=[], dtype='int32')
         tables = layers.data(name='dec_tables', shape=[pages_per_seq],
@@ -164,13 +225,14 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
         helper = LayerHelper('paged_decode_step', name='paged_decode_step')
         nxt = helper.create_variable_for_type_inference('int64')
         nxt.shape = (max_batch,)
-        inputs = _common_inputs(stacked, emb, pos, wout, kc, vc)
+        inputs = _common_inputs(stacked, emb, pos, wout, kc, vc, ks, vs)
         inputs.update({'Tokens': [tokens], 'SeqLens': [lens],
                        'BlockTables': [tables], 'Temps': [temps],
                        'Seeds': [seeds]})
+        outputs = dict(_arena_outputs(kc, vc, ks, vs),
+                       NextTokens=[nxt])
         helper.append_op(type='paged_decode_step', inputs=inputs,
-                         outputs={'NextTokens': [nxt],
-                                  'KCacheOut': [kc], 'VCacheOut': [vc]},
+                         outputs=outputs,
                          attrs={'n_head': spec.n_head,
                                 'block_size': int(block_size)})
         decode_fetch = nxt.name
@@ -180,7 +242,8 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
         verify_prog = Program()
         with program_guard(verify_prog, startup):
             stacked, emb, pos, wout = _lm_params(spec, capacity)
-            kc, vc = _arenas(spec, num_blocks, block_size)
+            kc, vc, ks, vs = _arenas(spec, num_blocks, block_size,
+                                     kv_dtype)
             tokens = layers.data(name='sv_tokens', shape=[spec_k + 1],
                                  dtype='int64')
             lens = layers.data(name='sv_lens', shape=[], dtype='int32')
@@ -193,14 +256,15 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
                                  name='paged_spec_verify')
             nxt = helper.create_variable_for_type_inference('int64')
             nxt.shape = (max_batch, spec_k + 1)
-            inputs = _common_inputs(stacked, emb, pos, wout, kc, vc)
+            inputs = _common_inputs(stacked, emb, pos, wout, kc, vc,
+                                    ks, vs)
             inputs.update({'Tokens': [tokens], 'SeqLens': [lens],
                            'BlockTables': [tables], 'Temps': [temps],
                            'Seeds': [seeds]})
+            outputs = dict(_arena_outputs(kc, vc, ks, vs),
+                           NextTokens=[nxt])
             helper.append_op(type='paged_spec_verify', inputs=inputs,
-                             outputs={'NextTokens': [nxt],
-                                      'KCacheOut': [kc],
-                                      'VCacheOut': [vc]},
+                             outputs=outputs,
                              attrs={'n_head': spec.n_head,
                                     'block_size': int(block_size),
                                     'k': spec_k})
@@ -209,14 +273,17 @@ def build_lm_programs(spec, max_batch, block_size, num_blocks,
     param_names = sorted(
         {'lm_emb', 'lm_pos_enc', 'lm_out_proj.w'} |
         {p.name for p in stacked.values()})
+    arena_names = ('lm_kcache', 'lm_vcache')
+    if ks is not None:
+        arena_names += ('lm_kscale', 'lm_vscale')
     return DecodePrograms(
         startup=startup, prefill=prefill_prog, decode=decode_prog,
         verify=verify_prog,
         prefill_fetch=prefill_fetch, decode_fetch=decode_fetch,
         verify_fetch=verify_fetch,
         param_names=param_names,
-        arena_names=('lm_kcache', 'lm_vcache'),
-        capacity=capacity)
+        arena_names=arena_names,
+        capacity=capacity, kv_dtype=kv_dtype)
 
 
 def random_weights(spec, seed=0):

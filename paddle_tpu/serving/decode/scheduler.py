@@ -155,6 +155,7 @@ class Scheduler(object):
         self.cache = cache
         self.waiting = collections.deque()
         self.running = []          # admission order (oldest first)
+        self.peak_running = 0      # high-water mark of resident seqs
         self._mu = threading.Lock()
 
     # ------------------------------------------------------------ intake
@@ -202,6 +203,11 @@ class Scheduler(object):
             seq.state = RUNNING
             seq.t_admit = time.perf_counter()
             self.running.append(seq)
+            if len(self.running) > self.peak_running:
+                self.peak_running = len(self.running)
+                if _obs.enabled():
+                    _obs.set_gauge('decode.running_seqs_peak',
+                                   self.peak_running)
         self._publish()
         return seq
 

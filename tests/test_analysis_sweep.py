@@ -116,6 +116,44 @@ def test_decode_model_builders_verify():
     _strict('decode_spec_verify', progs.verify, [progs.verify_fetch])
 
 
+def test_quantized_decode_builders_verify():
+    # the int8 KV arena builders, including the quant pass's
+    # arena/scale pairing contracts
+    from paddle_tpu.serving.decode.model import (LMSpec,
+                                                 build_lm_programs)
+    progs = build_lm_programs(LMSpec(vocab_size=128), 4, 8, 16, 4,
+                              spec_k=2, kv_dtype='int8')
+    _strict('decode_q_startup', progs.startup)
+    _strict('decode_q_prefill', progs.prefill, [progs.prefill_fetch])
+    _strict('decode_q_step', progs.decode, [progs.decode_fetch])
+    _strict('decode_q_verify', progs.verify, [progs.verify_fetch])
+
+
+def test_ptq_program_verifies():
+    # the PTQ Program->Program rewrite under the strict sweep — the
+    # quant pass's dtype/scale contracts must hold on its own output
+    import numpy as np
+
+    from paddle_tpu import quant
+
+    ids = fluid.layers.data(name='ids', shape=[4], dtype='int64')
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    emb = fluid.layers.embedding(input=ids, size=[64, 8])
+    pooled = fluid.layers.reduce_sum(emb, dim=1)
+    h = fluid.layers.fc(input=[x, pooled], size=16, act='relu')
+    out = fluid.layers.fc(input=h, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = fluid.io.get_inference_program([out])
+    qprog, report = quant.quantize_inference_program(
+        infer, fluid.global_scope(),
+        sample_feed={'ids': np.zeros((4, 4, 1), 'int64'),
+                     'x': np.zeros((4, 8), 'float32')},
+        executor=exe)
+    assert report['quantized'] >= 3
+    _strict('ptq_mlp', qprog, [out.name], feeds=['ids', 'x'])
+
+
 def test_seq2seq_graphs_verify():
     # the attention seq2seq train graph plus the beam-search generation
     # graph — the hairiest builders in the model zoo (recurrent nets,

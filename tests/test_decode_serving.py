@@ -306,7 +306,8 @@ def test_decode_bench_json_schema(tmp_path):
          '--d-inner', '32', '--block-size', '4', '--num-blocks', '32',
          '--pages-per-seq', '6', '--prompt-lo', '1', '--prompt-hi', '12',
          '--max-new', '8', '--prefix-cache', '--spec-k', '2',
-         '--shared-prefix', '0.9', '--shared-prefix-len', '9', '--json'],
+         '--shared-prefix', '0.9', '--shared-prefix-len', '9',
+         '--kv-dtype', 'int8', '--json'],
         capture_output=True, text=True, timeout=300,
         env=dict(os.environ, JAX_PLATFORMS='cpu'))
     assert out.returncode == 0, out.stderr[-2000:]
@@ -315,7 +316,8 @@ def test_decode_bench_json_schema(tmp_path):
                 'requests_ok', 'preemptions', 'warmup', 'executor',
                 'engine', 'kv_blocks_free_end', 'cache_hit_rate',
                 'prefill_tokens_skipped', 'accepted_draft_length',
-                'ttft_ms', 'spec_steps'):
+                'ttft_ms', 'spec_steps', 'resident_seqs_peak',
+                'kv_bytes_per_token'):
         assert key in doc, key
     assert doc['requests_ok'] > 0
     assert doc['inter_token_ms']['p99'] is not None
@@ -330,6 +332,12 @@ def test_decode_bench_json_schema(tmp_path):
         assert k in doc['accepted_draft_length'], k
     assert doc['engine']['prefix_cache'] is True
     assert doc['engine']['spec_k'] == 2
+    # the int8 arena: 1 byte/elem + per-row fp32 scale pair, and the
+    # whole prefix-cache/spec path ran over it (asserts above)
+    assert doc['engine']['kv_dtype'] == 'int8'
+    spec_bytes = 1 * 2 * (8 + 8) + 1 * 2 * 2 * 4   # L*H*(dk+dv) + scales
+    assert doc['kv_bytes_per_token'] == spec_bytes
+    assert doc['resident_seqs_peak'] >= 1
 
 
 @pytest.mark.slow

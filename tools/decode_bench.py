@@ -22,7 +22,9 @@ open with one shared system prompt (the fleet-realistic mix),
 `cache_hit_rate`, `prefill_tokens_skipped`, and the cached-vs-cold
 `ttft_ms` split), and `--spec-k K` turns on draft-and-verify decoding
 (watch `accepted_draft_length` p50/mean and tokens/sec vs the k=0
-baseline).
+baseline), and `--kv-dtype int8` (or fp8/bf16) quantizes the KV page
+arena — `kv_bytes_per_token` and `resident_seqs_peak` report the
+capacity side of that trade so it is measured, not asserted.
 
 Metrics land in the standard observe pipeline (--metrics-jsonl /
 PADDLE_TPU_METRICS_JSONL -> tools/metrics_report.py). --json emits one
@@ -63,6 +65,12 @@ def main(argv=None):
                    help='enable the global radix prefix cache')
     p.add_argument('--spec-k', type=int, default=0,
                    help='speculative decoding draft length (0 = off)')
+    p.add_argument('--kv-dtype', default='fp32',
+                   choices=['fp32', 'bf16', 'int8', 'fp8'],
+                   help='KV arena storage dtype (int8/fp8 carry '
+                        'per-row fp32 scales; watch '
+                        'kv_bytes_per_token and resident_seqs_peak '
+                        'for the capacity win)')
     p.add_argument('--shared-prefix', type=float, default=0.0,
                    help='fraction of requests opening with one shared '
                         'system prompt (0..1)')
@@ -102,7 +110,8 @@ def main(argv=None):
                           pages_per_seq=args.pages_per_seq,
                           max_queue_depth=args.max_queue_depth,
                           prefix_cache=args.prefix_cache or None,
-                          spec_k=args.spec_k or None)
+                          spec_k=args.spec_k or None,
+                          kv_dtype=args.kv_dtype)
     capacity = engine.capacity
     prompt_hi = min(args.prompt_hi, max(args.prompt_lo,
                                         capacity - args.max_new))
@@ -185,6 +194,11 @@ def main(argv=None):
         'preemptions': counters.get('decode.preemptions_total', 0),
         'pool_exhausted': counters.get('decode.pool_exhausted_total', 0),
         'kv_blocks_free_end': engine.pool.free_blocks(),
+        # capacity: most sequences ever page-resident at once, and what
+        # one cached token costs at this arena dtype — measure, don't
+        # assert, the quantized-KV win
+        'resident_seqs_peak': engine.resident_seqs_peak,
+        'kv_bytes_per_token': engine.kv_bytes_per_token,
         # prefix cache: lookup hit rate, tokens whose prefill was
         # skipped (the shared spans mapped from cached pages), and
         # time-to-first-token split by hit/miss — the TTFT delta IS
@@ -216,7 +230,8 @@ def main(argv=None):
                    'capacity_tokens': capacity,
                    'prompt_buckets': engine.prompt_buckets,
                    'prefix_cache': engine.prefix_cache_on,
-                   'spec_k': engine.spec_k},
+                   'spec_k': engine.spec_k,
+                   'kv_dtype': engine.kv_dtype},
         'workload': {'shared_prefix': args.shared_prefix,
                      'shared_prefix_len': len(shared)},
         'model': {'vocab': args.vocab, 'n_layer': args.n_layer,
@@ -246,6 +261,10 @@ def main(argv=None):
               'free-at-end=%d/%d'
               % (report['preemptions'], report['pool_exhausted'],
                  engine.pool.free_blocks(), args.num_blocks))
+        print('  kv         dtype=%s bytes/token=%d '
+              'resident-seqs-peak=%d'
+              % (engine.kv_dtype, engine.kv_bytes_per_token,
+                 report['resident_seqs_peak']))
         if report['cache_hit_rate'] is not None:
             tt = report['ttft_ms']
 

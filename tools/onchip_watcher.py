@@ -86,6 +86,10 @@ QUEUE = [
     # self-healing autoscaling fleet (ISSUE 11): flash-crowd scale-up,
     # crash-loop quarantine, trough scale-in, hedged-request budget
     ('autoscale', 'autoscale', None, 700),
+    # quantization end-to-end (ISSUE 13): int8-allreduce bytes/loss
+    # ablation, equal-bytes quantized-KV capacity + parity, fleet A/B
+    # on goodput/burn; quant.* gauges land in the shared metrics JSONL
+    ('quant', 'quant', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),

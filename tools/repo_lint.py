@@ -41,7 +41,13 @@ ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
                     'paddle_tpu/serving/decode/prefix_cache.py',
                     'paddle_tpu/serving/decode/spec.py',
                     'paddle_tpu/observe/slo.py',
-                    'paddle_tpu/observe/reqtrace.py')
+                    'paddle_tpu/observe/reqtrace.py',
+                    # quantization knobs (PADDLE_TPU_QUANT_ALLREDUCE /
+                    # QUANT_BLOCK / KV_DTYPE) must stay per-call reads
+                    'paddle_tpu/quant/__init__.py',
+                    'paddle_tpu/quant/core.py',
+                    'paddle_tpu/quant/ptq.py',
+                    'paddle_tpu/parallel/collective.py')
 LINT_ROOT = 'paddle_tpu'
 
 _ENV_ATTRS = ('environ', 'getenv')
