@@ -1363,6 +1363,241 @@ def bench_autoscale(in_dim=8, max_batch=8, max_queue_depth=12,
     }
 
 
+def bench_disagg(duration=5.0, clients=10, n_prefill=1, n_decode=2,
+                 vocab=4000, n_layer=4, n_head=4, d_model=128,
+                 d_inner=256, max_batch=8, block_size=16,
+                 num_blocks=256, pages_per_seq=16,
+                 long_prompt_frac=0.35, shared_prefix=0.6,
+                 shared_prefix_len=32, ttft_budget_s=3.0,
+                 kv_dtype=None, seed=0):
+    """Disaggregated-vs-colocated fleet A/B at EQUAL total chip count
+    (ISSUE 14's headline). Both legs run the same engines-per-fleet
+    count (``n_prefill + n_decode``), the same weights, and the same
+    mixed long-prompt/long-decode chaos mix (``loadgen.phase_mix``:
+    a minority of prefill-heavy requests stall everything behind them
+    on a colocated replica); the disaggregated leg splits the fleet
+    into a prefill pool and a decode pool joined by the zero-copy KV
+    handoff, the colocated leg serves both phases on every replica.
+    Asserted here (and re-asserted by tests/test_handoff.py):
+
+    - **inter-token p99**: disaggregated strictly below colocated —
+      decode replicas never run a long prefill, so the inter-token
+      tail collapses to the decode-step cadence plus a small suffix
+      prefill.
+    - **TTFT within budget**: the handoff hop (prefill elsewhere +
+      packet install + suffix prefill) keeps p95 TTFT under
+      ``ttft_budget_s``.
+    - **lost == 0 on both fleets**: every accepted request completes.
+    - **zero post-warmup executor cache misses on BOTH fleets**: the
+      handoff installs pages between dispatches, the decode side's
+      suffix prefill rides a warmed bucket — no new XLA signature on
+      either side of the boundary.
+
+    ``kv_dtype='int8'`` shrinks handoff wire bytes 3-4x (per-row
+    scales ride in the packet); the returned ``handoff`` ledger
+    reports measured bytes/page either way."""
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.serving import PhaseRouter, QueueFullError
+    from paddle_tpu.serving.decode import (DecodeEngine, LMSpec,
+                                           kv_page_bytes,
+                                           random_weights)
+    from paddle_tpu.serving.loadgen import (Stats, closed_loop,
+                                            percentiles, phase_mix)
+
+    d_head = max(8, d_model // n_head)
+    spec = LMSpec(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                  d_key=d_head, d_value=d_head, d_model=d_model,
+                  d_inner=d_inner)
+    weights = random_weights(spec, seed=11)
+    capacity = pages_per_seq * block_size
+    # long prompts land in the TOP prefill bucket (a dispatch tens of
+    # times a decode step's cost — the stall colocation suffers);
+    # leave room for the long-prompt leg's short decode
+    long_hi = capacity - 56
+    shared_ids = np.random.RandomState(1234).randint(
+        0, vocab, shared_prefix_len).tolist()
+
+    def make_engine(name):
+        return DecodeEngine(spec, max_batch=max_batch,
+                            block_size=block_size,
+                            num_blocks=num_blocks,
+                            pages_per_seq=pages_per_seq,
+                            max_queue_depth=8 * clients,
+                            prefix_cache=True, kv_dtype=kv_dtype,
+                            weights=weights, name=name)
+
+    def misses(snap):
+        return sum(v for k, v in snap['counters'].items()
+                   if k.startswith('executor.cache_miss_total'))
+
+    def counter_sum(snap, prefix):
+        return sum(v for k, v in snap['counters'].items()
+                   if k.startswith(prefix))
+
+    def run_leg(tag, disagg):
+        n_pre = n_prefill if disagg else 0
+        n_dec = n_decode if disagg else n_prefill + n_decode
+        pre = [make_engine('%s-pf%d' % (tag, i)) for i in range(n_pre)]
+        dec = [make_engine('%s-dc%d' % (tag, i)) for i in range(n_dec)]
+        for e in pre + dec:
+            e.warmup()
+            e.start()
+        router = PhaseRouter(pre, dec, route=tag,
+                             colocated=not disagg,
+                             max_inflight=4 * clients)
+        # the zero-recompile window opens AFTER warmup: anything from
+        # here on is a live-traffic signature the invariant forbids
+        snap0 = observe.snapshot()
+        stats = Stats()
+        mu = threading.Lock()
+        gaps, ttfts = [], []
+        accepted = [0]
+        completed = [0]
+
+        def do_request(rng):
+            plen, max_new = phase_mix(
+                rng, long_prompt_frac=long_prompt_frac,
+                long_prompt=(long_hi - 32, long_hi))
+            if rng.rand() < shared_prefix:
+                tail = max(1, plen - shared_prefix_len)
+                prompt = shared_ids + \
+                    rng.randint(0, vocab, tail).tolist()
+            else:
+                prompt = rng.randint(0, vocab, plen).tolist()
+            t_sub = time.perf_counter()
+            stream = router.submit(prompt, max_new_tokens=max_new,
+                                   seed=int(rng.randint(1 << 20)),
+                                   session=int(rng.randint(0, 16)))
+            with mu:
+                accepted[0] += 1
+            n, t_prev, local = 0, None, []
+            t_first = None
+            for _tok in stream:
+                now = time.perf_counter()
+                if t_first is None:
+                    t_first = now
+                if t_prev is not None:
+                    local.append(now - t_prev)
+                t_prev = now
+                n += 1
+            with mu:
+                completed[0] += 1
+                gaps.extend(local)
+                if t_first is not None:
+                    ttfts.append(t_first - t_sub)
+            return n
+
+        t0 = time.perf_counter()
+        closed_loop(do_request, stats, t0 + duration, clients)
+        router.close(shutdown_replicas=True)
+        wall = time.perf_counter() - t0
+        snap1 = observe.snapshot()
+        return {
+            'fleet': tag,
+            'engines': n_pre + n_dec,
+            'prefill_replicas': n_pre,
+            'decode_replicas': n_dec,
+            'duration_s': round(wall, 3),
+            'requests_ok': stats.ok,
+            'requests_rejected': stats.rejected,
+            'requests_errored': stats.errors,
+            'accepted': accepted[0],
+            'completed': completed[0],
+            'lost': accepted[0] - completed[0],
+            'tokens': len(gaps) + len(ttfts),
+            'inter_token_ms': percentiles(gaps),
+            'ttft_ms': percentiles(ttfts),
+            'request_ms': percentiles(stats.latencies),
+            'post_warmup_cache_misses': misses(snap1) - misses(snap0),
+            'handoffs': counter_sum(snap1, 'handoff.count_total')
+            - counter_sum(snap0, 'handoff.count_total'),
+            'handoff_pages_installed':
+                counter_sum(snap1, 'handoff.pages_installed_total')
+                - counter_sum(snap0, 'handoff.pages_installed_total'),
+            'handoff_pages_deduped':
+                counter_sum(snap1, 'handoff.pages_deduped_total')
+                - counter_sum(snap0, 'handoff.pages_deduped_total'),
+            'handoff_bytes':
+                counter_sum(snap1, 'handoff.bytes_total')
+                - counter_sum(snap0, 'handoff.bytes_total'),
+            'preemptions':
+                counter_sum(snap1, 'decode.preemptions_total')
+                - counter_sum(snap0, 'decode.preemptions_total'),
+        }
+
+    # every engine in both legs builds the same three programs — ride
+    # the AOT executable cache so engine #2..N deserialize their
+    # prefill ladder instead of re-compiling it (the same trick the
+    # autoscale bench uses for ~0.1s spawns)
+    import tempfile
+    prev = {k: os.environ.get(k) for k in
+            ('PADDLE_TPU_AOT_CACHE', 'PADDLE_TPU_AOT_CACHE_DIR')}
+    os.environ['PADDLE_TPU_AOT_CACHE'] = '1'
+    os.environ['PADDLE_TPU_AOT_CACHE_DIR'] = \
+        tempfile.mkdtemp(prefix='paddle_tpu_disagg_aot_')
+    try:
+        observe.flush(kind='snapshot')
+        coloc = run_leg('coloc', disagg=False)
+        observe.flush(kind='snapshot')
+        split = run_leg('disagg', disagg=True)
+        observe.flush(kind='snapshot')
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    p99_coloc = coloc['inter_token_ms'].get('p99')
+    p99_disagg = split['inter_token_ms'].get('p99')
+    ttft_p95 = split['ttft_ms'].get('p95')
+    # the headline contract — each one a hard assertion, not a report
+    assert coloc['lost'] == 0 and split['lost'] == 0, \
+        'request loss: coloc=%d disagg=%d' % (coloc['lost'],
+                                              split['lost'])
+    assert coloc['post_warmup_cache_misses'] == 0, \
+        'colocated fleet recompiled post-warmup: %d misses' \
+        % coloc['post_warmup_cache_misses']
+    assert split['post_warmup_cache_misses'] == 0, \
+        'disaggregated fleet recompiled post-warmup: %d misses ' \
+        '(the handoff must not mint signatures)' \
+        % split['post_warmup_cache_misses']
+    assert p99_coloc is not None and p99_disagg is not None, \
+        'no inter-token samples'
+    assert p99_disagg < p99_coloc, \
+        'disaggregation did not beat colocated inter-token p99: ' \
+        '%.2fms vs %.2fms' % (p99_disagg, p99_coloc)
+    assert ttft_p95 is not None and \
+        ttft_p95 <= ttft_budget_s * 1000.0, \
+        'disagg TTFT p95 %.1fms blew the %.1fms budget' \
+        % (ttft_p95 or -1, ttft_budget_s * 1000.0)
+    assert split['handoffs'] > 0, 'no handoffs happened'
+
+    from paddle_tpu.quant.core import resolve_kv_dtype
+    kv = resolve_kv_dtype(kv_dtype)
+    observe.set_gauge('disagg.inter_token_p99_ms', p99_disagg)
+    observe.set_gauge('disagg.coloc_inter_token_p99_ms', p99_coloc)
+    observe.set_gauge('disagg.ttft_p95_ms', ttft_p95)
+    return {
+        'workload': 'disagg',
+        'colocated': coloc,
+        'disaggregated': split,
+        'inter_token_p99_improvement': round(p99_coloc / p99_disagg, 3)
+        if p99_disagg else None,
+        'ttft_budget_s': ttft_budget_s,
+        'kv_dtype': kv,
+        'page_wire_bytes': kv_page_bytes(spec, block_size, kv),
+        'page_wire_bytes_fp32': kv_page_bytes(spec, block_size,
+                                              'float32'),
+        'traffic': {'clients': clients,
+                    'long_prompt_frac': long_prompt_frac,
+                    'shared_prefix': shared_prefix,
+                    'shared_prefix_len': shared_prefix_len},
+    }
+
+
 def _build_resnet_step(batch, image, train=True):
     """One source of truth for the ResNet bench setup — the headline
     img/s (train=True) and the anatomy profile share it, so the
@@ -1842,6 +2077,16 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(steps=60, kv_duration=1.5, fleet_duration=3.0,
                   reduced=True) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_quant(**kw)),
+              flush=True)
+        return
+    if workload == 'disagg':
+        # reduced: small model but LONG capacity (pages_per_seq=32 ->
+        # 512-token prompts), so the top prefill bucket still costs
+        # tens of decode steps — the stall the A/B measures
+        kw = dict(duration=2.5, clients=6, vocab=2048, n_layer=2,
+                  n_head=4, d_model=64, d_inner=128,
+                  pages_per_seq=32, num_blocks=256) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_disagg(**kw)),
               flush=True)
         return
     if workload == 'transformer_seq512_masked':
@@ -2385,7 +2630,7 @@ if __name__ == '__main__':
                                 'pipeline_transformer',
                                 'pipeline_resnet50',
                                 'decode_transformer', 'fleet',
-                                'autoscale', 'quant',
+                                'autoscale', 'quant', 'disagg',
                                 'autotune', 'autotune_child', 'verify'])
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')

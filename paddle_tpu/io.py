@@ -100,6 +100,16 @@ def _spec_from_json(entries, axis_names):
     return PartitionSpec(*parts)
 
 
+# Public names for the spec (de)serializers: the elastic-checkpoint
+# writer (above) and the KV-handoff packet header
+# (serving/handoff.py) share one wire form for logical shardings —
+# moving a sequence's KV pages between meshes is the same problem as
+# resuming a checkpoint on a different slice, so they must stay one
+# format.
+spec_to_json = _spec_to_json
+spec_from_json = _spec_from_json
+
+
 def checkpoint_topology(meta):
     """(hosts, {axis: size}) recorded in a checkpoint meta dict, or None
     for a pre-elastic checkpoint (format_version absent)."""

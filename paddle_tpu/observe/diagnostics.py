@@ -189,6 +189,10 @@ def _decode_status(snap):
     looked = sum(lookups.values())
     spec_steps = counters.get('decode.spec_steps_total', 0)
     accepted = counters.get('decode.spec_accepted_tokens_total', 0)
+    stall = snap.get('histograms', {}).get(
+        'decode.alloc_stall_seconds', {})
+    handoffs = sum(v for k, v in counters.items()
+                   if parse_rendered(k)[0] == 'handoff.count_total')
     return {
         'running_seqs': gauges.get('decode.running_seqs'),
         'waiting_seqs': gauges.get('decode.waiting_seqs'),
@@ -215,6 +219,25 @@ def _decode_status(snap):
         'spec_steps_total': spec_steps or None,
         'spec_accepted_len_mean':
             (accepted / float(spec_steps)) if spec_steps else None,
+        # allocator pressure: page handoff lands whole page groups at
+        # once, so fragmentation and alloc stalls are cross-replica
+        # signals — free count vs largest contiguous run, plus time
+        # requests spent waiting on the allocator
+        'kv_largest_free_run':
+            gauges.get('decode.kv_largest_free_run'),
+        'kv_fragmentation': gauges.get('decode.kv_fragmentation'),
+        'alloc_stalls': stall.get('count'),
+        'alloc_stall_seconds_p99': stall.get('p99'),
+        # KV handoff (disaggregated prefill/decode): hops, pages moved
+        # vs deduplicated at the receiving cache, wire bytes
+        'handoff_total': handoffs or None,
+        'handoff_pages_installed_total':
+            counters.get('handoff.pages_installed_total'),
+        'handoff_pages_deduped_total':
+            counters.get('handoff.pages_deduped_total'),
+        'handoff_bytes_total': counters.get('handoff.bytes_total'),
+        'handoff_seconds_p99': snap.get('histograms', {}).get(
+            'handoff.seconds', {}).get('p99'),
     }
 
 
@@ -317,6 +340,18 @@ def _router_status(snap):
                  if parse_rendered(k)[0] == 'router.hedge_total')
     requests = sum(v for k, v in counters.items()
                    if parse_rendered(k)[0] == 'router.requests_total')
+    phases = {}
+    for rendered, v in gauges.items():
+        name, labels = parse_rendered(rendered)
+        if name in ('router.phase_replicas',
+                    'router.phase_replicas_ready'):
+            ph = phases.setdefault(labels.get('phase', '?'), {})
+            ph['ready' if name.endswith('_ready') else 'total'] = v
+    for rendered, v in counters.items():
+        name, labels = parse_rendered(rendered)
+        if name == 'router.phase_dispatch_total':
+            ph = phases.setdefault(labels.get('phase', '?'), {})
+            ph['dispatched'] = ph.get('dispatched', 0) + v
     return {
         'replicas_ready': gauges.get('router.replicas_ready'),
         'replicas_total': gauges.get('router.replicas_total'),
@@ -330,6 +365,8 @@ def _router_status(snap):
         else None,
         'retry_budget_tokens':
             gauges.get('router.retry_budget_tokens'),
+        # disaggregated fleets: per-phase replica census + dispatches
+        'phases': phases or None,
     }
 
 

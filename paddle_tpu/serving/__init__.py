@@ -13,17 +13,26 @@ via `observe.slo`); `FleetController` closes the loop over the SLO
 signals (scale out/in, self-heal with exponential backoff, crash-loop
 quarantine); per-request distributed tracing (`observe.reqtrace`)
 follows each sampled request across the submit/batcher/dispatcher
-threads under one trace id. See docs/serving.md; load-test with
+threads under one trace id. `PhaseRouter` splits a decode fleet by
+phase — prefill replicas (compute-bound, bucket-laddered) feeding
+decode replicas (HBM-bound, paged) through the zero-copy KV handoff
+in `serving.handoff`, with per-phase autoscaling policies
+(`ttft_pressure` / `page_pressure`) plugging into `FleetController`.
+See docs/serving.md; load-test with
 tools/serving_bench.py, chaos-test the fleet with `bench.py
---workload fleet` and the autoscaler with `--workload autoscale`.
+--workload fleet`, the autoscaler with `--workload autoscale`, and
+the disaggregated fleet with `--workload disagg`.
 """
 
 from .buckets import BatchInfo, BucketLadder, pow2_ladder  # noqa: F401
-from .controller import FleetController, ReplicaFactory  # noqa: F401
+from .controller import (FleetController, ReplicaFactory,  # noqa: F401
+                         page_pressure, ttft_pressure)
 from .engine import (EngineClosedError, QueueFullError,  # noqa: F401
                      ServingEngine)
-from .router import (NoReplicaAvailableError, Router,  # noqa: F401
-                     SLOShedError)
+from .handoff import (HandoffError, KVDtypeMismatchError,  # noqa: F401
+                      KVGeometryError, KVPacket)
+from .router import (NoReplicaAvailableError, PhaseRouter,  # noqa: F401
+                     Router, SLOShedError)
 
 # The decode subpackage (continuous batching + paged KV cache) imports
 # lazily via `from paddle_tpu.serving import decode` /

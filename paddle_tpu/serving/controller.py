@@ -51,8 +51,8 @@ import time
 
 from .. import observe as _obs
 
-__all__ = ['FleetController', 'ReplicaFactory',
-           'UP', 'DRAINING', 'QUARANTINED', 'DEAD']
+__all__ = ['FleetController', 'ReplicaFactory', 'ttft_pressure',
+           'page_pressure', 'UP', 'DRAINING', 'QUARANTINED', 'DEAD']
 
 # replica state machine (the /statusz fleet panel renders these; the
 # numeric codes are what the controller.replica_state gauge carries)
@@ -105,6 +105,56 @@ class ReplicaFactory(object):
             return ReplicaFactory(factory)
         raise TypeError('factory must be callable or expose '
                         '.create(name), got %r' % (factory,))
+
+
+def ttft_pressure(phase_router, budget_s, high=1.0, low=0.5):
+    """Per-phase scaling policy for the PREFILL pool of a
+    :class:`~paddle_tpu.serving.router.PhaseRouter`: pressure when the
+    rolling TTFT attribution (prefill phase + handoff p95) burns past
+    ``high`` x ``budget_s``, calm below ``low`` x ``budget_s``.
+    Returns ``(pressure_fn, calm_fn)`` for ``FleetController(
+    router=pr.pool('prefill'), pressure_fn=..., calm_fn=...)`` —
+    prefill replicas are compute-bound, so the signal that matters is
+    how long prompts wait for FLOPs, not page occupancy."""
+    budget_s = float(budget_s)
+
+    def pressure_fn(now):
+        p95 = phase_router.prefill_phase_p95()
+        signals = {'ttft_p95': p95, 'ttft_budget': budget_s,
+                   'mean_queue_depth': 0.0, 'burn_rate': None}
+        if p95 is not None and p95 > high * budget_s:
+            return True, 'ttft_burn', signals
+        return False, None, signals
+
+    def calm_fn(signals):
+        p95 = signals.get('ttft_p95')
+        return p95 is None or p95 < low * budget_s
+
+    return pressure_fn, calm_fn
+
+
+def page_pressure(phase_router, free_low=0.15, free_high=0.5):
+    """Per-phase scaling policy for the DECODE pool: pressure when the
+    most page-starved ready decode replica's free-page fraction drops
+    below ``free_low``, calm once every replica is back above
+    ``free_high``. Decode replicas are HBM-bound — KV pages, not
+    FLOPs, are the resource that runs out (each handoff lands a whole
+    page group at once, so allocator pressure is a fleet signal, not a
+    replica detail)."""
+
+    def pressure_fn(now):
+        frac = phase_router.decode_free_page_frac()
+        signals = {'free_page_frac': frac, 'mean_queue_depth': 0.0,
+                   'burn_rate': None}
+        if frac is not None and frac < free_low:
+            return True, 'page_pressure', signals
+        return False, None, signals
+
+    def calm_fn(signals):
+        frac = signals.get('free_page_frac')
+        return frac is None or frac > free_high
+
+    return pressure_fn, calm_fn
 
 
 class _Lineage(object):
@@ -173,8 +223,15 @@ class FleetController(object):
                  backoff_base_s=0.25, backoff_max_s=8.0,
                  crash_loop_threshold=3, crash_window_s=10.0,
                  quarantine_s=30.0, drain_timeout_s=30.0,
-                 name_prefix='auto'):
+                 name_prefix='auto', pressure_fn=None, calm_fn=None):
         self.router = router
+        # pluggable pressure: a phase-split fleet scales each pool on
+        # its own physics — ``ttft_pressure`` (prefill, compute-bound)
+        # and ``page_pressure`` (decode, HBM-bound) build the
+        # (pressure_fn, calm_fn) pair; None keeps the SLO/queue-depth
+        # policy below
+        self.pressure_fn = pressure_fn
+        self.calm_fn = calm_fn
         self.factory = ReplicaFactory.adapt(factory)
         self._slo = slo if slo is not None else getattr(router, '_slo',
                                                         None)
@@ -409,6 +466,8 @@ class FleetController(object):
     # scale: pressure up, sustained trough down -------------------------
     def _pressure(self, now):
         """(pressured, reason, signals) — ANY high signal pressures."""
+        if self.pressure_fn is not None:
+            return self.pressure_fn(now)
         burn_high = _env_float('PADDLE_TPU_AUTOSCALE_BURN_HIGH',
                                self.burn_high)
         queue_high = _env_float('PADDLE_TPU_AUTOSCALE_QUEUE_HIGH',
@@ -440,6 +499,8 @@ class FleetController(object):
         return False, None, signals
 
     def _calm(self, signals):
+        if self.calm_fn is not None:
+            return self.calm_fn(signals)
         burn_low = _env_float('PADDLE_TPU_AUTOSCALE_BURN_LOW',
                               self.burn_low)
         queue_low = _env_float('PADDLE_TPU_AUTOSCALE_QUEUE_LOW',

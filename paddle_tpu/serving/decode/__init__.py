@@ -11,7 +11,8 @@ pages each sequence owns at its true length. See docs/serving.md
 
 from .engine import DecodeEngine  # noqa: F401
 from .kv_pool import BlockTable, KVPool  # noqa: F401
-from .model import LMSpec, build_lm_programs, random_weights  # noqa: F401
+from .model import (LMSpec, build_lm_programs,  # noqa: F401
+                    kv_page_bytes, random_weights)
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (GenerationStream, Scheduler,  # noqa: F401
                         Sequence)

@@ -88,10 +88,13 @@ class DecodeEngine(object):
     def __init__(self, spec, max_batch=8, block_size=16, num_blocks=64,
                  pages_per_seq=8, max_queue_depth=64, max_prompt_len=None,
                  place=None, weights=None, prefix_cache=None, spec_k=None,
-                 draft=None, kv_dtype=None):
+                 draft=None, kv_dtype=None, name=None):
         from ...quant.core import resolve_kv_dtype
         from .model import kv_bytes_per_token
         self.spec = spec
+        # fleet identity: the routers key membership, placement, and
+        # per-replica metrics on it (same contract as ServingEngine)
+        self.name = str(name) if name else None
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
@@ -157,6 +160,20 @@ class DecodeEngine(object):
             if self.prefix_cache_on else None
         self._sched = Scheduler(self.pool, self.max_batch,
                                 cache=self.prefix_cache)
+        # serializes arena access between the worker's executor
+        # dispatches (which donate the arena buffers) and out-of-band
+        # page readers/writers (KV handoff export/install) — a page
+        # read racing a donating dispatch would observe invalidated
+        # buffers, a page write racing the scope writeback would be
+        # silently clobbered
+        self._arena_mu = threading.Lock()
+        # host-staging buffers for page export: one reusable buffer per
+        # arena name (covers every layer at that name's dtype), so a
+        # handoff serializes through ONE device transfer per arena and
+        # zero fresh host allocations after the first export at a given
+        # page count (serving/handoff.py's fast-path contract)
+        self._staging = {}
+        self._staging_allocs = 0
         self._mu = threading.Condition(threading.Lock())
         self._done_cv = threading.Condition(threading.Lock())
         self._unfinished = 0
@@ -256,6 +273,124 @@ class DecodeEngine(object):
         the capacity number the quantized-KV ablation measures."""
         return self._sched.peak_running
 
+    # ------------------------------------------------------- phase load
+    def queue_depth(self):
+        """Waiting requests — the router's least-loaded signal (same
+        shape as ServingEngine.queue_depth())."""
+        waiting, _ = self._sched.counts()
+        return waiting
+
+    def free_pages(self):
+        """Free KV pages right now — the decode-phase admission signal
+        (a decode replica is HBM-bound: pages, not FLOPs, are what it
+        runs out of)."""
+        return self.pool.free_blocks()
+
+    def free_slots(self):
+        """Open decode-batch slots (max_batch - running)."""
+        return self._sched.free_slots()
+
+    def decode_load(self):
+        """(free_pages, free_slots, waiting) — the tuple the phase
+        router ranks decode replicas by."""
+        waiting, _ = self._sched.counts()
+        return self.pool.free_blocks(), self._sched.free_slots(), waiting
+
+    # -------------------------------------------------- KV page handoff
+    def kv_geometry(self):
+        """The arena contract a KV handoff packet must match exactly:
+        geometry (layers/heads/head dims/block size) and storage dtype.
+        serving/handoff.py refuses to install a packet whose geometry
+        or dtype differs — a cross-dtype mismatch raises instead of
+        silently dequantizing."""
+        s = self.spec
+        return {
+            'n_layer': s.n_layer, 'n_head': s.n_head,
+            'd_key': s.d_key, 'd_value': s.d_value,
+            'block_size': self.block_size, 'kv_dtype': self.kv_dtype,
+            'arena_names': tuple(self._progs.arena_names),
+        }
+
+    def _page_rung(self, n):
+        """Pad a page-group size up to its pow2 rung (capped at
+        pages_per_seq) so page reads/writes cycle through a SMALL
+        fixed set of jax shapes — all pre-traced by warmup() — instead
+        of compiling one gather/scatter per distinct handoff size
+        (which would stall decode steps behind the arena lock)."""
+        r = 1
+        while r < n:
+            r *= 2
+        return min(max(r, 1), max(self.pages_per_seq, n))
+
+    def read_pages(self, page_ids):
+        """Read the frozen pages ``page_ids`` out of every arena:
+        {arena name: host array [L, n_pages, ...]} through the reused
+        staging buffers — ONE device gather + transfer per arena name
+        per call, never a per-page round trip. The returned arrays are
+        views of the engine-owned staging buffers: consume (serialize)
+        them before the next read_pages call on this engine. Caller
+        must hold references (pool refcounts) on the pages so they
+        cannot be reallocated mid-read."""
+        import jax
+        import jax.numpy as jnp
+        n = len(page_ids)
+        rung = self._page_rung(n)
+        # pad the gather to the rung with page 0 (mode='clip' keeps it
+        # in bounds either way); pad rows are sliced off on the host
+        ids = np.zeros((rung,), dtype='int32')
+        ids[:n] = list(page_ids)
+        out = {}
+        with self._arena_mu:
+            for name in self._progs.arena_names:
+                arr = self._scope.get(name)
+                # one gather on device, one transfer to host
+                host = np.asarray(jax.device_get(
+                    jnp.take(arr, ids, axis=1, mode='clip')))
+                buf = self._staging.get(name)
+                if buf is None or buf.shape[1] < rung or \
+                        buf.dtype != host.dtype:
+                    shape = (host.shape[0],
+                             max(rung, self.pages_per_seq)) \
+                        + host.shape[2:]
+                    buf = np.empty(shape, dtype=host.dtype)
+                    self._staging[name] = buf
+                    self._staging_allocs += 1
+                view = buf[:, :n]
+                np.copyto(view, host[:, :n])
+                out[name] = view
+        return out
+
+    def write_pages(self, page_ids, arrays):
+        """Install page payloads into the arenas at ``page_ids``:
+        ``arrays`` maps arena name -> [L, n_pages, ...] host data (the
+        other half of read_pages). One device-side scatter per arena
+        under the arena lock — the write happens between executor
+        dispatches, so no new XLA *executor* signature is ever created
+        (the zero-recompile invariant holds on a replica receiving
+        handoffs); the pow2 rung padding (pad indexes scatter with
+        mode='drop') keeps the jax-level shape set small and warmable.
+        Pages must be caller-owned (freshly alloc'd)."""
+        import jax.numpy as jnp
+        n = len(page_ids)
+        rung = self._page_rung(n)
+        ids_np = np.full((rung,), self.num_blocks, dtype='int32')
+        ids_np[:n] = list(page_ids)
+        ids = jnp.asarray(ids_np)
+        with self._arena_mu:
+            for name in self._progs.arena_names:
+                if n and name not in arrays:
+                    raise KeyError('write_pages: missing arena %r'
+                                   % name)
+                arr = self._scope.get(name)
+                data = np.zeros((arr.shape[0], rung) + arr.shape[2:],
+                                dtype='float32')
+                if n:
+                    data[:, :n] = np.asarray(arrays[name],
+                                             dtype='float32')
+                payload = jnp.asarray(data).astype(arr.dtype)
+                self._scope.set(
+                    name, arr.at[:, ids].set(payload, mode='drop'))
+
     # ---------------------------------------------------------- lifecycle
     def ready(self):
         return bool(self._started and self._warmed and not self._closed
@@ -328,6 +463,27 @@ class DecodeEngine(object):
                         time.perf_counter() - t0, kind='spec_verify',
                         bucket='')
             self.warmup_signatures += 1
+        if self.prefix_cache is not None:
+            # pre-trace the KV-handoff page gather/scatter rungs so a
+            # live handoff never compiles behind the arena lock (the
+            # jax-level twin of the executor-signature warmup above);
+            # writes use all-dropped indexes, reads page 0 — device
+            # state untouched
+            t0 = time.perf_counter()
+            rung = 1
+            while rung <= self.pages_per_seq:
+                self.read_pages([0] * rung)
+                self.write_pages(
+                    [self.num_blocks] * rung,
+                    {name: np.zeros(
+                        (self._scope.get(name).shape[0], rung)
+                        + tuple(self._scope.get(name).shape[2:]),
+                        'float32')
+                     for name in self._progs.arena_names})
+                rung *= 2
+            _obs.record('decode.warmup_seconds',
+                        time.perf_counter() - t0, kind='handoff',
+                        bucket='')
         self._warmed = True
         _obs.set_gauge('decode.warmup_signatures', self.warmup_signatures)
         _obs.set_gauge('decode.warmup_total_seconds',
@@ -444,7 +600,7 @@ class DecodeEngine(object):
 
     # ----------------------------------------------------------- dispatch
     def _run_prefill(self, ids, length, cached, table, temp, seed):
-        with scope_guard(self._scope):
+        with self._arena_mu, scope_guard(self._scope):
             out = self._exe.run(
                 program=self._progs.prefill,
                 feed={'pf_ids': ids,
@@ -457,7 +613,7 @@ class DecodeEngine(object):
         return int(np.asarray(out[0]).reshape(-1)[0])
 
     def _run_verify(self, tokens, lens, tables, temps, seeds):
-        with scope_guard(self._scope):
+        with self._arena_mu, scope_guard(self._scope):
             out = self._exe.run(
                 program=self._progs.verify,
                 feed={'sv_tokens': tokens, 'sv_lens': lens,
@@ -467,7 +623,7 @@ class DecodeEngine(object):
         return np.asarray(out[0]).reshape(tokens.shape)
 
     def _run_decode(self, tokens, lens, tables, temps, seeds):
-        with scope_guard(self._scope):
+        with self._arena_mu, scope_guard(self._scope):
             out = self._exe.run(
                 program=self._progs.decode,
                 feed={'dec_tokens': tokens, 'dec_lens': lens,

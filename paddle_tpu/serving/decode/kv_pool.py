@@ -28,6 +28,7 @@ only preempts a victim once the cache has nothing left to give).
 """
 
 import threading
+import time
 
 from ... import observe as _obs
 
@@ -86,13 +87,49 @@ class KVPool(object):
         with self._mu:
             return 1.0 - len(self._free) / float(self.num_blocks)
 
+    def largest_free_run(self):
+        """Length of the longest run of CONTIGUOUS free page ids — the
+        fragmentation signal. Page handoff (serving/handoff.py) lands
+        whole page groups at once, so a pool whose free count is high
+        but whose largest run is short is fragmented: allocations
+        still succeed (pages are position-independent through block
+        tables) but the gauge pair free-vs-largest-run makes allocator
+        churn visible across replicas."""
+        with self._mu:
+            return self._largest_run_locked()
+
+    def _largest_run_locked(self):
+        if not self._free:
+            return 0
+        ids = sorted(self._free)
+        best = run = 1
+        for prev, cur in zip(ids, ids[1:]):
+            run = run + 1 if cur == prev + 1 else 1
+            if run > best:
+                best = run
+        return best
+
+    def fragmentation(self):
+        """1 - largest_free_run / free_pages (0.0 = one contiguous
+        run or empty free list)."""
+        with self._mu:
+            free = len(self._free)
+            if not free:
+                return 0.0
+            return 1.0 - self._largest_run_locked() / float(free)
+
     def _publish(self):
         if _obs.enabled():
             free = len(self._free)
+            run = self._largest_run_locked()
             _obs.set_gauge('decode.kv_blocks_free', free)
+            _obs.set_gauge('decode.kv_free_pages', free)
             _obs.set_gauge('decode.kv_blocks_total', self.num_blocks)
             _obs.set_gauge('decode.kv_block_occupancy',
                            1.0 - free / float(self.num_blocks))
+            _obs.set_gauge('decode.kv_largest_free_run', run)
+            _obs.set_gauge('decode.kv_fragmentation',
+                           1.0 - run / float(free) if free else 0.0)
 
     def blocks_for(self, n_tokens):
         """Pages needed to hold n_tokens positions."""
@@ -111,6 +148,7 @@ class KVPool(object):
         asks the installed reclaimer (prefix-cache LRU eviction) to top
         the free list back up before giving up."""
         n = int(n)
+        t0 = None
         while True:
             with self._mu:
                 if n <= len(self._free):
@@ -118,10 +156,24 @@ class KVPool(object):
                     for i in ids:
                         self._refs[i] = 1
                     self._publish()
+                    self._record_stall(t0)
                     return ids
                 short = n - len(self._free)
+            # the stall clock starts at the first shortfall: everything
+            # past this point (reclaimer eviction, or the caller's
+            # preempt-and-retry) is time a request spent waiting on the
+            # allocator — the cross-replica pressure signal the decode
+            # /statusz panel surfaces
+            if t0 is None:
+                t0 = time.perf_counter()
             if self._reclaimer is None or self._reclaimer(short) <= 0:
+                self._record_stall(t0)
                 return None
+
+    def _record_stall(self, t0):
+        if t0 is not None and _obs.enabled():
+            _obs.record('decode.alloc_stall_seconds',
+                        time.perf_counter() - t0)
 
     def grow(self, table, n_tokens):
         """Ensure ``table`` covers ``n_tokens`` positions, allocating

@@ -86,6 +86,16 @@ def arena_bytes(spec, num_blocks, block_size, kv_dtype='float32'):
         int(block_size)
 
 
+def kv_page_bytes(spec, block_size, kv_dtype='float32'):
+    """Wire bytes one FULL page costs in a KV handoff packet
+    (serving/handoff.py): the page's K/V rows at the arena dtype plus,
+    for quantized arenas, its per-row fp32 scales. The 3-4x shrink the
+    disaggregated fleet claims at ``kv_dtype='int8'`` is exactly this
+    number's ratio to the fp32 one — quantized pages ship their scale
+    sideband, never a dequantized copy."""
+    return kv_bytes_per_token(spec, kv_dtype) * int(block_size)
+
+
 def num_blocks_for_budget(budget_bytes, spec, block_size,
                           kv_dtype='float32'):
     """Pages an arena byte budget buys at ``kv_dtype`` — how bench.py

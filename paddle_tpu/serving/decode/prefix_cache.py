@@ -144,6 +144,35 @@ class PrefixCache(object):
                 _obs.inc('decode.prefix_tokens_reused_total', n)
         return n
 
+    def acquire(self, tokens):
+        """Pin the longest cached chain covering ``tokens``' FULL pages
+        and return ``(page_ids, covered_tokens)`` with one reference
+        taken on every returned page (caller releases via
+        ``pool.free(page_ids)``). Unlike :meth:`match` this walks all
+        the way to ``len(tokens) // block_size`` pages — the KV-handoff
+        path (serving/handoff.py) uses it to read a just-prefilled
+        sequence's frozen pages out of the arena (export) and to skip
+        re-installing pages a decode replica already caches (import
+        dedup); no admission is involved, so the at-least-one-token-
+        prefills cap does not apply. LRU-refreshes the chain."""
+        bs = self.block_size
+        max_pages = len(tokens) // bs
+        matched = []
+        with self._mu:
+            node = self._root
+            tick = next(self._tick)
+            for p in range(max_pages):
+                key = tuple(tokens[p * bs:(p + 1) * bs])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.last_used = tick
+                matched.append(child.page_id)
+                node = child
+            if matched:
+                self.pool.incref(matched)
+        return matched, len(matched) * bs
+
     def unmatch(self, table, matched_tokens):
         """Roll back a ``match`` whose admission failed: drop the
         sequence's references on the shared pages (the cache's own

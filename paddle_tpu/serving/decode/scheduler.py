@@ -168,6 +168,13 @@ class Scheduler(object):
         with self._mu:
             return len(self.waiting), len(self.running)
 
+    def free_slots(self):
+        """Batch slots not currently occupied — one of the two decode-
+        phase admission signals (the other is the pool's free pages)
+        the phase-aware router ranks decode replicas by."""
+        with self._mu:
+            return max(0, self.max_batch - len(self.running))
+
     def _publish(self):
         if _obs.enabled():
             w, r = self.counts()

@@ -35,7 +35,8 @@ import time
 import numpy as np
 
 __all__ = ['Stats', 'percentiles', 'closed_loop', 'open_loop',
-           'qps_at', 'diurnal', 'flash_crowd', 'heavy_tailed_rows']
+           'qps_at', 'diurnal', 'flash_crowd', 'heavy_tailed_rows',
+           'phase_mix']
 
 
 class Stats(object):
@@ -146,6 +147,23 @@ def heavy_tailed_rows(rng, lo, hi, alpha=1.3):
     draw = float(rng.pareto(alpha))
     frac = min(1.0, draw / 10.0)
     return int(lo + round((hi - lo) * frac))
+
+
+def phase_mix(rng, long_prompt_frac=0.3, short_prompt=(4, 16),
+              long_prompt=(48, 96), short_new=(4, 8),
+              long_new=(24, 48)):
+    """One ``(prompt_len, max_new_tokens)`` draw of the mixed
+    long-prompt/long-decode chaos mix the disaggregated-fleet bench
+    drives: a ``long_prompt_frac`` minority of requests are prefill-
+    heavy (long prompt, few new tokens), the rest are decode-heavy
+    (short prompt, many new tokens). On a colocated replica every
+    long prefill dispatch stalls all resident decode steps behind it
+    — exactly the inter-token tail the phase split removes."""
+    if rng.rand() < long_prompt_frac:
+        return (int(rng.randint(long_prompt[0], long_prompt[1] + 1)),
+                int(rng.randint(short_new[0], short_new[1] + 1)))
+    return (int(rng.randint(short_prompt[0], short_prompt[1] + 1)),
+            int(rng.randint(long_new[0], long_new[1] + 1)))
 
 
 # ---------------------------------------------------------- the loops

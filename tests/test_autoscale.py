@@ -431,6 +431,58 @@ def test_controller_scale_in_drains_before_shutdown():
     router.close()
 
 
+def test_controller_phase_pool_custom_pressure():
+    """Per-phase scaling (ISSUE 14): a FleetController driving ONE
+    phase of a PhaseRouter through its pool() adapter, scaling on a
+    pluggable pressure_fn/calm_fn pair (the page-pressure policy's
+    shape) instead of the SLO/queue-depth default."""
+    from paddle_tpu.serving import PhaseRouter
+    observe.enable()
+    d0 = FakeReplica('d0')
+    pr = PhaseRouter([], [d0], colocated=True, route='px')
+    pool = pr.pool('decode')
+    assert pool.route == 'px/decode'
+    spawned = []
+
+    def factory(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    box = {'frac': 0.9}
+
+    def press(now):
+        hot = box['frac'] < 0.15
+        return hot, 'page_pressure' if hot else None, \
+            {'free_page_frac': box['frac'], 'mean_queue_depth': 0.0,
+             'burn_rate': None}
+
+    def calm(signals):
+        return signals['free_page_frac'] > 0.5
+
+    ctl = FleetController(pool, factory, min_replicas=1,
+                          max_replicas=3, scale_out_cooldown_s=0.0,
+                          trough_s=0.5, scale_in_cooldown_s=0.0,
+                          pressure_fn=press, calm_fn=calm)
+    now = time.perf_counter()
+    ctl.step(now=now)
+    assert spawned == []                       # calm: no spawn
+    box['frac'] = 0.05                         # page pressure
+    ctl.step(now=now + 1.0)
+    assert len(spawned) == 1                   # scaled the decode pool
+    assert len(pr.members('decode')) == 2
+    assert pr.members('prefill') == []         # other phase untouched
+    assert observe.get_counter('controller.scale_out_total',
+                               route='px/decode',
+                               reason='page_pressure') == 1
+    box['frac'] = 0.9                          # sustained calm
+    ctl.step(now=now + 2.0)                    # trough starts
+    ctl.step(now=now + 3.0)                    # sustained -> scale in
+    assert len(pr.members('decode')) == 1
+    ctl.close()
+    pr.close()
+
+
 def test_controller_heal_backoff_quarantine_cycle():
     observe.enable()
     router, ctl, reps, spawned = _fleet(

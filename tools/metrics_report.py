@@ -285,14 +285,82 @@ def derive_fleet(records):
         'replicas': replicas,
         'totals': {k.split('.', 1)[1]: v for k, v in totals.items()},
         'hedge': hedge,
+        'phases': derive_phases(records),
     }
+
+
+def derive_phases(records):
+    """Phase-split view of a disaggregated fleet from the snapshot
+    JSONL: per-phase replica census (``router.phase_replicas*``
+    gauges), handoff count/latency/bytes/dedup (``handoff.*``), and
+    the TTFT-vs-inter-token attribution (how much of TTFT the
+    prefill+handoff hop explains vs the decode replica's inter-token
+    cadence — ``handoff.ttft_attributed_seconds`` against
+    ``decode.ttft_seconds`` / ``decode.inter_token_seconds``).
+    Empty-dict when the JSONL has no phase/handoff metrics (a
+    colocated fleet)."""
+    parse = _registry_mod().parse_rendered
+    last = None
+    for rec in records:
+        keys = list(rec.get('gauges', {})) + \
+            list(rec.get('counters', {}))
+        if any(parse(k)[0].startswith('handoff.')
+               or parse(k)[0].startswith('router.phase_')
+               for k in keys):
+            last = rec
+    if last is None:
+        return {}
+    phases = {}
+    for rendered, v in last.get('gauges', {}).items():
+        name, labels = parse(rendered)
+        if name in ('router.phase_replicas',
+                    'router.phase_replicas_ready'):
+            ph = phases.setdefault(labels.get('phase', '?'), {})
+            key = 'replicas_ready' if name.endswith('_ready') \
+                else 'replicas'
+            ph[key] = v
+    handoff = {}
+    for rendered, v in last.get('counters', {}).items():
+        name, labels = parse(rendered)
+        if name == 'router.phase_dispatch_total':
+            ph = phases.setdefault(labels.get('phase', '?'), {})
+            ph['dispatched'] = ph.get('dispatched', 0) + v
+        elif name == 'handoff.count_total':
+            handoff['count'] = handoff.get('count', 0) + v
+        elif name == 'handoff.bytes_total':
+            handoff['bytes'] = handoff.get('bytes', 0) + v
+        elif name == 'handoff.pages_installed_total':
+            handoff['pages_installed'] = \
+                handoff.get('pages_installed', 0) + v
+        elif name == 'handoff.pages_deduped_total':
+            handoff['pages_deduped'] = \
+                handoff.get('pages_deduped', 0) + v
+    attribution = {}
+    for rendered, stats in last.get('histograms', {}).items():
+        name, labels = parse(rendered)
+        if name == 'handoff.seconds':
+            handoff['seconds'] = {k: stats.get(k) for k in
+                                  ('count', 'mean', 'p50', 'p99')}
+        elif name == 'handoff.ttft_attributed_seconds':
+            attribution['prefill_plus_handoff'] = {
+                k: stats.get(k) for k in ('count', 'mean', 'p99')}
+        elif name == 'decode.ttft_seconds':
+            key = 'ttft_cached' if labels.get('cached') == '1' \
+                else 'ttft_cold'
+            attribution[key] = {k: stats.get(k)
+                                for k in ('count', 'mean', 'p99')}
+        elif name == 'decode.inter_token_seconds':
+            attribution['inter_token'] = {
+                k: stats.get(k) for k in ('count', 'mean', 'p99')}
+    return {'census': phases, 'handoff': handoff,
+            'attribution': attribution}
 
 
 def render_fleet(records):
     doc = derive_fleet(records)
     if not doc['census_timeline'] and not doc['replicas'] and \
-            not doc['scale_events']:
-        return 'no controller.* metrics in this JSONL'
+            not doc['scale_events'] and not doc.get('phases'):
+        return 'no controller.* or phase/handoff metrics in this JSONL'
     lines = ['== fleet controller timeline']
     for ev in doc['scale_events']:
         what = ', '.join('%s +%d' % (k, v) for k, v in
@@ -322,6 +390,40 @@ def render_fleet(records):
                         if h.get('hedge_fraction') is not None else '?',
                         h.get('failovers'), h.get('mismatches'),
                         h.get('retry_budget_tokens')))
+    ph = doc.get('phases') or {}
+    if ph.get('census'):
+        lines.append('== phase split (disaggregated fleet)')
+        for phase in sorted(ph['census']):
+            c = ph['census'][phase]
+            lines.append('   %-8s replicas %s (ready %s)  '
+                         'dispatched %s'
+                         % (phase, c.get('replicas', '?'),
+                            c.get('replicas_ready', '?'),
+                            c.get('dispatched', 0)))
+        h = ph.get('handoff', {})
+        if h:
+            sec = h.get('seconds') or {}
+            lines.append('   handoffs %s   pages installed %s / '
+                         'deduped %s   bytes %s   latency mean %s '
+                         'p99 %s'
+                         % (h.get('count', 0),
+                            h.get('pages_installed', 0),
+                            h.get('pages_deduped', 0),
+                            h.get('bytes', 0),
+                            _fmt_val(sec.get('mean')),
+                            _fmt_val(sec.get('p99'))))
+        att = ph.get('attribution', {})
+        if att:
+            lines.append('== TTFT vs inter-token attribution')
+            for key in ('prefill_plus_handoff', 'ttft_cold',
+                        'ttft_cached', 'inter_token'):
+                if key in att:
+                    s = att[key]
+                    lines.append(
+                        '   %-22s n=%-6s mean %s   p99 %s'
+                        % (key, s.get('count'),
+                           _fmt_val(s.get('mean')),
+                           _fmt_val(s.get('p99'))))
     t = doc['totals']
     lines.append('== totals: %s' % '  '.join(
         '%s=%d' % (k, v) for k, v in sorted(t.items())))

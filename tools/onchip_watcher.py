@@ -90,6 +90,10 @@ QUEUE = [
     # ablation, equal-bytes quantized-KV capacity + parity, fleet A/B
     # on goodput/burn; quant.* gauges land in the shared metrics JSONL
     ('quant', 'quant', None, 700),
+    # disaggregated prefill/decode fleet (ISSUE 14): disagg-vs-coloc
+    # inter-token p99 at equal chips, TTFT budget, zero-recompile
+    # across the KV handoff; handoff.* metrics land in the JSONL
+    ('disagg', 'disagg', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),

@@ -38,6 +38,9 @@ ENV_SCOPED_DIRS = ('paddle_tpu/ops', 'paddle_tpu/tuning')
 # per call/per test — the exact class PR 8 fixed in ops/ by hand.
 ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
                     'paddle_tpu/serving/controller.py',
+                    # KV-handoff knobs (PADDLE_TPU_HANDOFF_VERIFY /
+                    # HANDOFF_WORKERS) must stay per-call reads
+                    'paddle_tpu/serving/handoff.py',
                     'paddle_tpu/serving/decode/prefix_cache.py',
                     'paddle_tpu/serving/decode/spec.py',
                     'paddle_tpu/observe/slo.py',
