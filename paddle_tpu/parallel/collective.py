@@ -8,9 +8,16 @@ import jax
 
 
 def _axis_size(axis_name):
-    """Concrete size of a named axis inside shard_map/pmap: psum of a
-    python literal constant-folds to the axis extent."""
+    """Concrete size of a named axis inside shard_map/pmap. Newer jax
+    has jax.lax.axis_size; elsewhere a psum of a python literal
+    constant-folds to the axis extent."""
+    size = getattr(jax.lax, 'axis_size', None)
+    if size is not None:
+        return size(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+axis_size = _axis_size
 
 
 def all_reduce(x, axis_name='dp', op='sum'):

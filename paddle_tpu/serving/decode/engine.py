@@ -311,85 +311,123 @@ class DecodeEngine(object):
             'arena_names': tuple(self._progs.arena_names),
         }
 
+    def arena_specs(self):
+        """{arena name: logical PartitionSpec or None} of the live
+        arena arrays — what export stamps into the packet header.
+        None (single-device sharding) serializes as replicated; a
+        NamedSharding records its logical axis names only, never
+        device positions."""
+        with self._arena_mu:
+            out = {}
+            for name in self._progs.arena_names:
+                sharding = getattr(self._scope.get(name),
+                                   'sharding', None)
+                out[name] = getattr(sharding, 'spec', None)
+            return out
+
     def _page_rung(self, n):
-        """Pad a page-group size up to its pow2 rung (capped at
-        pages_per_seq) so page reads/writes cycle through a SMALL
-        fixed set of jax shapes — all pre-traced by warmup() — instead
-        of compiling one gather/scatter per distinct handoff size
-        (which would stall decode steps behind the arena lock)."""
+        """Pad a page-group size up to its pow2 rung, capped at
+        pages_per_seq — the largest shape warmup() pre-traces — so
+        page reads/writes cycle through a SMALL fixed set of jax
+        shapes instead of compiling one gather/scatter per distinct
+        handoff size (which would stall decode steps behind the arena
+        lock). Groups larger than pages_per_seq are chunked by
+        read_pages/write_pages, never padded to an unwarmed shape."""
         r = 1
         while r < n:
             r *= 2
-        return min(max(r, 1), max(self.pages_per_seq, n))
+        return max(1, min(r, self.pages_per_seq))
 
     def read_pages(self, page_ids):
         """Read the frozen pages ``page_ids`` out of every arena:
-        {arena name: host array [L, n_pages, ...]} through the reused
-        staging buffers — ONE device gather + transfer per arena name
-        per call, never a per-page round trip. The returned arrays are
-        views of the engine-owned staging buffers: consume (serialize)
-        them before the next read_pages call on this engine. Caller
-        must hold references (pool refcounts) on the pages so they
-        cannot be reallocated mid-read."""
+        {arena name: host array [L, n_pages, ...]}. Each gather lands
+        in the reused per-arena staging buffer (ONE device gather +
+        transfer per arena per pages_per_seq chunk, never a per-page
+        round trip) and is copied out under the arena lock, so the
+        returned arrays are caller-owned — concurrent read_pages
+        calls (thread-pooled handoff exports) cannot overwrite each
+        other. Caller must hold references (pool refcounts) on the
+        pages so they cannot be reallocated mid-read."""
         import jax
         import jax.numpy as jnp
         n = len(page_ids)
-        rung = self._page_rung(n)
-        # pad the gather to the rung with page 0 (mode='clip' keeps it
-        # in bounds either way); pad rows are sliced off on the host
-        ids = np.zeros((rung,), dtype='int32')
-        ids[:n] = list(page_ids)
+        pps = self.pages_per_seq
+        # oversized groups walk warmed rungs chunk by chunk instead of
+        # padding the gather to an untraced (compile-stalling) shape
+        chunks = [list(page_ids[i:i + pps])
+                  for i in range(0, n, pps)] or [[]]
         out = {}
         with self._arena_mu:
             for name in self._progs.arena_names:
                 arr = self._scope.get(name)
-                # one gather on device, one transfer to host
-                host = np.asarray(jax.device_get(
-                    jnp.take(arr, ids, axis=1, mode='clip')))
-                buf = self._staging.get(name)
-                if buf is None or buf.shape[1] < rung or \
-                        buf.dtype != host.dtype:
-                    shape = (host.shape[0],
-                             max(rung, self.pages_per_seq)) \
-                        + host.shape[2:]
-                    buf = np.empty(shape, dtype=host.dtype)
-                    self._staging[name] = buf
-                    self._staging_allocs += 1
-                view = buf[:, :n]
-                np.copyto(view, host[:, :n])
-                out[name] = view
+                dest = None
+                done = 0
+                for chunk in chunks:
+                    c = len(chunk)
+                    rung = self._page_rung(c)
+                    # pad the gather to the rung with page 0
+                    # (mode='clip' keeps it in bounds either way);
+                    # pad rows are sliced off on the host
+                    ids = np.zeros((rung,), dtype='int32')
+                    ids[:c] = chunk
+                    # one gather on device, one transfer to host
+                    host = np.asarray(jax.device_get(
+                        jnp.take(arr, ids, axis=1, mode='clip')))
+                    buf = self._staging.get(name)
+                    if buf is None or buf.shape[1] < rung or \
+                            buf.dtype != host.dtype or \
+                            buf.shape[2:] != host.shape[2:]:
+                        shape = (host.shape[0], pps) + host.shape[2:]
+                        buf = np.empty(shape, dtype=host.dtype)
+                        self._staging[name] = buf
+                        self._staging_allocs += 1
+                    np.copyto(buf[:, :c], host[:, :c])
+                    if dest is None:
+                        dest = np.empty(
+                            (host.shape[0], n) + host.shape[2:],
+                            dtype=host.dtype)
+                    dest[:, done:done + c] = buf[:, :c]
+                    done += c
+                out[name] = dest
         return out
 
     def write_pages(self, page_ids, arrays):
         """Install page payloads into the arenas at ``page_ids``:
         ``arrays`` maps arena name -> [L, n_pages, ...] host data (the
         other half of read_pages). One device-side scatter per arena
-        under the arena lock — the write happens between executor
-        dispatches, so no new XLA *executor* signature is ever created
-        (the zero-recompile invariant holds on a replica receiving
-        handoffs); the pow2 rung padding (pad indexes scatter with
-        mode='drop') keeps the jax-level shape set small and warmable.
+        per pages_per_seq chunk, under the arena lock — the write
+        happens between executor dispatches, so no new XLA *executor*
+        signature is ever created (the zero-recompile invariant holds
+        on a replica receiving handoffs); the pow2 rung padding (pad
+        indexes scatter with mode='drop') keeps the jax-level shape
+        set small, warmable, and never larger than warmup traced.
         Pages must be caller-owned (freshly alloc'd)."""
         import jax.numpy as jnp
         n = len(page_ids)
-        rung = self._page_rung(n)
-        ids_np = np.full((rung,), self.num_blocks, dtype='int32')
-        ids_np[:n] = list(page_ids)
-        ids = jnp.asarray(ids_np)
+        if not n:
+            return
+        pps = self.pages_per_seq
         with self._arena_mu:
             for name in self._progs.arena_names:
-                if n and name not in arrays:
+                if name not in arrays:
                     raise KeyError('write_pages: missing arena %r'
                                    % name)
                 arr = self._scope.get(name)
-                data = np.zeros((arr.shape[0], rung) + arr.shape[2:],
-                                dtype='float32')
-                if n:
-                    data[:, :n] = np.asarray(arrays[name],
-                                             dtype='float32')
-                payload = jnp.asarray(data).astype(arr.dtype)
-                self._scope.set(
-                    name, arr.at[:, ids].set(payload, mode='drop'))
+                src = np.asarray(arrays[name], dtype='float32')
+                for start in range(0, n, pps):
+                    c = min(pps, n - start)
+                    rung = self._page_rung(c)
+                    ids_np = np.full((rung,), self.num_blocks,
+                                     dtype='int32')
+                    ids_np[:c] = list(page_ids[start:start + c])
+                    data = np.zeros(
+                        (arr.shape[0], rung) + arr.shape[2:],
+                        dtype='float32')
+                    data[:, :c] = src[:, start:start + c]
+                    payload = jnp.asarray(data).astype(arr.dtype)
+                    arr = arr.at[:, jnp.asarray(ids_np)].set(
+                        payload, mode='drop')
+                    self._scope.set(name, arr)
 
     # ---------------------------------------------------------- lifecycle
     def ready(self):

@@ -34,6 +34,11 @@ from ... import observe as _obs
 
 __all__ = ['KVPool', 'BlockTable']
 
+# The fragmentation gauges need a sort over the free list, so _publish
+# only refreshes them every Nth alloc/free; direct largest_free_run()
+# / fragmentation() reads always recompute (and re-publish) fresh.
+_FRAG_PUBLISH_EVERY = 64
+
 
 class BlockTable(object):
     """One sequence's logical->physical page map."""
@@ -65,6 +70,7 @@ class KVPool(object):
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._refs = [0] * self.num_blocks
         self._reclaimer = None
+        self._frag_seq = 0
         self._publish()
 
     def set_reclaimer(self, fn):
@@ -94,9 +100,12 @@ class KVPool(object):
         but whose largest run is short is fragmented: allocations
         still succeed (pages are position-independent through block
         tables) but the gauge pair free-vs-largest-run makes allocator
-        churn visible across replicas."""
+        churn visible across replicas. Reading it refreshes the
+        gauges, so a scrape always sees a fresh value."""
         with self._mu:
-            return self._largest_run_locked()
+            run = self._largest_run_locked()
+            self._publish_frag_locked(run)
+            return run
 
     def _largest_run_locked(self):
         if not self._free:
@@ -111,25 +120,38 @@ class KVPool(object):
 
     def fragmentation(self):
         """1 - largest_free_run / free_pages (0.0 = one contiguous
-        run or empty free list)."""
+        run or empty free list). Refreshes the gauges like
+        largest_free_run."""
         with self._mu:
             free = len(self._free)
+            run = self._largest_run_locked()
+            self._publish_frag_locked(run)
             if not free:
                 return 0.0
-            return 1.0 - self._largest_run_locked() / float(free)
+            return 1.0 - run / float(free)
+
+    def _publish_frag_locked(self, run):
+        if _obs.enabled():
+            free = len(self._free)
+            _obs.set_gauge('decode.kv_largest_free_run', run)
+            _obs.set_gauge('decode.kv_fragmentation',
+                           1.0 - run / float(free) if free else 0.0)
 
     def _publish(self):
         if _obs.enabled():
             free = len(self._free)
-            run = self._largest_run_locked()
             _obs.set_gauge('decode.kv_blocks_free', free)
             _obs.set_gauge('decode.kv_free_pages', free)
             _obs.set_gauge('decode.kv_blocks_total', self.num_blocks)
             _obs.set_gauge('decode.kv_block_occupancy',
                            1.0 - free / float(self.num_blocks))
-            _obs.set_gauge('decode.kv_largest_free_run', run)
-            _obs.set_gauge('decode.kv_fragmentation',
-                           1.0 - run / float(free) if free else 0.0)
+            # largest-run is an O(free log free) sort — keep it OFF
+            # the per-alloc/free hot path: refresh every Nth publish
+            # (and on every direct largest_free_run/fragmentation
+            # read, so scrapes stay fresh)
+            self._frag_seq += 1
+            if self._frag_seq % _FRAG_PUBLISH_EVERY == 1:
+                self._publish_frag_locked(self._largest_run_locked())
 
     def blocks_for(self, n_tokens):
         """Pages needed to hold n_tokens positions."""

@@ -12,10 +12,12 @@ must cross replica boundaries. This module is that wire:
   boundary). Export pins the chain (``PrefixCache.acquire`` — one pool
   ref per page so LRU eviction cannot pull the pages mid-read), reads
   every arena's pages through the engine's reused host-staging buffers
-  (ONE device gather + transfer per (arena name) per handoff, never a
-  per-page ``device_get`` round trip, and zero fresh host allocations
-  after the first export at a given page count), serializes them into
-  a :class:`KVPacket`, and releases the pins.
+  (one device gather + transfer per arena per warmed page-rung chunk,
+  never a per-page ``device_get`` round trip, and zero fresh staging
+  allocations after the first export), copies them out under the
+  arena lock — concurrent exports on the router's handoff thread pool
+  each get their OWN arrays — serializes them into a
+  :class:`KVPacket`, and releases the pins.
 - **install** (:func:`install_packet`) — the decode replica first
   walks its OWN prefix cache with the packet's token chain: pages the
   replica already caches (a shared system prompt handed off earlier,
@@ -191,14 +193,17 @@ def packet_wire_bytes(spec, n_pages, block_size, kv_dtype='float32'):
 
 def _geometry_header(engine):
     geo = engine.kv_geometry()
-    return {k: geo[k] for k in ('n_layer', 'n_head', 'd_key', 'd_value',
-                                'block_size', 'kv_dtype')}
+    out = {k: geo[k] for k in ('n_layer', 'n_head', 'd_key', 'd_value',
+                               'block_size', 'kv_dtype')}
+    out['arena_names'] = sorted(geo['arena_names'])
+    return out
 
 
 def _check_geometry(engine, header):
     """Destination contract: dtype mismatches get their own typed
-    error (the silently-dequantize trap), everything else is
-    geometry."""
+    error (the silently-dequantize trap), everything else —
+    layer/head geometry AND the arena-name set — is geometry,
+    checked BEFORE any page is allocated."""
     geo = _geometry_header(engine)
     if header['kv_dtype'] != geo['kv_dtype']:
         raise KVDtypeMismatchError(
@@ -215,6 +220,11 @@ def _check_geometry(engine, header):
             'arenas: %s' % ', '.join(
                 '%s packet=%r dest=%r' % (k, p, d)
                 for k, (p, d) in sorted(bad.items())))
+    pk_names = sorted(header.get('arena_names') or [])
+    if pk_names != geo['arena_names']:
+        raise KVGeometryError(
+            'KV packet arena set does not match the destination: '
+            'packet=%r dest=%r' % (pk_names, geo['arena_names']))
 
 
 # ----------------------------------------------------------- export
@@ -239,11 +249,11 @@ def export_packet(engine, tokens):
         _obs.inc('handoff.empty_exports_total')
         return None
     try:
-        staged = engine.read_pages(page_ids)
-        # staging buffers are engine-owned and reused: copy out into
-        # the packet's own arrays here (this IS the wire allocation)
-        arrays = {name: np.array(view, copy=True)
-                  for name, view in staged.items()}
+        # read_pages copies out of the engine-owned staging buffers
+        # under the arena lock and returns caller-owned arrays, so
+        # concurrent exports (the router's handoff thread pool) can
+        # never corrupt each other's packets
+        arrays = engine.read_pages(page_ids)
     finally:
         engine.pool.free(page_ids)
     from ..io import spec_to_json
@@ -251,7 +261,8 @@ def export_packet(engine, tokens):
                   version=_VERSION,
                   tokens=tokens[:covered],
                   n_pages=len(page_ids),
-                  specs={name: spec_to_json(None) for name in arrays})
+                  specs={name: spec_to_json(spec) for name, spec
+                         in engine.arena_specs().items()})
     pkt = KVPacket(header, arrays)
     if _obs.enabled():
         _obs.record('handoff.export_seconds',
@@ -291,38 +302,44 @@ def install_packet(engine, packet):
     tail = n_pages - have
     installed = 0
     new_ids = []
-    if tail > 0:
-        new_ids = pool.alloc(tail)
-        if new_ids is None:
-            # page pressure: install what fits page-by-page, front
-            # first (a shorter chain is still a win)
-            new_ids = []
-            for _ in range(tail):
-                one = pool.alloc(1)
-                if one is None:
-                    break
-                new_ids.extend(one)
-        if new_ids:
-            # 2. scatter the tail pages into every arena (one device
-            # write per arena, under the engine's arena lock — no
-            # executor dispatch, no new signature)
-            sl = slice(have, have + len(new_ids))
-            engine.write_pages(
-                new_ids, {name: arr[:, sl]
-                          for name, arr in packet.arrays.items()})
-            installed = len(new_ids)
-    # 3. publish the full chain (reused head + installed tail) so
-    # admission matches it; publish Dedups per node, increfing only
-    # chain nodes it creates
-    from .decode.kv_pool import BlockTable
-    table = BlockTable()
-    table.block_ids = list(have_ids) + list(new_ids)
-    chain_tokens = tokens[:len(table.block_ids) * bs]
-    cache.publish(chain_tokens, table, len(chain_tokens))
-    # 4. drop OUR references: the cache's own refs keep the chain
-    # resident (and evictable under pressure, like any cached pages)
-    if table.block_ids:
-        pool.free(table.block_ids)
+    try:
+        if tail > 0:
+            new_ids = pool.alloc(tail)
+            if new_ids is None:
+                # page pressure: install what fits page-by-page, front
+                # first (a shorter chain is still a win)
+                new_ids = []
+                for _ in range(tail):
+                    one = pool.alloc(1)
+                    if one is None:
+                        break
+                    new_ids.extend(one)
+            if new_ids:
+                # 2. scatter the tail pages into every arena (one
+                # device write per arena, under the engine's arena
+                # lock — no executor dispatch, no new signature)
+                sl = slice(have, have + len(new_ids))
+                engine.write_pages(
+                    new_ids, {name: arr[:, sl]
+                              for name, arr in packet.arrays.items()})
+                installed = len(new_ids)
+        # 3. publish the full chain (reused head + installed tail) so
+        # admission matches it; publish Dedups per node, increfing
+        # only chain nodes it creates
+        from .decode.kv_pool import BlockTable
+        table = BlockTable()
+        table.block_ids = list(have_ids) + list(new_ids)
+        chain_tokens = tokens[:len(table.block_ids) * bs]
+        cache.publish(chain_tokens, table, len(chain_tokens))
+    finally:
+        # 4. drop OUR references (the acquire pins + fresh allocs) on
+        # every path: after a successful publish the cache's own refs
+        # keep the chain resident (and evictable under pressure, like
+        # any cached pages); on an error this is what stops a failed
+        # handoff from leaking pinned pages until the pool is empty
+        ours = list(have_ids) + list(new_ids or [])
+        if ours:
+            pool.free(ours)
     covered_tokens = len(chain_tokens)
     dedup = have
     if _obs.enabled():

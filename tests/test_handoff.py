@@ -217,6 +217,93 @@ def test_staging_no_per_handoff_allocation_growth():
     eng.shutdown()
 
 
+def test_export_owns_its_arrays():
+    """Regression: read_pages used to return views of the shared
+    staging buffers, so a later export (the router runs handoffs on a
+    thread pool) silently overwrote an earlier packet's payload.
+    Packets must own their arrays."""
+    eng = _engine()
+    eng.start()
+    a, b = _prompt(16, seed=11), _prompt(16, seed=12)
+    eng.generate(a, max_new_tokens=1, timeout=120)
+    eng.generate(b, max_new_tokens=1, timeout=120)
+    pkt_a = handoff_mod.export_packet(eng, a)
+    before = {name: np.asarray(arr).tobytes()
+              for name, arr in pkt_a.arrays.items()}
+    handoff_mod.export_packet(eng, b)
+    for name, arr in pkt_a.arrays.items():
+        assert np.asarray(arr).tobytes() == before[name], \
+            'arena %s of an exported packet was overwritten by a ' \
+            'later export' % name
+    eng.shutdown()
+
+
+def test_install_failure_frees_pages(monkeypatch):
+    """Regression: a write_pages failure mid-install must release the
+    acquired head pins AND the freshly allocated tail pages — repeated
+    handoff failures must not drain the decode pool."""
+    src = _engine()
+    src.start()
+    prompt = _prompt(16, seed=13)
+    src.generate(prompt, max_new_tokens=1, timeout=120)
+    pkt = handoff_mod.export_packet(src, prompt)
+    dst = _engine()
+    free0 = dst.pool.free_blocks()
+
+    def boom(*a, **kw):
+        raise RuntimeError('injected write failure')
+
+    monkeypatch.setattr(dst, 'write_pages', boom)
+    with pytest.raises(RuntimeError):
+        handoff_mod.install_packet(dst, pkt)
+    assert dst.pool.free_blocks() == free0, \
+        'failed install leaked KV pool pages'
+    src.shutdown()
+    dst.shutdown(drain=False)
+
+
+def test_arena_set_mismatch_raises_before_alloc():
+    """A packet whose arena-name set does not match the destination
+    (e.g. scales missing) is refused as KVGeometryError before any
+    page is allocated."""
+    src = _engine()
+    src.start()
+    prompt = _prompt(9, seed=14)
+    src.generate(prompt, max_new_tokens=1, timeout=120)
+    pkt = handoff_mod.export_packet(src, prompt)
+    pkt.header['arena_names'] = ['lm_kcache']
+    dst = _engine()
+    free0 = dst.pool.free_blocks()
+    with pytest.raises(KVGeometryError):
+        handoff_mod.install_packet(dst, pkt)
+    assert dst.pool.free_blocks() == free0
+    src.shutdown()
+    dst.shutdown(drain=False)
+
+
+def test_oversized_page_group_chunks_through_warmed_rungs():
+    """Regression: page groups larger than pages_per_seq (a packet
+    from a replica configured with a larger pages_per_seq) used to
+    pad the gather/scatter to a shape warmup never traced; they now
+    chunk through the warmed rungs. Round-trip stays bit-identical."""
+    eng = _engine()
+    n = eng.pages_per_seq + 3
+    ids = eng.pool.alloc(n)
+    assert ids is not None and len(ids) == n
+    shapes = {name: np.asarray(arr).shape
+              for name, arr in eng.read_pages(ids).items()}
+    rng = np.random.RandomState(15)
+    payload = {name: rng.uniform(-1, 1, size=shp).astype('float32')
+               for name, shp in shapes.items()}
+    eng.write_pages(ids, payload)
+    back = eng.read_pages(ids)
+    for name, want in payload.items():
+        assert np.array_equal(np.asarray(back[name]), want), \
+            'arena %s lost data across the chunked round-trip' % name
+    eng.pool.free(ids)
+    eng.shutdown(drain=False)
+
+
 # ------------------------------------------------------------ e2e hops
 @pytest.mark.parametrize('kv_dtype', ['float32', 'int8'])
 def test_handoff_e2e_bit_identical(kv_dtype):

@@ -116,7 +116,7 @@ def test_row_sharded_embedding_matches_unsharded():
 def test_ring_attention_equals_full_attention():
     from paddle_tpu.parallel.ring_attention import ring_attention
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import compat_shard_map as shard_map
 
     b, h, t, d, n_shards = 2, 2, 32, 8, 8
     rng = np.random.RandomState(0)
@@ -152,7 +152,7 @@ def test_ring_attention_equals_full_attention():
 def test_collectives_roundtrip():
     from paddle_tpu.parallel import collective
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import compat_shard_map as shard_map
 
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ('dp',))
     x = np.arange(8, dtype='float32').reshape(4, 2)
@@ -761,7 +761,7 @@ def test_ring_attention_masked_equals_reference():
     masked reference, including rows whose length falls inside an
     earlier shard's block."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import compat_shard_map as shard_map
     from paddle_tpu.parallel.ring_attention import ring_attention
     from paddle_tpu.ops.attention_ops import reference_attention
 
