@@ -1975,6 +1975,215 @@ def bench_verify(batch=8, seq=64, vocab=32000, iters=10):
             'ok': bool(ratio < 0.01 and counts['error'] == 0)}
 
 
+def bench_linalg(n_parity=256, tune_points=((512, 2048, 512),
+                                            (256, 4096, 256)),
+                 n_fact=256, n_pow=1024, powit_iters=40, runs=5,
+                 reduced=False):
+    """Distributed linear algebra at pod scale (ISSUE 15), four
+    asserted legs over the dp x tp mesh:
+
+    1. **SUMMA parity + zero recompiles** — blocked matmul matches
+       numpy at the parity shape; after the first (compiling) run,
+       `runs` more dispatches hit the executor cache with ZERO misses.
+    2. **autotuned panel** — PADDLE_TPU_AUTOTUNE=record sweeps the
+       legal panel ladder at each (N, K, M) tuning point; asserts the
+       recorded winner STRICTLY beats the default panel's measured
+       time on at least one point (the r4 lesson: no single panel is
+       right for every shape), then asserts the memory contract —
+       per-shard peak arena bytes within 1.5x of the O(N^2/P) ideal —
+       at the LARGEST SUMMA shape with its default panel.
+    3. **blocked Cholesky / QR** — factorization residuals
+       (reconstruction, orthogonality, triangularity) at n_fact on a
+       1-D dp mesh.
+    4. **power iteration** — dominant eigenvalue matches numpy to
+       rel-err < 1e-3 through exact psum and < 5e-2 through the PR 13
+       quantized allreduce, with the analytic wire-bytes compression
+       >= 3x reported from the linalg.powit_* gauges. The reduction IS
+       the step here, which is what makes this the second measurement
+       axis for the compressed-collective trade.
+    """
+    import jax
+
+    from paddle_tpu import linalg, observe, tuning
+    from paddle_tpu.core.executor import Executor
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    if reduced:
+        n_parity, n_fact, n_pow = 128, 128, 512
+        tune_points = ((256, 2048, 256), (128, 4096, 128))
+        powit_iters, runs = 30, 3
+
+    count = jax.device_count()
+    dp = 2 if count >= 2 else 1
+    tp = max(1, min(4, count // dp))
+    while tp > 1 and count < dp * tp:
+        tp //= 2
+    grid = make_mesh(dp=dp, tp=tp)
+    dp1 = 1
+    while dp1 * 2 <= min(8, count):
+        dp1 *= 2
+    line = make_mesh(dp=dp1)
+    out = {'workload': 'linalg', 'grid': {'dp': dp, 'tp': tp},
+           'line_dp': dp1}
+    rng = np.random.RandomState(0)
+
+    # ---- leg 1: SUMMA parity + zero recompiles ---------------------
+    n = n_parity
+    a = rng.randn(n, n).astype('float32')
+    b = rng.randn(n, n).astype('float32')
+    exe = Executor()
+    prog, c_var = linalg.build_matmul_program(n, n, n, mesh=grid,
+                                             panel=32)
+    t0 = time.perf_counter()
+    got = exe.run(prog, feed={'summa_x': a, 'summa_y': b},
+                  fetch_list=[c_var])[0]
+    first = time.perf_counter() - t0
+    ref = a.astype('float64') @ b.astype('float64')
+    rel = float(np.abs(got - ref).max() / np.abs(ref).max())
+    assert rel < 1e-4, 'SUMMA parity rel err %.2e' % rel
+    snap = observe.snapshot()
+    miss0 = sum(v for k, v in snap.get('counters', {}).items()
+                if k.startswith('executor.cache_miss_total'))
+    best = float('inf')
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        np.asarray(exe.run(prog, feed={'summa_x': a, 'summa_y': b},
+                           fetch_list=[c_var])[0])
+        best = min(best, time.perf_counter() - t0)
+        assert not exe.last_cache_miss, \
+            'SUMMA warm dispatch missed the compile cache'
+    snap = observe.snapshot()
+    miss1 = sum(v for k, v in snap.get('counters', {}).items()
+                if k.startswith('executor.cache_miss_total'))
+    assert miss1 == miss0, 'cache misses after warmup: %d' \
+        % (miss1 - miss0)
+    gf = 2.0 * n * n * n / best / 1e9
+    out['summa'] = {'n': n, 'rel_err': rel,
+                    'first_dispatch_s': round(first, 4),
+                    'warm_step_s': round(best, 5),
+                    'gflops': round(gf, 2),
+                    'cache_misses_after_warmup': 0}
+    observe.set_gauge('linalg.bench_summa_gflops', gf)
+
+    # ---- leg 2: autotuned panel vs default + memory contract -------
+    tune_dir = os.environ.get('TMPDIR', '/tmp')
+    table_path = os.path.join(tune_dir, 'bench_linalg_tuning_%d.json'
+                              % os.getpid())
+    saved = {k: os.environ.get(k) for k in ('PADDLE_TPU_AUTOTUNE',
+                                            'PADDLE_TPU_TUNING_TABLE')}
+    os.environ['PADDLE_TPU_AUTOTUNE'] = 'record'
+    os.environ['PADDLE_TPU_TUNING_TABLE'] = table_path
+    tuning.reset()
+    try:
+        points = []
+        beats = 0
+        for (pn, pk, pm) in tune_points:
+            win = tuning.decide_summa_panel(pn, pk, pm, 'float32', grid)
+            default = linalg.default_panel(pk, dp, tp, n=pn, m=pm)
+            key = ('summa_matmul|n%d k%d m%d|dp%d tp%d|float32'
+                   % (pn, pk, pm, dp, tp))
+            ent = tuning.current_table().lookup(tuning.device_kind(),
+                                                key)
+            timings = {k: v for k, v in ent['timings'].items()
+                       if v >= 0}
+            def_label = 'summa panel%d' % default
+            win_label = 'summa panel%d' % int(win['panel'])
+            t_def = timings.get(def_label)
+            t_win = timings.get(win_label)
+            strictly = (win['panel'] != default and t_def is not None
+                        and t_win is not None and t_win < t_def)
+            beats += bool(strictly)
+            points.append({
+                'shape': [pn, pk, pm], 'default_panel': default,
+                'tuned_panel': int(win['panel']),
+                'default_ms': round(t_def * 1e3, 3) if t_def else None,
+                'tuned_ms': round(t_win * 1e3, 3) if t_win else None,
+                'tuned_beats_default': strictly})
+        assert beats >= 1, \
+            'autotuned panel never beat the default: %r' % points
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tuning.reset()
+    # memory contract at the LARGEST SUMMA shape, default panel
+    big = max(tune_points, key=lambda d: d[0] * d[1] + d[1] * d[2])
+    model = linalg.assert_memory_contract(
+        'summa_matmul', grid, big, panel=linalg.default_panel(
+            big[1], dp, tp, n=big[0], m=big[2]), factor=1.5)
+    observe.set_gauge('linalg.bench_memory_factor', model['factor'])
+    out['autotune'] = {'points': points, 'tuned_beats_default': beats}
+    out['memory'] = {'shape': list(big), 'per_shard_peak': model['peak'],
+                     'ideal': model['ideal'],
+                     'factor': round(model['factor'], 3),
+                     'participants': model['participants']}
+
+    # ---- leg 3: blocked Cholesky / QR residuals --------------------
+    nf = n_fact
+    m0 = rng.randn(nf, nf).astype('float32')
+    spd = (m0 @ m0.T + nf * np.eye(nf)).astype('float32')
+    exe3 = Executor()
+    l = np.asarray(linalg.cholesky(spd, mesh=line, executor=exe3))
+    chol_res = float(np.abs(l @ l.T - spd).max() / np.abs(spd).max())
+    assert chol_res < 1e-5, 'cholesky residual %.2e' % chol_res
+    assert float(np.abs(np.triu(l, 1)).max()) == 0.0
+
+    tall = rng.randn(nf * 2, nf).astype('float32')
+    q, r = linalg.qr(tall, mesh=line, executor=exe3)
+    q, r = np.asarray(q), np.asarray(r)
+    orth = float(np.abs(q.T @ q - np.eye(nf)).max())
+    recon = float(np.abs(q @ r - tall).max() / np.abs(tall).max())
+    assert orth < 1e-4, 'QR orthogonality %.2e' % orth
+    assert recon < 1e-4, 'QR reconstruction %.2e' % recon
+    out['factorizations'] = {
+        'n': nf, 'dp': dp1,
+        'cholesky_residual': chol_res,
+        'qr_orthogonality': orth, 'qr_reconstruction': recon}
+
+    # ---- leg 4: power iteration, exact vs quantized reduction ------
+    npow = n_pow
+    qo, _ = np.linalg.qr(rng.randn(npow, npow))
+    spectrum = np.concatenate([[10.0, 6.0],
+                               np.linspace(1.0, 2.0, npow - 2)])
+    sym = ((qo * spectrum) @ qo.T).astype('float32')
+    sym = (sym + sym.T) / 2
+    dom = np.linalg.eigvalsh(sym)
+    dom = float(dom[np.abs(dom).argmax()])
+    exe4 = Executor()
+    lam, _ = linalg.power_iteration(sym, iters=powit_iters, mesh=line,
+                                    executor=exe4)
+    assert not exe4.last_cache_miss, \
+        'power_iteration re-compiled inside the loop'
+    rel_exact = abs(lam - dom) / abs(dom)
+    assert rel_exact < 1e-3, \
+        'power iteration (psum) rel err %.2e' % rel_exact
+    lam_q, _ = linalg.power_iteration(sym, iters=powit_iters,
+                                      mesh=line, quantized=True,
+                                      executor=exe4)
+    rel_quant = abs(lam_q - dom) / abs(dom)
+    assert rel_quant < 5e-2, \
+        'power iteration (quantized) rel err %.2e' % rel_quant
+    g = observe.snapshot().get('gauges', {})
+    compression = g.get('linalg.powit_compression', 0.0)
+    if dp1 > 1:
+        assert compression >= 3.0, \
+            'quantized reduction compression %.2fx < 3x' % compression
+    out['power_iteration'] = {
+        'n': npow, 'iters': powit_iters, 'numpy_eigval': dom,
+        'exact': {'eigval': lam, 'rel_err': rel_exact},
+        'quantized': {'eigval': lam_q, 'rel_err': rel_quant,
+                      'compression_x': round(compression, 2),
+                      'bytes_fp32': g.get('linalg.powit_bytes_fp32'),
+                      'bytes_quant': g.get('linalg.powit_bytes_quant')},
+    }
+    observe.set_gauge('linalg.bench_powit_rel_err_exact', rel_exact)
+    observe.set_gauge('linalg.bench_powit_rel_err_quant', rel_quant)
+    out['ok'] = True
+    return out
+
+
 def _run_workload_child(workload, backend, reduced):
     """Child-process entry: run ONE workload, print 'RESULT <number>'."""
     from paddle_tpu import observe
@@ -1990,9 +2199,9 @@ def _run_workload_child(workload, backend, reduced):
                    trace=os.environ.get('PADDLE_TPU_TRACE_JSON'))
     if backend == 'cpu':
         from paddle_tpu.core.platform_boot import force_host_cpu
-        # the quant ablation needs a dp mesh even off-chip: 8 virtual
-        # CPU devices, same as the test suite's conftest
-        force_host_cpu(8 if workload == 'quant' else None)
+        # the quant/linalg ablations need a dp(x tp) mesh even
+        # off-chip: 8 virtual CPU devices, same as the test conftest
+        force_host_cpu(8 if workload in ('quant', 'linalg') else None)
     # one home for the cache-arming quirk (env alone does not arm it on
     # this jax build); a workload killed mid-compile then restarts from
     # the cached executable instead of re-burning its watchdog budget
@@ -2078,6 +2287,10 @@ def _run_workload_child(workload, backend, reduced):
                   reduced=True) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_quant(**kw)),
               flush=True)
+        return
+    if workload == 'linalg':
+        print('RESULT_JSON %s'
+              % json.dumps(bench_linalg(reduced=reduced)), flush=True)
         return
     if workload == 'disagg':
         # reduced: small model but LONG capacity (pages_per_seq=32 ->
@@ -2614,24 +2827,26 @@ def main():
     }))
 
 
+# Every workload --workload accepts, at module level so the watcher
+# QUEUE <-> argparse consistency test can import it (the PR 13 lesson:
+# 'autoscale' was queued but not an accepted choice, and nothing
+# noticed until the watcher drained on chip).
+WORKLOAD_CHOICES = [
+    'transformer', 'transformer_seq256', 'transformer_seq1024',
+    'transformer_seq4096', 'transformer_big',
+    'transformer_seq512_masked', 'rnn_lstm', 'resnet50',
+    'resnet50_anatomy', 'attention_microbench', 'pallas_parity',
+    'moe_cap1.0', 'moe_cap1.25', 'moe_cap2.0', 'pipeline_transformer',
+    'pipeline_resnet50', 'decode_transformer', 'fleet', 'autoscale',
+    'quant', 'disagg', 'linalg', 'autotune', 'autotune_child',
+    'verify',
+]
+
 if __name__ == '__main__':
     if '--workload' in sys.argv:
         import argparse
         p = argparse.ArgumentParser()
-        p.add_argument('--workload',
-                       choices=['transformer', 'transformer_seq256',
-                                'transformer_seq1024',
-                                'transformer_seq4096', 'transformer_big',
-                                'transformer_seq512_masked', 'rnn_lstm',
-                                'resnet50',
-                                'resnet50_anatomy', 'attention_microbench',
-                                'pallas_parity', 'moe_cap1.0',
-                                'moe_cap1.25', 'moe_cap2.0',
-                                'pipeline_transformer',
-                                'pipeline_resnet50',
-                                'decode_transformer', 'fleet',
-                                'autoscale', 'quant', 'disagg',
-                                'autotune', 'autotune_child', 'verify'])
+        p.add_argument('--workload', choices=WORKLOAD_CHOICES)
         p.add_argument('--backend', default='cpu')
         p.add_argument('--reduced', action='store_true')
         a = p.parse_args()

@@ -44,6 +44,7 @@ from .core.errors import EnforceError, enforce  # noqa: F401
 from .core.flags import init_flags  # noqa: F401
 from .core.lod import create_lod_tensor, pad_sequences  # noqa: F401
 from . import parallel  # noqa: F401
+from . import linalg  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import image  # noqa: F401

@@ -3,7 +3,7 @@
 Whole-program XLA compilation means graph bugs otherwise surface as
 opaque tracer exceptions (or silent recompiles) deep inside `jit`, far
 from the user code that appended the op. This package runs BEFORE any
-trace: six static-analysis passes over Program/Block/Operator IR,
+trace: seven static-analysis passes over Program/Block/Operator IR,
 each emitting structured diagnostics with severity, op index, and the
 op's construction provenance (`file.py:line`, captured at append_op).
 
@@ -24,6 +24,10 @@ Passes (see docs/static_analysis.md for the full catalog):
 - ``quant``      — quantization dtype/scale contracts: int8 PTQ
   weights must pair with fp32 per-channel scale vars (fp32
   accumulation), quantized KV arenas with per-row scale arenas.
+- ``linalg``     — blocked-layout contracts for the distributed
+  linalg tier: block divisibility vs mesh axes, panel-spec
+  consistency, and no implicit full-gather resharding (a missing or
+  wrong blocked spec would hand GSPMD a full matrix per shard).
 
 Three ways in:
 

@@ -150,7 +150,7 @@ class AnalysisContext(object):
 def _ensure_passes_loaded():
     # importing the modules registers their passes
     from . import wellformed, shapes, sharding, donation, \
-        recompile, quant  # noqa: F401
+        recompile, quant, linalg  # noqa: F401
 
 
 def run_passes(program, feed_names=None, fetch_names=None, passes=None):

@@ -1,0 +1,183 @@
+"""Pass 7 — blocked-layout contracts for the distributed linalg tier.
+
+The `sharding` pass checks generic PartitionSpec consistency; this one
+knows the linalg ops' LAYOUT CONTRACTS (ops/linalg_ops.py) and
+verifies them before anything traces:
+
+- **block divisibility vs mesh axes** (`block-indivisible`, error):
+  SUMMA needs N, K divisible by dp and K, M divisible by tp;
+  Cholesky/QR/power iteration need N divisible by dp. An indivisible
+  shape can't be blocked without padding — XLA would reshard every
+  step.
+- **panel-spec consistency** (`panel-misaligned`, warning): an
+  explicit `panel`/`block` attr that doesn't divide the legal extents
+  is rounded DOWN by the lowering; the diagnostic names the size that
+  will actually run so a tuned table entry can't silently drift.
+- **no implicit full-gather resharding** (`layout-not-blocked` /
+  `implicit-full-gather`, error): on a >1-device grid every linalg
+  operand must carry its blocked PartitionSpec. A missing spec means
+  GSPMD replicates the operand — a FULL matrix per shard, the exact
+  failure the O(N^2/P) memory contract exists to prevent; a wrong
+  spec makes GSPMD insert a whole-matrix reshard in front of the
+  shard_map.
+
+Duck-typed like the sharding pass (mesh is a `.shape` mapping, specs
+iterate as entries) — never imports jax, so `tools/program_lint.py`
+runs it on a bastion host.
+"""
+
+from .base import analysis_pass
+
+LINALG_OPS = ('summa_matmul', 'blocked_cholesky', 'blocked_qr',
+              'power_iter_step')
+
+
+def _gcd(a, b):
+    while b:
+        a, b = b, a % b
+    return a
+
+
+def _entries(spec):
+    try:
+        return tuple(spec)
+    except TypeError:
+        return ()
+
+
+def _norm(entries):
+    """Strip trailing replicated dims so P('dp') == P('dp', None)."""
+    out = list(entries)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def _check_layout(ctx, op, i, shardings, want):
+    """Every (var, expected entries) pair must be annotated exactly."""
+    for name, expect in want.items():
+        if name is None:
+            continue
+        if name not in shardings:
+            ctx.error('layout-not-blocked',
+                      '%s operand %r has no sharding spec on a '
+                      'multi-device grid — GSPMD replicates it (a '
+                      'FULL matrix per shard), breaking the O(N^2/P) '
+                      'memory contract; annotate it %s'
+                      % (op.type, name, expect), op=op, op_index=i,
+                      var=name)
+            continue
+        got = _norm(_entries(shardings[name]))
+        if got != _norm(expect):
+            ctx.error('implicit-full-gather',
+                      '%s operand %r is annotated %s but the blocked '
+                      'layout is %s — GSPMD must insert a whole-'
+                      'matrix reshard before the shard_map every step'
+                      % (op.type, name, got, _norm(expect)), op=op,
+                      op_index=i, var=name)
+
+
+@analysis_pass('linalg')
+def check(ctx):
+    program = ctx.program
+    mesh_shape = {}
+    if program.mesh is not None:
+        mesh_shape = {str(a): int(s)
+                      for a, s in dict(program.mesh.shape).items()}
+    shardings = program.var_shardings or {}
+
+    for i, op in enumerate(ctx.block.ops):
+        if op.type not in LINALG_OPS:
+            continue
+        row = op.attrs.get('row_axis', op.attrs.get('axis', 'dp'))
+        col = op.attrs.get('col_axis', 'tp')
+        n_dp = int(mesh_shape.get(row, 1))
+        n_tp = int(mesh_shape.get(col, 1))
+        on_grid = n_dp * n_tp > 1
+
+        def dim(name, d):
+            shape = ctx.shape_of(name)
+            if shape is None or d >= len(shape):
+                return None
+            v = shape[d]
+            return int(v) if v is not None and v >= 0 else None
+
+        if op.type == 'summa_matmul':
+            xn, yn, on = op.input('X'), op.input('Y'), op.output('Out')
+            n, k = dim(xn, 0), dim(xn, 1)
+            m = dim(yn, 1)
+            for label, size, ax, extent in (
+                    ('N', n, row, n_dp), ('K', k, row, n_dp),
+                    ('K', k, col, n_tp), ('M', m, col, n_tp)):
+                if size is not None and extent > 1 and size % extent:
+                    ctx.error('block-indivisible',
+                              'summa_matmul dim %s=%d is not divisible '
+                              'by mesh axis %r (size %d) — the operand '
+                              'cannot be blocked without padding'
+                              % (label, size, ax, extent), op=op,
+                              op_index=i, var=xn)
+            panel = int(op.attrs.get('panel', 0) or 0)
+            if panel > 0 and k is not None and not (k % n_dp or
+                                                    k % n_tp):
+                g = _gcd(k // n_tp, k // n_dp)
+                if g % panel:
+                    legal = max(d for d in range(1, panel + 1)
+                                if g % d == 0)
+                    ctx.warning('panel-misaligned',
+                                'summa_matmul panel=%d does not divide '
+                                'gcd(K/%s, K/%s)=%d; the lowering '
+                                'rounds it down to %d'
+                                % (panel, col, row, g, legal), op=op,
+                                op_index=i, var=xn)
+            if on_grid:
+                _check_layout(ctx, op, i, shardings,
+                              {xn: (row, col), yn: (row, col),
+                               on: (row, col)})
+
+        elif op.type in ('blocked_cholesky', 'blocked_qr'):
+            xn = op.input('X')
+            n = dim(xn, 0)
+            m = dim(xn, 1)
+            if n is not None and n_dp > 1 and n % n_dp:
+                ctx.error('block-indivisible',
+                          '%s N=%d is not divisible by mesh axis %r '
+                          '(size %d)' % (op.type, n, row, n_dp), op=op,
+                          op_index=i, var=xn)
+            block = int(op.attrs.get('block', 0) or 0)
+            if block > 0:
+                extent = None
+                if op.type == 'blocked_cholesky' and n is not None \
+                        and n_dp >= 1 and not (n % max(n_dp, 1)):
+                    extent, what = n // max(n_dp, 1), 'N/dp'
+                elif op.type == 'blocked_qr' and m is not None:
+                    extent, what = m, 'M'
+                if extent is not None and extent % block:
+                    legal = max(d for d in range(1, block + 1)
+                                if extent % d == 0)
+                    ctx.warning('panel-misaligned',
+                                '%s block=%d does not divide %s=%d; '
+                                'the lowering rounds it down to %d'
+                                % (op.type, block, what, extent,
+                                   legal), op=op, op_index=i, var=xn)
+            if n_dp > 1:
+                want = {xn: (row,)}
+                if op.type == 'blocked_cholesky':
+                    want[op.output('Out')] = (row,)
+                else:
+                    want[op.output('Q')] = (row,)
+                    want[op.output('R')] = ()
+                _check_layout(ctx, op, i, shardings, want)
+
+        elif op.type == 'power_iter_step':
+            xn, vn = op.input('X'), op.input('V')
+            n = dim(xn, 0)
+            if n is not None and n_dp > 1 and n % n_dp:
+                ctx.error('block-indivisible',
+                          'power_iter_step N=%d is not divisible by '
+                          'mesh axis %r (size %d)' % (n, row, n_dp),
+                          op=op, op_index=i, var=xn)
+            if n_dp > 1:
+                _check_layout(ctx, op, i, shardings,
+                              {xn: (None, row), vn: (),
+                               op.output('VOut'): (),
+                               op.output('Eigval'): ()})

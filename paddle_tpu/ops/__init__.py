@@ -24,6 +24,7 @@ from . import detection_ops  # noqa: F401
 from . import decode_ops  # noqa: F401
 from . import paged_decode_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
 from . import lr_ops  # noqa: F401
 from . import vision_ops  # noqa: F401
 from . import metric_ops  # noqa: F401

@@ -1,0 +1,182 @@
+"""Distributed linear-algebra IR ops (ROADMAP item 4, the non-NN
+workload tier).
+
+Four ops lower to the shard_map kernels in ``paddle_tpu/linalg/
+kernels.py`` when the program runs on a mesh, and to single-device jnp
+references otherwise — proving the Program IR generalizes beyond ML:
+
+- ``summa_matmul``      X [N,K], Y [K,M] -> Out [N,M], all blocked
+                        P('dp','tp'); attr ``panel`` (0 = resolve)
+- ``blocked_cholesky``  X [N,N] SPD -> Out [N,N] lower factor, both
+                        row-blocked P('dp', None); attr ``block``
+- ``blocked_qr``        X [N,M] -> Q [N,M] row-blocked, R [M,M]
+                        replicated; attr ``block``
+- ``power_iter_step``   X [N,N] column-blocked P(None,'dp'),
+                        V [N] replicated -> VOut [N], Eigval [1];
+                        attrs ``quantized`` / ``qblock`` route the
+                        Rayleigh reduction through psum or the PR 13
+                        quantized allreduce
+
+Panel/block resolution order (per call, never at import): explicit op
+attr > ``PADDLE_TPU_SUMMA_PANEL`` / ``PADDLE_TPU_LINALG_BLOCK`` env >
+the autotuner's linalg family (``PADDLE_TPU_AUTOTUNE=on``) > the
+heuristic default. Illegal requests round DOWN to the nearest legal
+size (the pallas ``_pick_block`` convention) — the blocked-layout
+analysis pass flags truly indivisible shapes before any trace.
+"""
+
+import os
+
+from .. import observe as _obs
+from ..core.registry import register
+
+
+def _mesh(ctx):
+    return getattr(ctx.block.program, 'mesh', None)
+
+
+def _round_down_legal(value, legal):
+    """Largest legal size <= the requested one (smallest legal when the
+    request is below the whole ladder); `legal` is sorted ascending."""
+    picks = [x for x in legal if x <= int(value)]
+    if picks:
+        return picks[-1]
+    return legal[0] if legal else int(value)
+
+
+def _resolve_panel(ctx, n, k, m, dtype, mesh):
+    from .. import tuning
+    from ..linalg import kernels
+    n_dp, n_tp = kernels.axis_sizes_of(mesh, 'dp', 'tp')
+    legal = kernels.legal_panels(k, n_dp, n_tp)
+    attr = int(ctx.attr('panel', 0) or 0)
+    if attr > 0:
+        return _round_down_legal(attr, legal)
+    env = os.environ.get('PADDLE_TPU_SUMMA_PANEL')
+    if env:
+        return _round_down_legal(int(env), legal)
+    if tuning.autotune_mode() != 'off':
+        win = tuning.decide_summa_panel(n, k, m, str(dtype), mesh)
+        if win and win.get('panel'):
+            return _round_down_legal(int(win['panel']), legal)
+    return kernels.default_panel(k, n_dp, n_tp, n=n, m=m,
+                                 dtype=str(dtype))
+
+
+def _resolve_block(ctx, op, n, m, dtype, mesh):
+    from .. import tuning
+    from ..linalg import kernels
+    (n_dp,) = kernels.axis_sizes_of(mesh, 'dp')
+    if op == 'blocked_cholesky':
+        legal = kernels.legal_blocks(n, local=n // max(n_dp, 1))
+    else:
+        legal = kernels.legal_blocks(m)
+    attr = int(ctx.attr('block', 0) or 0)
+    if attr > 0:
+        return _round_down_legal(attr, legal)
+    env = os.environ.get('PADDLE_TPU_LINALG_BLOCK')
+    if env:
+        return _round_down_legal(int(env), legal)
+    if tuning.autotune_mode() != 'off':
+        win = tuning.decide_linalg_block(op, n, m, str(dtype), mesh)
+        if win and win.get('block'):
+            return _round_down_legal(int(win['block']), legal)
+    local = n // max(n_dp, 1) if op == 'blocked_cholesky' else None
+    return kernels.default_block(n if op == 'blocked_cholesky' else m,
+                                 local=local)
+
+
+def _memory_gauges(op, model, extra=None):
+    """Trace-time memory-contract telemetry (shapes are concrete at
+    lowering, so the analytic model is exact here)."""
+    if not _obs.enabled():
+        return
+    _obs.set_gauge('linalg.per_shard_peak_bytes', model['peak'], op=op)
+    _obs.set_gauge('linalg.memory_factor', model['factor'], op=op)
+    for k, v in (extra or {}).items():
+        _obs.set_gauge('linalg.%s' % k, v, op=op)
+
+
+@register('summa_matmul')
+def _summa_matmul(ctx):
+    from ..linalg import kernels
+    x = ctx.input('X')
+    y = ctx.input('Y')
+    mesh = _mesh(ctx)
+    if mesh is None:
+        ctx.set_output('Out', kernels.matmul_reference(x, y))
+        return
+    n, k = x.shape
+    m = y.shape[1]
+    panel = _resolve_panel(ctx, n, k, m, x.dtype, mesh)
+    _memory_gauges('summa_matmul', kernels.per_shard_peak_bytes(
+        'summa_matmul', mesh, (n, k, m), dtype=str(x.dtype),
+        panel=panel), {'summa_panel': panel})
+    ctx.set_output('Out', kernels.summa_matmul(
+        x, y, mesh, panel=panel,
+        row_axis=ctx.attr('row_axis', 'dp'),
+        col_axis=ctx.attr('col_axis', 'tp')))
+
+
+@register('blocked_cholesky')
+def _blocked_cholesky(ctx):
+    from ..linalg import kernels
+    x = ctx.input('X')
+    mesh = _mesh(ctx)
+    if mesh is None:
+        ctx.set_output('Out', kernels.cholesky_reference(x))
+        return
+    n = x.shape[0]
+    block = _resolve_block(ctx, 'blocked_cholesky', n, n, x.dtype, mesh)
+    _memory_gauges('blocked_cholesky', kernels.per_shard_peak_bytes(
+        'blocked_cholesky', mesh, (n, n), dtype=str(x.dtype),
+        block=block), {'factor_block': block})
+    ctx.set_output('Out', kernels.blocked_cholesky(
+        x, mesh, block=block, axis=ctx.attr('axis', 'dp')))
+
+
+@register('blocked_qr')
+def _blocked_qr(ctx):
+    from ..linalg import kernels
+    x = ctx.input('X')
+    mesh = _mesh(ctx)
+    if mesh is None:
+        q, r = kernels.qr_reference(x)
+        ctx.set_output('Q', q)
+        ctx.set_output('R', r)
+        return
+    n, m = x.shape
+    block = _resolve_block(ctx, 'blocked_qr', n, m, x.dtype, mesh)
+    _memory_gauges('blocked_qr', kernels.per_shard_peak_bytes(
+        'blocked_qr', mesh, (n, m), dtype=str(x.dtype), block=block),
+        {'factor_block': block})
+    q, r = kernels.blocked_qr(x, mesh, block=block,
+                              axis=ctx.attr('axis', 'dp'))
+    ctx.set_output('Q', q)
+    ctx.set_output('R', r)
+
+
+@register('power_iter_step')
+def _power_iter_step(ctx):
+    from ..linalg import kernels
+    x = ctx.input('X')
+    v = ctx.input('V')
+    mesh = _mesh(ctx)
+    quantized = bool(ctx.attr('quantized', False))
+    qblock = int(ctx.attr('qblock', 256))
+    n = x.shape[0]
+    if mesh is not None and _obs.enabled():
+        from ..quant import core as _q
+        (n_dp,) = kernels.axis_sizes_of(mesh, ctx.attr('axis', 'dp'))
+        if n_dp > 1:
+            fp32_b = _q.allreduce_wire_bytes(n, n_dp)
+            q_b = _q.quantized_allreduce_wire_bytes(n, n_dp, qblock)
+            _obs.set_gauge('linalg.powit_bytes_fp32', fp32_b)
+            _obs.set_gauge('linalg.powit_bytes_quant', q_b)
+            _obs.set_gauge('linalg.powit_compression',
+                           fp32_b / max(q_b, 1.0))
+    vn, lam = kernels.power_iter_step(
+        x, v, mesh, axis=ctx.attr('axis', 'dp'), quantized=quantized,
+        qblock=qblock)
+    ctx.set_output('VOut', vn)
+    ctx.set_output('Eigval', lam)

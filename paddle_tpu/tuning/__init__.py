@@ -21,13 +21,15 @@ warm start" covers both.
 
 from .autotune import (autotune_mode, current_table, decide,  # noqa: F401
                        decide_attention, decide_batch_norm,
-                       decide_layer_norm, decide_paged_attention,
+                       decide_layer_norm, decide_linalg_block,
+                       decide_paged_attention, decide_summa_panel,
                        device_kind, env_gate_set, reset, set_timer,
                        table_path)
 from .table import FORMAT_VERSION, TuningTable  # noqa: F401
 
 __all__ = ['autotune_mode', 'decide', 'decide_attention',
            'decide_batch_norm', 'decide_layer_norm',
-           'decide_paged_attention', 'device_kind', 'env_gate_set',
+           'decide_linalg_block', 'decide_paged_attention',
+           'decide_summa_panel', 'device_kind', 'env_gate_set',
            'reset', 'set_timer', 'table_path', 'current_table',
            'TuningTable', 'FORMAT_VERSION']

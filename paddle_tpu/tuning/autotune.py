@@ -38,7 +38,8 @@ from .. import observe as _obs
 from .table import TuningTable
 
 __all__ = ['autotune_mode', 'decide', 'reset', 'set_timer', 'table_path',
-           'current_table', 'device_kind', 'env_gate_set']
+           'current_table', 'device_kind', 'env_gate_set',
+           'decide_summa_panel', 'decide_linalg_block']
 
 _STATE = {'table': None, 'table_path': None, 'memo': {}, 'timer': None}
 
@@ -320,6 +321,75 @@ def decide_layer_norm(n, d, dtype):
             candidates.append(({'impl': 'pallas', 'block_rows': rows},
                                pallas_thunk))
     return decide('layer_norm', key, candidates)
+
+
+def _ladder(sizes, cap=6):
+    """Trim a legal-size ladder to at most `cap` candidates, keeping
+    the largest (each candidate runs the real distributed kernel, so
+    the sweep cost is bounded; the small end of the ladder loses on
+    per-step collective latency everywhere we have measured)."""
+    sizes = [s for s in sizes if s >= 8] or sizes[-1:]
+    return sizes[-cap:]
+
+
+def decide_summa_panel(n, k, m, dtype, mesh):
+    """SUMMA k-panel size over the legal ladder (divisors of
+    gcd(K/tp, K/dp)) — the `linalg` op family, keyed by
+    (op, shape, dtype, mesh grid). Candidates run the REAL shard_map
+    kernel on `mesh` at the live shape: coarse panels amortize the
+    broadcast chain, fine panels overlap it against the local dot, and
+    which wins is a property of the chip generation the table is keyed
+    by."""
+    import jax
+    import jax.numpy as jnp
+    from ..linalg import kernels
+
+    n_dp, n_tp = kernels.axis_sizes_of(mesh, 'dp', 'tp')
+    key = ('summa_matmul|n%d k%d m%d|dp%d tp%d|%s'
+           % (n, k, m, n_dp, n_tp, dtype))
+    panels = _ladder(kernels.legal_panels(k, n_dp, n_tp))
+    candidates = []
+    for p in panels:
+        def thunk(p=p):
+            a = jnp.ones((n, k), dtype)
+            b = jnp.ones((k, m), dtype)
+            return jax.jit(lambda a_, b_: kernels.summa_matmul(
+                a_, b_, mesh, panel=p))(a, b)
+        candidates.append(({'impl': 'summa', 'panel': p}, thunk))
+    return decide('summa_matmul', key, candidates)
+
+
+def decide_linalg_block(op, n, m, dtype, mesh, axis='dp'):
+    """Factorization panel width for blocked_cholesky / blocked_qr
+    over the legal ladder (cholesky panels must divide the per-shard
+    row extent; qr panels the column count). Same linalg family key
+    shape as decide_summa_panel."""
+    import jax
+    import jax.numpy as jnp
+    from ..linalg import kernels
+
+    (n_dp,) = kernels.axis_sizes_of(mesh, axis)
+    key = '%s|n%d m%d|dp%d|%s' % (op, n, m, n_dp, dtype)
+    if op == 'blocked_cholesky':
+        blocks = kernels.legal_blocks(n, local=n // n_dp)
+    elif op == 'blocked_qr':
+        blocks = kernels.legal_blocks(m)
+    else:
+        raise ValueError('decide_linalg_block: unknown op %r' % op)
+    candidates = []
+    for blk in _ladder(blocks):
+        def thunk(blk=blk):
+            if op == 'blocked_cholesky':
+                # synthetic SPD: diagonally dominant, full rank
+                a = jnp.eye(n, dtype=dtype) * (2.0 * n) + 1.0
+                return jax.jit(lambda a_: kernels.blocked_cholesky(
+                    a_, mesh, block=blk))(a)
+            a = (jnp.sin(jnp.arange(n * m, dtype=jnp.float32))
+                 .reshape(n, m).astype(dtype))
+            return jax.jit(lambda a_: kernels.blocked_qr(
+                a_, mesh, block=blk))(a)[0]
+        candidates.append(({'impl': 'blocked', 'block': blk}, thunk))
+    return decide(op, key, candidates)
 
 
 def decide_batch_norm(r, c, dtype):

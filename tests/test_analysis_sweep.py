@@ -154,6 +154,33 @@ def test_ptq_program_verifies():
     _strict('ptq_mlp', qprog, [out.name], feeds=['ids', 'x'])
 
 
+def test_linalg_programs_verify():
+    # the distributed linear-algebra builders (ISSUE 15) under the
+    # strict sweep — the blocked-layout pass's false-positive lock on
+    # all four ops, meshed and single-device
+    from paddle_tpu import linalg
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    grid = make_mesh(dp=2, tp=4)
+    line = make_mesh(dp=8)
+    prog, out = linalg.build_matmul_program(64, 128, 32, mesh=grid,
+                                            panel=8)
+    _strict('linalg_summa', prog, [out],
+            feeds=['summa_x', 'summa_y'])
+    prog, out = linalg.build_cholesky_program(64, mesh=line, block=4)
+    _strict('linalg_cholesky', prog, [out], feeds=['chol_x'])
+    prog, (q, r) = linalg.build_qr_program(128, 64, mesh=line, block=8)
+    _strict('linalg_qr', prog, [q, r], feeds=['qr_x'])
+    for quantized in (False, True):
+        prog, (v, lam) = linalg.build_power_iter_program(
+            64, mesh=line, quantized=quantized)
+        _strict('linalg_powit', prog, [v, lam],
+                feeds=['powit_x', 'powit_v'])
+    prog, out = linalg.build_matmul_program(8, 8, 8)   # no mesh
+    _strict('linalg_summa_1dev', prog, [out],
+            feeds=['summa_x', 'summa_y'])
+
+
 def test_seq2seq_graphs_verify():
     # the attention seq2seq train graph plus the beam-search generation
     # graph — the hairiest builders in the model zoo (recurrent nets,

