@@ -1363,6 +1363,406 @@ def bench_autoscale(in_dim=8, max_batch=8, max_queue_depth=12,
     }
 
 
+def bench_crosshost(in_dim=8, max_batch=4, max_queue_depth=16,
+                    compute_delay_ms=15.0, latency_budget_s=0.2,
+                    availability=0.9, window_s=1.5,
+                    kill_duration=8.0, kill_qps=18.0, kill_at=2.5,
+                    hung_duration=10.0, hung_qps=10.0, stall_at=2.0,
+                    crash_duration=12.0, crash_qps=8.0, crash_kills=3,
+                    crash_interval_s=2.5, crash_first_kill_at=1.0,
+                    heartbeat_timeout_s=0.6, replace_window_s=45.0,
+                    spawn_timeout_s=180.0, identity_requests=12,
+                    trace_sample=0.05):
+    """Cross-host fleet chaos (ISSUE 16): the replica-kill / hung-
+    worker / crash-loop scenarios with the fleet split across REAL
+    worker processes (serving.rpc.ProcessReplicaFactory spawning
+    tools/replica_worker.py), kills delivered as real SIGKILL to live
+    PIDs (fault.inject.kill_process). Asserts the tentpole contract
+    directly:
+
+    1. **replica kill** — SIGKILL one worker mid-load: zero
+       accepted-request loss (router failover resubmits in-flight
+       work typed as RemoteReplicaError), only typed error classes
+       observed, the victim's /readyz flip seen over plain HTTP, and
+       the controller heals the slot (a fresh process).
+    2. **hung worker** — SIGSTOP (alive but wedged): the /readyz
+       heartbeat timeout declares it dead, the corpse is SIGKILLed +
+       reaped, and a replacement is UP within ``replace_window_s``.
+    3. **crash loop** — repeated kill_process on one lineage's
+       replacements trips the quarantine breaker.
+    4. **bit identity** — the same deterministic request stream
+       through a subprocess replica and an in-process engine yields
+       byte-identical outputs.
+
+    Per-worker metrics JSONLs land beside the parent's sink;
+    ``tools/metrics_report.py --fleet <dir>`` renders the merged run
+    (per-replica census from child-emitted worker.* gauges)."""
+    import signal as _signal
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.fault import inject
+    from paddle_tpu.inference import create_predictor
+    from paddle_tpu.observe.slo import Objective, SloTracker
+    from paddle_tpu.serving import (FleetController,
+                                    NoReplicaAvailableError,
+                                    ProcessReplicaFactory, Router,
+                                    ServingEngine)
+    from paddle_tpu.serving.loadgen import (Stats, open_loop,
+                                            percentiles)
+
+    model_dir = _save_chaos_model(in_dim)
+    aot_dir = os.path.join(os.path.dirname(model_dir), 'aot_cache')
+    delay_s = float(compute_delay_ms) / 1000.0
+
+    # the typed vocabulary: every error a chaos run is ALLOWED to
+    # surface to a client (anything else is a bug, asserted below)
+    typed_errors = {'RemoteReplicaError', 'EngineClosedError',
+                    'QueueFullError', 'SLOShedError',
+                    'NoReplicaAvailableError', 'TimeoutError'}
+
+    worker_config = {
+        'kind': 'serving', 'model_dir': model_dir, 'backend': 'cpu',
+        'compute_delay_ms': compute_delay_ms,
+        'engine': {'max_batch_size': max_batch,
+                   'batch_timeout_ms': 1.0,
+                   'max_queue_depth': max_queue_depth}}
+
+    def http_readyz(url, timeout=1.0):
+        """GET /readyz over plain HTTP: status code, or None when the
+        TCP layer already says dead — the flip a real balancer sees."""
+        import http.client
+        hostport = url.rstrip('/').split('://', 1)[-1]
+        host, _, port = hostport.rpartition(':')
+        try:
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=timeout)
+            conn.request('GET', '/readyz')
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            return resp.status
+        except Exception:
+            return None
+
+    def counter_sum(snap, prefix):
+        return sum(v for k, v in snap['counters'].items()
+                   if k.startswith(prefix))
+
+    def run_scenario(tag, qps, duration, n_start, ctl_kw, chaos=None):
+        """One scenario over a fresh SUBPROCESS fleet. Same shape as
+        bench_autoscale's runner; every replica here is a PID."""
+        snap0 = observe.snapshot()
+        factory = ProcessReplicaFactory(
+            worker_config, spawn_timeout_s=spawn_timeout_s,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            admission_timeout_s=3.0)
+        t_w0 = time.perf_counter()
+        replicas = [factory.create('%s%d' % (tag, i))
+                    for i in range(n_start)]
+        warmup_s = time.perf_counter() - t_w0
+        tracker = SloTracker([Objective(tag, latency_budget_s,
+                                        availability_target=availability,
+                                        window_s=window_s)])
+        router = Router(replicas, slo=tracker, route=tag, retries=3,
+                        hedge=False)
+        ctl = FleetController(router, factory, slo=tracker, route=tag,
+                              name_prefix='%s-x' % tag, **ctl_kw)
+        ctl.start()
+
+        stats = Stats()
+        submitted = [0]
+        no_replica = [0]
+        error_types = set()
+
+        def submit_request(rng):
+            rows = int(rng.randint(1, max_batch + 1))
+            feed = {'x': rng.rand(rows, in_dim).astype('float32')}
+            try:
+                fut = router.submit(feed,
+                                    session=int(rng.randint(0, 64)))
+            except NoReplicaAvailableError:
+                no_replica[0] += 1
+                return None
+            submitted[0] += 1
+
+            def _type_cb(f):
+                exc = f.exception()
+                if exc is not None:
+                    error_types.add(type(exc).__name__)
+            fut.add_done_callback(_type_cb)
+            return fut, rows
+
+        goodput_timeline, census_timeline = [], []
+        t0 = time.perf_counter()
+        stop = threading.Event()
+
+        def sampler():
+            last_flush = 0.0
+            while not stop.wait(0.05):
+                now = time.perf_counter()
+                t = round(now - t0, 3)
+                goodput_timeline.append((t, tracker.goodput(tag, now)))
+                census_timeline.append((t, ctl.census()))
+                if now - last_flush >= 0.25:
+                    last_flush = now
+                    observe.flush(kind='snapshot')
+
+        threads = [threading.Thread(target=sampler, daemon=True)]
+        chaos_result = {}
+        if chaos is not None:
+            threads.append(threading.Thread(
+                target=lambda: chaos_result.update(
+                    chaos(ctl, router, factory, t0)), daemon=True))
+        for t in threads:
+            t.start()
+        open_loop(submit_request, stats, t0 + duration, qps)
+        ctl.close()                    # stop ticking before teardown
+        for _name, rep in router.replicas():
+            rep.shutdown(drain=True)
+        t_end = time.perf_counter() + 20.0
+        while stats.ok + stats.errors < submitted[0] and \
+                time.perf_counter() < t_end:
+            time.sleep(0.01)
+        stop.set()
+        wall = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=15)
+        ctl.close(shutdown_replicas=True)
+        router.close()
+        factory.close()                # no PID outlives the scenario
+        tracker.publish()
+        observe.flush(kind='snapshot')
+
+        snap1 = observe.snapshot()
+        delta = lambda prefix: (counter_sum(snap1, prefix)  # noqa: E731
+                                - counter_sum(snap0, prefix))
+        accepted = submitted[0]
+        completed = stats.ok + stats.errors
+        return dict({
+            'scenario': tag,
+            'duration_s': round(wall, 3),
+            'spawn_s': round(warmup_s, 3),
+            'accepted': accepted,
+            'completed': completed,
+            'lost': accepted - completed,
+            'requests_ok': stats.ok,
+            'requests_rejected': stats.rejected,
+            'requests_errored': stats.errors,
+            'no_replica': no_replica[0],
+            'error_types': sorted(error_types),
+            'untyped_errors': sorted(error_types - typed_errors),
+            'latency_ms': percentiles(stats.latencies),
+            'goodput_end_rps': round(
+                sum(g for _, g in goodput_timeline[-6:])
+                / max(1, len(goodput_timeline[-6:])), 2),
+            'census_timeline': census_timeline[::6],
+            'heals': delta('controller.heals_total'),
+            'deaths': delta('controller.deaths_total'),
+            'quarantines': delta('controller.quarantines_total'),
+            'spawn_failures': delta('controller.spawn_failures_total'),
+            'failovers': delta('router.failover_total'),
+            'process_kills': delta('fault.process_kills_total'),
+        }, **chaos_result)
+
+    def wait_replaced(ctl, base, victim, t_from, budget):
+        """Block until lineage ``base`` holds a DIFFERENT live replica
+        than ``victim`` (the controller declared the death and spawned
+        a replacement process); seconds-to-heal or None on timeout."""
+        deadline = t_from + budget
+        while time.perf_counter() < deadline:
+            cur = ctl.current(base)
+            if cur is not None and cur is not victim:
+                return round(time.perf_counter() - t_from, 3)
+            time.sleep(0.05)
+        return None
+
+    def kill_chaos(ctl, router, factory, t0):
+        wait = kill_at - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        victim = ctl.current('kill0')
+        if victim is None:           # slot already churned: any UP one
+            live = [r for _n, r in router.replicas() if r.ready()]
+            victim = live[0] if live else None
+        if victim is None:
+            return {'killed_pid': None}
+        readyz_before = http_readyz(victim.url)
+        pid = inject.kill_process(victim)
+        t_kill = time.perf_counter()
+        readyz_after = None
+        for _ in range(200):         # the HTTP-visible flip
+            status = http_readyz(victim.url, timeout=0.25)
+            if status != 200:
+                readyz_after = status
+                break
+            time.sleep(0.02)
+        healed_in = wait_replaced(ctl, 'kill0', victim, t_kill,
+                                  replace_window_s)
+        return {'killed_pid': pid,
+                'readyz_before': readyz_before,
+                'readyz_after': readyz_after,
+                'readyz_flipped': (readyz_before == 200
+                                   and readyz_after != 200),
+                'healed_in_s': healed_in}
+
+    def hung_chaos(ctl, router, factory, t0):
+        wait = stall_at - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        victim = ctl.current('hung0')
+        if victim is None:
+            return {'stalled_pid': None}
+        pid = inject.kill_process(victim, sig=_signal.SIGSTOP)
+        t_stop = time.perf_counter()
+        # the worker is ALIVE (kernel still completes its TCP
+        # handshakes) but answers nothing: only the heartbeat timeout
+        # can declare it dead
+        replaced_in = wait_replaced(ctl, 'hung0', victim, t_stop,
+                                    replace_window_s)
+        # defence in depth: the controller's reap path SIGKILLs the
+        # stopped corpse; if the window elapsed without that, unwedge
+        # so no stopped PID outlives the bench
+        try:
+            os.kill(pid, _signal.SIGKILL)
+        except (OSError, TypeError):
+            pass
+        return {'stalled_pid': pid, 'replaced_in_s': replaced_in,
+                'declared_dead_by_heartbeat': replaced_in is not None}
+
+    def crash_chaos(ctl, router, factory, t0):
+        wait = crash_first_kill_at - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        kills = 0
+        for i in range(crash_kills):
+            if i:
+                time.sleep(crash_interval_s)
+            # lineage-aware: every kill lands on whatever replacement
+            # the controller just spawned for slot 'crash1'
+            pid = inject.kill_process(lambda: ctl.current('crash1'))
+            if pid is not None:
+                kills += 1
+        # the breaker engaging is a census fact, not a counter: the
+        # flapping lineage must land in QUARANTINED
+        engaged = False
+        deadline = time.perf_counter() + replace_window_s
+        while time.perf_counter() < deadline:
+            if ctl.census().get('QUARANTINED', 0) >= 1:
+                engaged = True
+                break
+            time.sleep(0.05)
+        return {'kills_performed': kills,
+                'quarantine_engaged': engaged}
+
+    def identity_leg():
+        """Same deterministic request stream through a subprocess
+        replica and an in-process engine: outputs must be
+        byte-identical."""
+        factory = ProcessReplicaFactory(
+            worker_config, spawn_timeout_s=spawn_timeout_s,
+            heartbeat_timeout_s=heartbeat_timeout_s)
+        remote = factory.create('ident0')
+        local = ServingEngine(
+            _ChaosPredictor(create_predictor(model_dir), delay_s),
+            max_batch_size=max_batch, batch_timeout_ms=1.0,
+            max_queue_depth=max_queue_depth, name='ident-local')
+        local.warmup()
+        local.start()
+        rng = np.random.RandomState(1234)
+        mismatches = 0
+        try:
+            for i in range(identity_requests):
+                rows = (i % max_batch) + 1
+                feed = {'x': rng.rand(rows, in_dim).astype('float32')}
+                r_out = remote.submit(dict(feed)).result(30)
+                l_out = local.submit(dict(feed)).result(30)
+                for a, b in zip(r_out, l_out):
+                    a, b = np.asarray(a), np.asarray(b)
+                    if a.dtype != b.dtype or a.shape != b.shape or \
+                            a.tobytes() != b.tobytes():
+                        mismatches += 1
+        finally:
+            local.shutdown(drain=True)
+            remote.shutdown(drain=True)
+            factory.close()
+        return {'requests': identity_requests,
+                'mismatches': mismatches,
+                'bit_identical': mismatches == 0}
+
+    prev = {k: os.environ.get(k) for k in
+            ('PADDLE_TPU_TRACE_SAMPLE', 'PADDLE_TPU_AOT_CACHE',
+             'PADDLE_TPU_AOT_CACHE_DIR')}
+    os.environ['PADDLE_TPU_TRACE_SAMPLE'] = str(trace_sample)
+    # the AOT executable cache dir is INHERITED by every worker spawn:
+    # the first worker's warmup populates it, every later spawn (the
+    # heal path under chaos) warm-starts from serialized executables
+    os.environ['PADDLE_TPU_AOT_CACHE'] = '1'
+    os.environ['PADDLE_TPU_AOT_CACHE_DIR'] = aot_dir
+    try:
+        kill = run_scenario(
+            'kill', kill_qps, kill_duration, n_start=2,
+            ctl_kw=dict(min_replicas=2, max_replicas=3,
+                        interval_s=0.1, backoff_base_s=0.05,
+                        backoff_max_s=0.4, trough_s=1e9,
+                        scale_out_cooldown_s=1e9, queue_high=1e9,
+                        burn_high=1e9),
+            chaos=kill_chaos)
+        hung = run_scenario(
+            'hung', hung_qps, hung_duration, n_start=2,
+            ctl_kw=dict(min_replicas=2, max_replicas=3,
+                        interval_s=0.1, backoff_base_s=0.05,
+                        backoff_max_s=0.4, trough_s=1e9,
+                        scale_out_cooldown_s=1e9, queue_high=1e9,
+                        burn_high=1e9),
+            chaos=hung_chaos)
+        crash = run_scenario(
+            'crash', crash_qps, crash_duration, n_start=2,
+            ctl_kw=dict(min_replicas=1, max_replicas=3,
+                        interval_s=0.1, backoff_base_s=0.05,
+                        backoff_max_s=0.3, crash_loop_threshold=2,
+                        crash_window_s=60.0, quarantine_s=120.0,
+                        trough_s=1e9, scale_out_cooldown_s=1e9,
+                        queue_high=1e9, burn_high=1e9),
+            chaos=crash_chaos)
+        identity = identity_leg()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    result = {
+        'workload': 'crosshost',
+        'replica_kill': kill,
+        'hung_worker': hung,
+        'crash_loop': crash,
+        'bit_identity': identity,
+    }
+    # the tentpole contract, asserted HERE (ISSUE 16 acceptance): a
+    # crosshost bench run that returns is a crosshost bench run that
+    # held the line
+    assert kill['lost'] == 0, 'accepted requests lost: %r' % kill
+    assert not kill['untyped_errors'], \
+        'untyped errors surfaced: %s' % kill['untyped_errors']
+    assert kill.get('killed_pid'), 'chaos never killed a live PID'
+    assert kill.get('readyz_flipped'), \
+        'readyz flip not observed over HTTP: %r' % kill
+    assert kill.get('healed_in_s') is not None, \
+        'controller never healed the killed slot: %r' % kill
+    assert hung.get('declared_dead_by_heartbeat'), \
+        'hung worker not declared dead within %.0fs: %r' \
+        % (replace_window_s, hung)
+    assert hung['lost'] == 0 and not hung['untyped_errors'], \
+        'hung-worker scenario lost/mistyped requests: %r' % hung
+    assert crash.get('quarantine_engaged'), \
+        'crash loop never tripped quarantine: %r' % crash
+    assert identity['bit_identical'], \
+        'subprocess vs in-process results diverged: %r' % identity
+    return result
+
+
 def bench_disagg(duration=5.0, clients=10, n_prefill=1, n_decode=2,
                  vocab=4000, n_layer=4, n_head=4, d_model=128,
                  d_inner=256, max_batch=8, block_size=16,
@@ -2282,6 +2682,13 @@ def _run_workload_child(workload, backend, reduced):
         print('RESULT_JSON %s' % json.dumps(bench_autoscale(**kw)),
               flush=True)
         return
+    if workload == 'crosshost':
+        kw = dict(kill_duration=6.0, hung_duration=8.0,
+                  crash_duration=9.0, crash_kills=2,
+                  identity_requests=6) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_crosshost(**kw)),
+              flush=True)
+        return
     if workload == 'quant':
         kw = dict(steps=60, kv_duration=1.5, fleet_duration=3.0,
                   reduced=True) if reduced else {}
@@ -2839,7 +3246,7 @@ WORKLOAD_CHOICES = [
     'moe_cap1.0', 'moe_cap1.25', 'moe_cap2.0', 'pipeline_transformer',
     'pipeline_resnet50', 'decode_transformer', 'fleet', 'autoscale',
     'quant', 'disagg', 'linalg', 'autotune', 'autotune_child',
-    'verify',
+    'verify', 'crosshost',
 ]
 
 if __name__ == '__main__':

@@ -39,7 +39,7 @@ import os
 __all__ = ['KILL_EXIT_CODE', 'FaultPlan', 'TransientReaderError',
            'install', 'install_from_env', 'clear', 'active', 'fire',
            'truncate_file', 'poison_nans', 'flaky', 'kill_replica',
-           'crash_loop']
+           'crash_loop', 'kill_process']
 
 KILL_EXIT_CODE = 42
 _ENV_KILL = 'PADDLE_TPU_FI_KILL_AT_STEP'
@@ -208,6 +208,58 @@ def crash_loop(engine, kills, interval_s):
         last = victim
         killed += 1
     return killed
+
+
+def kill_process(proc_or_resolver, sig=None):
+    """Chaos action for the CROSS-HOST fleet: deliver a real signal
+    (default SIGKILL) to a live replica worker PID — death the kernel
+    enforces, not a flipped flag. Mirrors ``kill_replica`` /
+    ``crash_loop``:
+
+    ``proc_or_resolver`` is any of
+      - a ``subprocess.Popen`` (or anything with ``.pid``),
+      - a ``serving.rpc.RemoteReplica`` (its ``.proc`` is the victim),
+      - a raw integer PID, or
+      - the interesting form: a zero-arg callable returning any of the
+        above or None — ``lambda: ctl.current('r2')`` aims every kill
+        at whatever replacement the controller just spawned.
+
+    Emits the ``process_kill`` flight event +
+    ``fault.process_kills_total`` before the signal (the postmortem
+    must show the kill even if this process dies next). Returns the
+    PID signalled, or None when there was no victim (slot empty /
+    process already reaped) — a quarantined slot producing no victims
+    is the breaker WORKING, same contract as ``crash_loop``."""
+    import signal
+    victim = (proc_or_resolver() if callable(proc_or_resolver)
+              else proc_or_resolver)
+    if victim is None:
+        return None
+    proc = getattr(victim, 'proc', None) or victim   # RemoteReplica
+    if isinstance(proc, int):
+        pid, alive = proc, True
+    else:
+        pid = getattr(proc, 'pid', None)
+        if pid is None:
+            return None
+        poll = getattr(proc, 'poll', None)
+        alive = poll() is None if callable(poll) else True
+    if not alive:
+        return None                 # already a reaped corpse
+    signum = int(sig) if sig is not None else signal.SIGKILL
+    try:
+        from .. import observe as _obs
+        _obs.flight_event('process_kill', pid=int(pid), sig=signum,
+                          replica=str(getattr(victim, 'name', pid)))
+        _obs.inc('fault.process_kills_total',
+                 replica=str(getattr(victim, 'name', pid)))
+    except Exception:
+        pass
+    try:
+        os.kill(int(pid), signum)
+    except ProcessLookupError:
+        return None                 # raced with its own death
+    return int(pid)
 
 
 def truncate_file(path, keep_fraction=0.5):

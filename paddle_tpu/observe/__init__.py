@@ -67,7 +67,8 @@ from .spans import SpanRecorder
 __all__ = ['enabled', 'enable', 'enable_from_env', 'disable', 'reset',
            'registry', 'spans', 'counter', 'gauge', 'histogram', 'inc',
            'set_gauge', 'add_gauge', 'record', 'get_gauge', 'get_counter',
-           'span', 'key_id', 'flush', 'maybe_flush', 'export_trace',
+           'span', 'key_id', 'flush', 'maybe_flush', 'jsonl_path',
+           'export_trace',
            'run_begin', 'step_done', 'overhead', 'goodput',
            'step_telemetry', 'summary_table', 'snapshot',
            'device_peak_flops', 'cost_analysis_flops',
@@ -127,6 +128,14 @@ def enable(jsonl=None, trace=None, every_secs=30.0):
     if not _atexit_armed:
         _atexit_armed.append(True)
         atexit.register(_atexit_flush)
+
+
+def jsonl_path():
+    """Path of the JSONL metrics sink, or None when no sink is set.
+    The cross-process fleet uses this to place each replica worker's
+    sink beside the parent's (``<stem>-<replica>.jsonl``), so one
+    ``tools/metrics_report.py --fleet <dir>`` merges the whole run."""
+    return _SINK['path']
 
 
 def enable_from_env(environ=None):
@@ -321,9 +330,16 @@ def summary_table():
 
 
 def _host():
-    """jax.process_index() when jax is loaded and initialized, else 0 —
-    the `host` tag on flushed/snapshot records that makes merged
-    multihost JSONLs attributable (never imports jax itself)."""
+    """The `host` tag on flushed/snapshot records that makes merged
+    multihost JSONLs attributable. ``PADDLE_TPU_OBSERVE_HOST`` (read
+    per call) overrides — replica worker subprocesses stamp their
+    replica name here so a fleet's side-by-side JSONLs stay
+    disambiguated even though every worker is jax process 0; otherwise
+    jax.process_index() when jax is loaded and initialized, else 0
+    (never imports jax itself)."""
+    label = os.environ.get('PADDLE_TPU_OBSERVE_HOST')
+    if label:
+        return label
     jax = sys.modules.get('jax')
     if jax is not None:
         try:

@@ -36,6 +36,15 @@ where ``fn()`` returns truthy/falsy or ``(ok, detail)``. Checks
 registered with ``readiness_only=True`` gate /readyz but not /healthz
 (an engine that has not warmed up yet is unready, not unhealthy).
 
+POST handlers are pluggable the same way: ``register_post_handler(
+path, fn)`` where ``fn(handler, body_bytes)`` owns the whole response
+(it may send status + headers early and stream the body — the serving
+RPC control plane's submit/stream endpoints in serving/rpc.py do
+exactly that, acking admission before the result exists). An
+unhandled exception inside ``fn`` becomes a 500 JSON envelope
+``{"error": {"type", "message"}}`` when the response has not started
+yet; the GET routes are unaffected.
+
 The server only reads shared state under the registry's own locks; it
 adds zero work to instrumented call sites — the hot-path contract
 stays one ``enabled()`` boolean read, server or no server.
@@ -50,13 +59,17 @@ from .registry import parse_rendered, prometheus_exposition
 
 __all__ = ['DiagnosticsServer', 'start', 'stop', 'active',
            'register_health_check', 'unregister_health_check',
-           'run_health_checks']
+           'run_health_checks', 'register_post_handler',
+           'unregister_post_handler']
 
 _lock = threading.Lock()
 _server = None          # the active DiagnosticsServer, if any
 
 _checks_lock = threading.Lock()
 _checks = {}            # name -> (fn, readiness_only)
+
+_post_lock = threading.Lock()
+_post_handlers = {}     # path -> fn(handler, body_bytes)
 
 
 # ------------------------------------------------------- health checks
@@ -103,6 +116,29 @@ def run_health_checks(include_readiness=False):
         'detail': ('tripped: %s' % ', '.join(tripped)) if tripped
         else None}
     return all_ok and not tripped, results
+
+
+# -------------------------------------------------------- POST handlers
+def register_post_handler(path, fn):
+    """Route POST ``path`` to ``fn(handler, body_bytes)``. ``handler``
+    is the live BaseHTTPRequestHandler: the fn owns the response (use
+    ``handler._send`` for one-shot bodies, or send status + headers
+    itself and stream). Re-registering a path replaces the handler —
+    the serving RPC layer (serving/rpc.py) binds engines here."""
+    if not callable(fn):
+        raise TypeError('POST handler for %r is not callable' % path)
+    with _post_lock:
+        _post_handlers[str(path)] = fn
+
+
+def unregister_post_handler(path):
+    with _post_lock:
+        _post_handlers.pop(str(path), None)
+
+
+def _post_handler(path):
+    with _post_lock:
+        return _post_handlers.get(path)
 
 
 # ------------------------------------------------------------- payloads
@@ -540,6 +576,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     {'error': '%s: %s' % (type(e).__name__, e)}))
             except Exception:
                 pass
+
+    def do_POST(self):
+        path, _, _query = self.path.partition('?')
+        fn = _post_handler(path)
+        if fn is None:
+            with _post_lock:
+                routes = sorted(_post_handlers)
+            self._send(404, json.dumps({'error': 'no POST route %s'
+                                        % path, 'routes': routes}))
+            return
+        try:
+            length = int(self.headers.get('Content-Length', 0) or 0)
+            body = self.rfile.read(length) if length > 0 else b''
+            fn(self, body)
+        except Exception as e:   # handler died before/while responding
+            try:
+                self._send(500, json.dumps(
+                    {'error': {'type': type(e).__name__,
+                               'message': str(e)}}))
+            except Exception:
+                pass             # response already started: drop the wire
 
 
 class _ThreadingServer(http.server.ThreadingHTTPServer):

@@ -33,6 +33,8 @@ from .handoff import (HandoffError, KVDtypeMismatchError,  # noqa: F401
                       KVGeometryError, KVPacket)
 from .router import (NoReplicaAvailableError, PhaseRouter,  # noqa: F401
                      Router, SLOShedError)
+from .rpc import (ProcessReplicaFactory, RemoteCallError,  # noqa: F401
+                  RemoteReplica, RemoteReplicaError, serve_engine)
 
 # The decode subpackage (continuous batching + paged KV cache) imports
 # lazily via `from paddle_tpu.serving import decode` /

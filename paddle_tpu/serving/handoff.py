@@ -47,9 +47,16 @@ and destination raises :class:`KVDtypeMismatchError` — the wire NEVER
 silently dequantizes.
 
 Env knob (read per call — this file is in tools/repo_lint.py's
-ENV_SCOPED_FILES): ``PADDLE_TPU_HANDOFF_VERIFY=1`` adds a sha1 over
-the page payload to every packet and verifies it on decode; off by
-default (the e2e bit-identity tests are the stronger check).
+ENV_SCOPED_FILES): ``PADDLE_TPU_HANDOFF_VERIFY`` adds a sha1 over the
+page payload to every packet. The default is **transport-dependent**:
+in-process handoff keeps it opt-in (``1`` to enable — the e2e
+bit-identity tests are the stronger check there), but a packet
+serialized for the **socket** transport (``to_bytes(transport=
+'socket')``, which is what serving/rpc.py's cross-host hop uses)
+stamps the sha1 unless explicitly disabled with ``0`` — a corrupted
+network packet must be a typed refusal, never silent KV corruption.
+``from_bytes`` verifies whenever the header carries a sha1,
+regardless of the env: a stamped packet is always checked on receive.
 """
 
 import hashlib
@@ -87,10 +94,16 @@ class KVGeometryError(HandoffError):
     not match the destination arenas."""
 
 
-def handoff_verify_enabled():
-    """PADDLE_TPU_HANDOFF_VERIFY knob, read per call."""
-    return os.environ.get('PADDLE_TPU_HANDOFF_VERIFY', '0') \
-        not in ('0', 'false', 'False', '')
+def handoff_verify_enabled(transport='inproc'):
+    """PADDLE_TPU_HANDOFF_VERIFY knob, read per call. Unset, the
+    default depends on the transport: OFF for the in-process hop
+    (opt-in), ON for ``transport='socket'`` (a wire that can corrupt
+    must be verified by default). An explicit ``0`` disables either;
+    an explicit ``1`` enables either."""
+    raw = os.environ.get('PADDLE_TPU_HANDOFF_VERIFY')
+    if raw is None or raw == '':
+        return transport == 'socket'
+    return raw not in ('0', 'false', 'False')
 
 
 class KVPacket(object):
@@ -127,11 +140,13 @@ class KVPacket(object):
         return sum(a.nbytes for a in self.arrays.values())
 
     # ------------------------------------------------------------ wire
-    def to_bytes(self):
+    def to_bytes(self, transport='inproc'):
         """MAGIC + u32 header length + header JSON + raw arena bytes
         in header arena order. bf16 ships as its raw 2-byte payload
         (io.to_numpy's uint16 view); the header records the logical
-        dtype so from_bytes restores it exactly."""
+        dtype so from_bytes restores it exactly. ``transport='socket'``
+        (the cross-host RPC hop) stamps the payload sha1 by default —
+        see handoff_verify_enabled."""
         from .. import io as _io
         blobs, arenas = [], []
         for name in sorted(self.arrays):
@@ -144,7 +159,7 @@ class KVPacket(object):
                            .get(name, [])})
             blobs.append(raw.tobytes())
         header = dict(self.header, arenas=arenas)
-        if handoff_verify_enabled():
+        if handoff_verify_enabled(transport):
             sha = hashlib.sha1()
             for b in blobs:
                 sha.update(b)
@@ -174,7 +189,11 @@ class KVPacket(object):
                 .reshape(shape)
             arrays[ent['name']] = _io._from_numpy(raw, dtype_name)
             off += n
-        if handoff_verify_enabled() and header.get('sha1'):
+        if header.get('sha1'):
+            # a stamped packet is ALWAYS verified on receive — the env
+            # knob gates whether the writer stamps, never whether the
+            # reader checks (a socket packet that went bad in flight
+            # must refuse typed, not install silently)
             sha = hashlib.sha1(data[payload_start:off]).hexdigest()
             if sha != header['sha1']:
                 raise HandoffError('KV packet payload corrupt: sha1 '
@@ -359,15 +378,38 @@ def handoff(src_engine, dst_engine, tokens, via_bytes=True):
     trip through the wire encoding, install into ``dst_engine``.
     Returns the covered token count (0 when nothing was cached to
     ship). One ``kv_handoff`` flight event + ``handoff.*`` metrics per
-    call — the unit the phase router's pipeline drives."""
+    call — the unit the phase router's pipeline drives.
+
+    Either side may be a cross-host ``serving.rpc.RemoteReplica``
+    (duck-typed on ``export_packet_bytes`` / ``install_packet_bytes``):
+    the packet then moves as its socket wire encoding — sha1-stamped
+    by default (handoff_verify_enabled('socket')) — and the install
+    runs on the destination WORKER against its own prefix cache, so
+    the dedup-against-destination path is identical to the in-process
+    hop: shared prefixes still ship once per decode host."""
     t0 = time.perf_counter()
-    pkt = export_packet(src_engine, tokens)
-    if pkt is None:
-        return 0
+    remote_src = callable(getattr(src_engine, 'export_packet_bytes',
+                                  None))
+    remote_dst = callable(getattr(dst_engine, 'install_packet_bytes',
+                                  None))
+    transport = 'socket' if (remote_src or remote_dst) else 'inproc'
+    if remote_src:
+        data = src_engine.export_packet_bytes(tokens)
+        if not data:
+            return 0
+        pkt = KVPacket.from_bytes(data)
+    else:
+        pkt = export_packet(src_engine, tokens)
+        if pkt is None:
+            return 0
     wire = pkt.wire_bytes()
-    if via_bytes:
-        pkt = KVPacket.from_bytes(pkt.to_bytes())
-    covered, installed, dedup = install_packet(dst_engine, pkt)
+    if remote_dst:
+        covered, installed, dedup = dst_engine.install_packet_bytes(
+            pkt.to_bytes(transport='socket'))
+    else:
+        if via_bytes and not remote_src:  # remote src already rode the wire
+            pkt = KVPacket.from_bytes(pkt.to_bytes(transport=transport))
+        covered, installed, dedup = install_packet(dst_engine, pkt)
     dt = time.perf_counter() - t0
     if _obs.enabled():
         _obs.inc('handoff.count_total')
@@ -376,6 +418,6 @@ def handoff(src_engine, dst_engine, tokens, via_bytes=True):
     _obs.flight_event('kv_handoff', pages=pkt.n_pages,
                       installed=installed, dedup=dedup,
                       covered_tokens=covered, bytes=wire,
-                      kv_dtype=pkt.kv_dtype,
+                      kv_dtype=pkt.kv_dtype, transport=transport,
                       seconds=round(dt, 6))
     return covered

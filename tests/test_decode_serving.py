@@ -234,6 +234,7 @@ def test_streaming_tokens_arrive_incrementally():
         assert isinstance(tok, int)
     assert got == stream.result()
     assert stream.done()
+    eng.shutdown()
 
 
 def test_sampled_streams_deterministic_per_seed():

@@ -192,7 +192,16 @@ def test_packet_verify_knob_catches_corruption(monkeypatch):
     with pytest.raises(HandoffError):
         KVPacket.from_bytes(bytes(wire))
     monkeypatch.setenv('PADDLE_TPU_HANDOFF_VERIFY', '0')
-    KVPacket.from_bytes(bytes(wire))    # knob off: no sha1 check
+    with pytest.raises(HandoffError):
+        # a STAMPED packet is always verified on receive — the knob
+        # gates whether the writer stamps (ISSUE 16: a socket packet
+        # that went bad in flight must refuse typed, never install)
+        KVPacket.from_bytes(bytes(wire))
+    unstamped = bytearray(handoff_mod.export_packet(eng, prompt)
+                          .to_bytes())
+    assert b'sha1' not in bytes(unstamped)
+    unstamped[-3] ^= 0xFF
+    KVPacket.from_bytes(bytes(unstamped))   # knob off: never stamped
     eng.shutdown()
 
 

@@ -25,6 +25,7 @@ flight recorder's postmortem JSON).
 """
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -45,19 +46,35 @@ def _registry_mod():
 
 
 def load_records(path):
-    """Parse records, skipping torn lines (concurrent appenders)."""
+    """Parse records, skipping torn lines (concurrent appenders).
+
+    ``path`` may be a single JSONL file, a directory (every ``*.jsonl``
+    inside is merged — the shape a cross-host run leaves behind: the
+    parent's sink plus one ``<stem>-<replica>.jsonl`` per worker
+    process), or a glob pattern. Merged records are ordered by ``ts``
+    so counter-delta timelines stay monotonic; each record's ``host``
+    field says which process emitted it."""
+    if os.path.isdir(path):
+        paths = sorted(glob.glob(os.path.join(path, '*.jsonl')))
+    elif any(ch in path for ch in '*?['):
+        paths = sorted(glob.glob(path))
+    else:
+        paths = [path]
     out = []
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if not ln:
-                continue
-            try:
-                rec = json.loads(ln)
-            except ValueError:
-                continue
-            if isinstance(rec, dict):
-                out.append(rec)
+    for p in paths:
+        with open(p) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    if len(paths) > 1:
+        out.sort(key=lambda r: (r.get('ts') is None, r.get('ts') or 0))
     return out
 
 
@@ -279,10 +296,25 @@ def derive_fleet(records):
         totals = totals_of(last, cnames)
     else:
         totals = dict.fromkeys(cnames, 0)
+    # per-process census of a cross-host run: every replica worker
+    # heartbeats worker.up / worker.ready / worker.queue_depth into its
+    # own JSONL (host = replica name); the newest record per host wins
+    workers = {}
+    for rec in records:
+        doc = None
+        for rendered, v in rec.get('gauges', {}).items():
+            name, labels = parse(rendered)
+            if name.startswith('worker.'):
+                if doc is None:
+                    doc = {'pid': rec.get('pid')}
+                doc[name.split('.', 1)[1]] = v
+        if doc is not None:
+            workers[str(rec.get('host', '?'))] = doc
     return {
         'census_timeline': census_timeline,
         'scale_events': events,
         'replicas': replicas,
+        'workers': workers,
         'totals': {k.split('.', 1)[1]: v for k, v in totals.items()},
         'hedge': hedge,
         'phases': derive_phases(records),
@@ -359,7 +391,8 @@ def derive_phases(records):
 def render_fleet(records):
     doc = derive_fleet(records)
     if not doc['census_timeline'] and not doc['replicas'] and \
-            not doc['scale_events'] and not doc.get('phases'):
+            not doc['scale_events'] and not doc.get('phases') and \
+            not doc.get('workers'):
         return 'no controller.* or phase/handoff metrics in this JSONL'
     lines = ['== fleet controller timeline']
     for ev in doc['scale_events']:
@@ -380,6 +413,16 @@ def render_fleet(records):
         lines.append('== final replica states')
         for name in sorted(doc['replicas']):
             lines.append('   %-24s %s' % (name, doc['replicas'][name]))
+    if doc.get('workers'):
+        lines.append('== worker processes (child-emitted gauges)')
+        for host in sorted(doc['workers']):
+            w = doc['workers'][host]
+            lines.append('   %-24s pid %-8s up %-3s ready %-3s '
+                         'queue_depth %s'
+                         % (host, w.get('pid', '?'),
+                            int(w.get('up', 0)),
+                            int(w.get('ready', 0)),
+                            w.get('queue_depth', '?')))
     h = doc['hedge']
     if h:
         lines.append('== hedged requests vs retry budget')

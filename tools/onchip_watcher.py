@@ -111,6 +111,12 @@ QUEUE = [
     # compile on the transformer program; analysis.* gauges land in the
     # shared metrics JSONL and `ok` asserts the <1% contract on-chip
     ('verify', 'verify', None, 600),
+    # cross-host fleet chaos (ISSUE 16): replica workers as REAL
+    # subprocesses behind the RPC control plane — SIGKILL mid-load
+    # (zero loss + typed errors + heal), SIGSTOP hung-worker heartbeat
+    # death, crash-loop quarantine, subprocess-vs-in-process bit
+    # identity; rpc.*/worker.* metrics land in the shared JSONL
+    ('crosshost', 'crosshost', None, 900),
 ]
 
 # non-bench tools: (key, argv, timeout) — raw stdout lines stored

@@ -50,8 +50,16 @@ ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
                     'paddle_tpu/quant/__init__.py',
                     'paddle_tpu/quant/core.py',
                     'paddle_tpu/quant/ptq.py',
-                    'paddle_tpu/parallel/collective.py')
+                    'paddle_tpu/parallel/collective.py',
+                    # cross-host RPC knobs (timeouts, verify default)
+                    # must stay per-call reads
+                    'paddle_tpu/serving/rpc.py')
 LINT_ROOT = 'paddle_tpu'
+
+# files OUTSIDE the lint root that still get the full env-scoped lint —
+# the replica worker entrypoint runs paddle_tpu code in a fresh process
+# and must not freeze env at import either
+EXTRA_ENV_SCOPED_FILES = ('tools/replica_worker.py',)
 
 _ENV_ATTRS = ('environ', 'getenv')
 _ENV_NAMES = ('environ', 'getenv', 'get_flag', 'FLAGS')
@@ -183,6 +191,14 @@ def lint_tree(root):
             violations.extend(lint_source(
                 os.path.relpath(path, root), source,
                 env_scoped=env_scoped))
+    for rel in EXTRA_ENV_SCOPED_FILES:
+        path = os.path.join(root, rel.replace('/', os.sep))
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+        except OSError:
+            continue                 # entrypoint not present in this tree
+        violations.extend(lint_source(rel, source, env_scoped=True))
     return violations
 
 
