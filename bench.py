@@ -1763,6 +1763,394 @@ def bench_crosshost(in_dim=8, max_batch=4, max_queue_depth=16,
     return result
 
 
+def bench_multitenant(in_dim=8, max_batch=8, max_queue_depth=16,
+                      compute_delay_ms=8.0, interactive_qps=25.0,
+                      batch_quota_rps=10.0, flood_factor=10.0,
+                      mix_duration=3.0, quota_rps=8.0, quota_qps=40.0,
+                      quota_duration=2.0, inv_batch_new=40,
+                      inv_inter_new=8, latency_budget_s=0.002,
+                      window_s=1.2, tick_s=0.05, train_batches=10,
+                      train_split=3):
+    """Multi-tenant fleet chaos (ISSUE 18): four scenarios through the
+    serving.tenancy policy layer, the acceptance contract asserted
+    inline (a run that returns is a run that held the line):
+
+    1. **noisy neighbor** — an interactive tenant's goodput is first
+       measured solo, then again while a batch tenant floods
+       ``flood_factor``x its request quota: the token bucket sheds the
+       flood at admission, so interactive goodput stays within 10% of
+       the solo baseline.
+    2. **quota exhaustion** — a tenant offered well past its quota:
+       every shed is the typed ``QuotaExceededError`` (never a bare
+       queue-full), and the in-quota traffic that WAS admitted loses
+       nothing.
+    3. **priority inversion** — a decode engine whose KV pool the
+       batch class has saturated receives interactive arrivals: pool
+       exhaustion preempts only batch sequences (lowest class first),
+       interactive preemptions stay zero while every interactive
+       request completes.
+    4. **co-location** — a background fine-tuning Trainer shares the
+       host with serving; SLO-violating traffic drives the burn rate
+       past 1 and ``colocation_yield`` yields the trainer within one
+       FleetController tick (``tenant_yield`` flight event +
+       ``tenant.trainer_yields_total``), calm resumes it, and the
+       final params are bit-identical to an uninterrupted run at the
+       same step count.
+
+    ``tenant.admitted/shed/preempted/evicted_pages`` land in the
+    metrics JSONL; ``tools/metrics_report.py --tenants`` renders the
+    per-tenant isolation panel."""
+    import threading
+
+    from paddle_tpu import observe
+    from paddle_tpu.observe.slo import Objective, SloTracker
+    from paddle_tpu.serving import (FleetController, QueueFullError,
+                                    NoReplicaAvailableError,
+                                    QuotaExceededError, Router,
+                                    ServingEngine, TenantRegistry,
+                                    colocation_yield,
+                                    slo_burn_pressure)
+    from paddle_tpu.serving.loadgen import (Stats, open_loop,
+                                            percentiles)
+
+    model_dir = _save_chaos_model(in_dim)
+    from paddle_tpu.inference import create_predictor
+
+    delay_s = float(compute_delay_ms) / 1000.0
+
+    def make_engine(name):
+        pred = _ChaosPredictor(create_predictor(model_dir), delay_s)
+        return ServingEngine(pred, max_batch_size=max_batch,
+                             batch_timeout_ms=1.0,
+                             max_queue_depth=max_queue_depth,
+                             name=name)
+
+    def counter_sel(snap, prefix, substr=''):
+        return sum(v for k, v in snap['counters'].items()
+                   if k.startswith(prefix) and substr in k)
+
+    # ------------------------------------------------- mix harness
+    def run_mix(tag, registry, traffic, duration, n_engines=2):
+        """Open-loop pacers, one per tenant (``traffic`` is
+        ``[(tenant, qps, sessions)]``), through one quota-equipped
+        Router. Returns per-tenant admission/goodput ledgers plus the
+        tenant.* counter deltas for the window."""
+        snap0 = observe.snapshot()
+        engines = []
+        for i in range(n_engines):
+            eng = make_engine('%s%d' % (tag, i))
+            eng.warmup()
+            eng.start()
+            engines.append(eng)
+        router = Router(engines, route=tag, tenants=registry)
+        t0 = time.perf_counter()
+        per, threads = {}, []
+        for seed, (name, qps, sessions) in enumerate(traffic):
+            led = {'stats': Stats(t0), 'submitted': [0],
+                   'typed': [0], 'untyped': [0]}
+
+            def submit_request(rng, name=name, sessions=sessions,
+                               led=led):
+                feed = {'x': rng.rand(1, in_dim).astype('float32')}
+                session = '%s/s%d' % (name,
+                                      int(rng.randint(sessions)))
+                try:
+                    fut = router.submit(feed, session=session)
+                except QuotaExceededError:
+                    led['typed'][0] += 1
+                    return None
+                except (QueueFullError, NoReplicaAvailableError):
+                    led['untyped'][0] += 1
+                    return None
+                led['submitted'][0] += 1
+                return fut, 1
+
+            per[name] = led
+            threads.append(threading.Thread(
+                target=open_loop,
+                args=(submit_request, led['stats'], t0 + duration,
+                      qps),
+                kwargs=dict(seed=101 + seed), daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for eng in engines:
+            eng.shutdown(drain=True)
+        accepted = sum(led['submitted'][0] for led in per.values())
+        t_end = time.perf_counter() + 15.0
+        while sum(led['stats'].ok + led['stats'].errors
+                  for led in per.values()) < accepted and \
+                time.perf_counter() < t_end:
+            time.sleep(0.01)
+        router.close()
+        snap1 = observe.snapshot()
+        out = {'scenario': tag, 'duration_s': duration, 'tenants': {}}
+        for name, led in per.items():
+            s = led['stats']
+            out['tenants'][name] = {
+                'offered': led['submitted'][0] + s.rejected,
+                'admitted': led['submitted'][0],
+                'ok': s.ok,
+                'errors': s.errors,
+                'lost': led['submitted'][0] - (s.ok + s.errors),
+                'quota_sheds': led['typed'][0],
+                'untyped_rejects': led['untyped'][0],
+                'goodput_rps': round(s.ok / duration, 2),
+                'latency_ms': percentiles(s.latencies),
+                'shed_counter': counter_sel(
+                    snap1, 'tenant.shed', 'tenant=%s' % name)
+                - counter_sel(snap0, 'tenant.shed',
+                              'tenant=%s' % name),
+            }
+        return out
+
+    # 1 — noisy neighbor: batch flood vs interactive goodput
+    def mk_registry():
+        reg = TenantRegistry()
+        reg.add('fg', priority='interactive')
+        reg.add('bg', priority='batch', request_rate=batch_quota_rps)
+        return reg
+
+    solo = run_mix('nnsolo', mk_registry(),
+                   [('fg', interactive_qps, 8)], mix_duration)
+    flood_qps = flood_factor * batch_quota_rps
+    mixed = run_mix('nnmix', mk_registry(),
+                    [('fg', interactive_qps, 8),
+                     ('bg', flood_qps, 8)], mix_duration)
+    solo_fg = solo['tenants']['fg']
+    mix_fg = mixed['tenants']['fg']
+    mix_bg = mixed['tenants']['bg']
+    isolation = mix_fg['ok'] / float(max(1, solo_fg['ok']))
+    noisy = {'solo': solo, 'mixed': mixed,
+             'flood_qps': flood_qps,
+             'isolation_ratio': round(isolation, 4)}
+
+    # 2 — quota exhaustion: typed sheds, zero loss for admitted work
+    reg = TenantRegistry()
+    reg.add('acme', priority='standard', request_rate=quota_rps)
+    quota = run_mix('quota', reg, [('acme', quota_qps, 4)],
+                    quota_duration, n_engines=1)
+    acme = quota['tenants']['acme']
+    quota['quota_rps'] = quota_rps
+    quota['offered_qps'] = quota_qps
+
+    # 3 — priority inversion: batch saturates the KV pool, then
+    # interactive arrives; only batch may be preempted
+    def run_inversion():
+        from paddle_tpu.serving.decode import DecodeEngine, LMSpec
+        spec = LMSpec(vocab_size=256, n_layer=1, n_head=2, d_key=8,
+                      d_value=8, d_model=16, d_inner=32)
+        # 3 batch seqs want 3*ceil((8+inv_batch_new)/4) pages >> 24:
+        # exhaustion mid-decode is guaranteed while batch runs
+        engine = DecodeEngine(spec, max_batch=4, block_size=4,
+                              num_blocks=24, pages_per_seq=16,
+                              max_queue_depth=16)
+        engine.warmup()
+        engine.start()
+        before = observe.snapshot()
+        rng = np.random.RandomState(5)
+        batch_streams = [
+            engine.submit(rng.randint(0, 256, 8).tolist(),
+                          max_new_tokens=inv_batch_new, seed=i,
+                          tenant='bulk', priority='batch')
+            for i in range(3)]
+        time.sleep(0.25)       # let the batch class occupy the pool
+        inter_streams = [
+            engine.submit(rng.randint(0, 256, 8).tolist(),
+                          max_new_tokens=inv_inter_new, seed=10 + i,
+                          tenant='fg', priority='interactive')
+            for i in range(2)]
+        inter_lens = [len(s.result(timeout=300))
+                      for s in inter_streams]
+        batch_lens = [len(s.result(timeout=300))
+                      for s in batch_streams]
+        engine.shutdown(drain=True)
+        snap = observe.snapshot()
+        sel = lambda substr: (  # noqa: E731
+            counter_sel(snap, 'tenant.preempted', substr)
+            - counter_sel(before, 'tenant.preempted', substr))
+        return {
+            'scenario': 'inversion',
+            'preempted_batch': sel('priority=batch'),
+            'preempted_interactive': sel('priority=interactive'),
+            'interactive_tokens': inter_lens,
+            'batch_tokens': batch_lens,
+        }
+
+    inversion = run_inversion()
+
+    # 4 — co-location: SLO pressure yields the trainer, calm resumes
+    # it, params stay bit-identical to the uninterrupted run
+    def make_batches():
+        rng = np.random.RandomState(3)
+        w = rng.randn(4, 1).astype('float32')
+        r = np.random.RandomState(4)
+        out = []
+        for _ in range(train_batches):
+            xs = r.randn(8, 4).astype('float32')
+            out.append({'x': xs, 'y': xs @ w})
+        return out
+
+    def train_run(fluid, reader, hooks=None):
+        """One fresh linreg training run; ``hooks(trainer)`` runs
+        between construction and train() (the colo leg wires the
+        controller there). Returns the final persistables."""
+        from paddle_tpu import io as _io
+
+        def train_func():
+            x = fluid.layers.data(name='x', shape=[4],
+                                  dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1],
+                                  dtype='float32')
+            pred = fluid.layers.fc(input=x, size=1)
+            return [fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))]
+
+        trainer = fluid.Trainer(
+            train_func=train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(
+                learning_rate=0.1),
+            place=fluid.CPUPlace())
+        done = hooks(trainer) if hooks is not None else None
+        trainer.train(num_epochs=1, event_handler=lambda e: None,
+                      reader=reader)
+        arrays, _ = _io._snapshot_vars(trainer.program,
+                                       predicate=_io._is_persistable)
+        arrays = {k: np.array(v) for k, v in arrays.items()}
+        if done is not None:
+            done()
+        return arrays
+
+    def run_colocation():
+        batches = make_batches()
+        base = train_run(_fresh(), lambda: iter(batches))
+
+        gate_hit, gate_go = threading.Event(), threading.Event()
+
+        def gated_reader():
+            for i, b in enumerate(batches):
+                if i == train_split:
+                    gate_hit.set()
+                    gate_go.wait(timeout=120)
+                yield b
+
+        tracker = SloTracker([Objective(
+            'colo', latency_budget_s,
+            availability_target=0.5, window_s=window_s)])
+        engine = make_engine('colo0')
+        engine.warmup()
+        engine.start()
+        # admission='none': the tracker must SEE every breach (burn is
+        # the yield signal here) — SLO admission would shed the chaos
+        # burst before it ever recorded a violation
+        router = Router([engine], slo=tracker, route='colo',
+                        admission='none')
+        measured = {}
+
+        fluid = _fresh()
+
+        def hooks(trainer):
+            pf, cf = colocation_yield(
+                trainer, *slo_burn_pressure(tracker, 'colo'),
+                route='colo')
+            ctl = FleetController(router, make_engine, slo=tracker,
+                                  route='colo', min_replicas=1,
+                                  max_replicas=1, interval_s=tick_s,
+                                  pressure_fn=pf, calm_fn=cf)
+            ctl.start()
+
+            def chaos():
+                # trainer is mid-run, parked at the reader gate with
+                # the pipeline drained of steps [0, train_split)
+                gate_hit.wait(timeout=120)
+                # burn the budget: every request breaches the 2ms
+                # deadline by construction (8ms compute floor)
+                rng = np.random.RandomState(11)
+                for _ in range(20):
+                    feed = {'x': rng.rand(1, in_dim)
+                            .astype('float32')}
+                    router.submit(feed, session='fg/s0').result(
+                        timeout=30)
+                t_flip = time.perf_counter()
+                t_dead = t_flip + 5.0
+                while time.perf_counter() < t_dead:
+                    if observe.get_counter('tenant.trainer_yields_total',
+                                           route='colo'):
+                        measured['yield_latency_s'] = round(
+                            time.perf_counter() - t_flip, 4)
+                        break
+                    time.sleep(0.002)
+                gate_go.set()      # loop resumes, sees the request,
+                t_dead = time.perf_counter() + 10.0   # drains, parks
+                while time.perf_counter() < t_dead:
+                    if trainer.yielded():
+                        measured['parked'] = True
+                        break
+                    time.sleep(0.002)
+                # calm: no more traffic — the violation window slides
+                # out, burn drops, the controller resumes the trainer
+                # (train() returning IS the resume evidence)
+
+            th = threading.Thread(target=chaos, daemon=True)
+            th.start()
+
+            def done():
+                th.join(timeout=60)
+                measured['resumed'] = not trainer.yielded()
+                ctl.close(shutdown_replicas=False)
+            return done
+
+        colo_params = train_run(fluid, gated_reader, hooks=hooks)
+        engine.shutdown(drain=True)
+        router.close()
+        bit_identical = set(colo_params) == set(base) and all(
+            np.array_equal(colo_params[k], base[k]) for k in base)
+        return dict({
+            'scenario': 'colocation',
+            'train_steps': len(batches),
+            'tick_s': tick_s,
+            'bit_identical': bit_identical,
+            'parked': measured.get('parked', False),
+            'resumed': measured.get('resumed', False),
+            'yield_latency_s': measured.get('yield_latency_s'),
+        })
+
+    colo = run_colocation()
+
+    result = {
+        'workload': 'multitenant',
+        'noisy_neighbor': noisy,
+        'quota_exhaustion': quota,
+        'priority_inversion': inversion,
+        'colocation': colo,
+    }
+    # the acceptance contract (ISSUE 18), asserted HERE
+    assert isolation >= 0.9, \
+        'noisy neighbor broke isolation: %r' % noisy
+    assert mix_bg['quota_sheds'] > 0, \
+        'batch flood was never shed: %r' % mix_bg
+    assert acme['quota_sheds'] > 0 and acme['untyped_rejects'] == 0, \
+        'over-quota sheds not typed QuotaExceededError: %r' % acme
+    assert acme['lost'] == 0 and acme['errors'] == 0, \
+        'in-quota traffic lost work: %r' % acme
+    assert inversion['preempted_interactive'] == 0, \
+        'interactive sequences were preempted: %r' % inversion
+    assert inversion['preempted_batch'] > 0, \
+        'pool pressure never preempted the batch class: %r' % inversion
+    assert all(n == inv_inter_new
+               for n in inversion['interactive_tokens']), \
+        'interactive decode did not complete: %r' % inversion
+    assert colo['yield_latency_s'] is not None and \
+        colo['yield_latency_s'] <= tick_s + 0.2, \
+        'trainer did not yield within a controller tick: %r' % colo
+    assert colo['parked'] and colo['resumed'], \
+        'trainer never parked/resumed around pressure: %r' % colo
+    assert colo['bit_identical'], \
+        'co-located training diverged from the solo run: %r' % colo
+    return result
+
+
 def bench_disagg(duration=5.0, clients=10, n_prefill=1, n_decode=2,
                  vocab=4000, n_layer=4, n_head=4, d_model=128,
                  d_inner=256, max_batch=8, block_size=16,
@@ -2689,6 +3077,15 @@ def _run_workload_child(workload, backend, reduced):
         print('RESULT_JSON %s' % json.dumps(bench_crosshost(**kw)),
               flush=True)
         return
+    if workload == 'multitenant':
+        # inv_batch_new must overshoot the 24-page pool: 3 batch seqs
+        # * ceil((8+28)/4) = 27 pages (24 would fit exactly — no
+        # exhaustion, no preemption to measure)
+        kw = dict(mix_duration=1.5, quota_duration=1.5,
+                  inv_batch_new=28, train_batches=8) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_multitenant(**kw)),
+              flush=True)
+        return
     if workload == 'quant':
         kw = dict(steps=60, kv_duration=1.5, fleet_duration=3.0,
                   reduced=True) if reduced else {}
@@ -3246,7 +3643,7 @@ WORKLOAD_CHOICES = [
     'moe_cap1.0', 'moe_cap1.25', 'moe_cap2.0', 'pipeline_transformer',
     'pipeline_resnet50', 'decode_transformer', 'fleet', 'autoscale',
     'quant', 'disagg', 'linalg', 'autotune', 'autotune_child',
-    'verify', 'crosshost',
+    'verify', 'crosshost', 'multitenant',
 ]
 
 if __name__ == '__main__':

@@ -18,10 +18,15 @@ phase — prefill replicas (compute-bound, bucket-laddered) feeding
 decode replicas (HBM-bound, paged) through the zero-copy KV handoff
 in `serving.handoff`, with per-phase autoscaling policies
 (`ttft_pressure` / `page_pressure`) plugging into `FleetController`.
-See docs/serving.md; load-test with
+`serving.tenancy` makes the fleet multi-tenant: priority classes +
+token-bucket quotas charged at admission (`QuotaExceededError`),
+priority-aware decode preemption/eviction, and a co-location policy
+(`colocation_yield`) that pauses a background fine-tuning Trainer
+under SLO pressure. See docs/serving.md; load-test with
 tools/serving_bench.py, chaos-test the fleet with `bench.py
---workload fleet`, the autoscaler with `--workload autoscale`, and
-the disaggregated fleet with `--workload disagg`.
+--workload fleet`, the autoscaler with `--workload autoscale`, the
+disaggregated fleet with `--workload disagg`, and the multi-tenant
+policies with `--workload multitenant`.
 """
 
 from .buckets import BatchInfo, BucketLadder, pow2_ladder  # noqa: F401
@@ -35,6 +40,9 @@ from .router import (NoReplicaAvailableError, PhaseRouter,  # noqa: F401
                      Router, SLOShedError)
 from .rpc import (ProcessReplicaFactory, RemoteCallError,  # noqa: F401
                   RemoteReplica, RemoteReplicaError, serve_engine)
+from .tenancy import (PRIORITIES, QuotaExceededError,  # noqa: F401
+                      Tenant, TenantRegistry, colocation_yield,
+                      slo_burn_pressure, tenant_of_session)
 
 # The decode subpackage (continuous batching + paged KV cache) imports
 # lazily via `from paddle_tpu.serving import decode` /

@@ -203,14 +203,19 @@ class DecodeEngine(object):
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-               seed=0, eos_id=None, ctx=None, deadline_s=None):
+               seed=0, eos_id=None, ctx=None, deadline_s=None,
+               tenant=None, priority=None):
         """Enqueue one generation request; returns a GenerationStream.
         Raises QueueFullError past max_queue_depth, EngineClosedError
         after shutdown, ValueError for prompts the page budget can
         never hold. ``ctx`` carries an upstream trace context; when
         absent one is created here (route 'decode', sampling per
         PADDLE_TPU_TRACE_SAMPLE) — sampled requests record queue-wait/
-        prefill spans plus a per-token event timeline."""
+        prefill spans plus a per-token event timeline. ``tenant`` /
+        ``priority`` (serving.tenancy) make the request a scheduling
+        citizen of its class: admission order, preemption victim
+        choice, and prefix-cache eviction all key off it; None means
+        'standard' (today's behavior exactly)."""
         t_sub0 = time.perf_counter()
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         max_new = int(max_new_tokens)
@@ -250,7 +255,8 @@ class DecodeEngine(object):
                 ctx = _reqtrace.new_context('decode',
                                             deadline_s=deadline_s)
             seq = Sequence(next(self._ids), prompt, max_new, temperature,
-                           seed, eos_id, ctx=ctx)
+                           seed, eos_id, ctx=ctx, tenant=tenant,
+                           priority=priority)
             with self._done_cv:
                 self._unfinished += 1
             self._sched.add(seq)
@@ -726,7 +732,9 @@ class DecodeEngine(object):
         full = seq.cache_len // self.block_size
         if full > seq.published_pages:
             self.prefix_cache.publish(seq.prefix(), seq.table,
-                                      seq.cache_len)
+                                      seq.cache_len,
+                                      tenant=seq.tenant,
+                                      priority=seq.priority)
             seq.published_pages = full
 
     def _decode_step(self):

@@ -44,6 +44,7 @@ import os
 import threading
 
 from ... import observe as _obs
+from ..tenancy import PRIORITIES, priority_rank
 
 __all__ = ['PrefixCache', 'prefix_cache_enabled']
 
@@ -61,16 +62,22 @@ def prefix_cache_enabled(default=None):
 class _Node(object):
     """One full page of the radix tree. ``key`` is the page's token
     tuple (edge label from the parent); the chain of keys from the
-    root IS the token prefix the page's K/V encodes."""
+    root IS the token prefix the page's K/V encodes. ``prio`` /
+    ``tenant`` record the best (lowest-rank) priority class that ever
+    published the page — the eviction order's first dimension."""
 
-    __slots__ = ('key', 'page_id', 'parent', 'children', 'last_used')
+    __slots__ = ('key', 'page_id', 'parent', 'children', 'last_used',
+                 'prio', 'tenant')
 
-    def __init__(self, key, page_id, parent, tick):
+    def __init__(self, key, page_id, parent, tick, prio=1,
+                 tenant='default'):
         self.key = key
         self.page_id = page_id
         self.parent = parent
         self.children = {}
         self.last_used = tick
+        self.prio = prio
+        self.tenant = tenant
 
 
 class PrefixCache(object):
@@ -183,14 +190,19 @@ class PrefixCache(object):
             self.pool.free(ids)
 
     # ----------------------------------------------------------- publish
-    def publish(self, tokens, table, upto_tokens):
+    def publish(self, tokens, table, upto_tokens, tenant=None,
+                priority=None):
         """Publish every FULL page of ``table`` below ``upto_tokens``
         (the sequence's materialized KV length). For each full page
         whose chain is not yet cached, the trie gains a node and the
         cache takes one pool reference. Chains already cached under a
         *different* physical page are deduplicated: the walk descends
         the existing node and the sequence's twin page stays private.
+        ``tenant``/``priority`` stamp the page for the priority-aware
+        eviction order; a page shared across classes keeps the most
+        protected (lowest-rank) class it was ever published under.
         Returns the number of newly published pages."""
+        rank = priority_rank(priority)
         bs = self.block_size
         n_full = min(int(upto_tokens) // bs, len(table.block_ids))
         added = 0
@@ -203,10 +215,16 @@ class PrefixCache(object):
                 if child is None:
                     page = table.block_ids[p]
                     self.pool.incref([page])
-                    child = _Node(key, page, node, tick)
+                    child = _Node(key, page, node, tick, prio=rank,
+                                  tenant=tenant or 'default')
                     node.children[key] = child
                     self._pages += 1
                     added += 1
+                elif rank < child.prio:
+                    # a more latency-sensitive class now depends on
+                    # this page: promote it (and its billing label)
+                    child.prio = rank
+                    child.tenant = tenant or 'default'
                 child.last_used = tick
                 node = child
             self._publish_gauges()
@@ -217,8 +235,10 @@ class PrefixCache(object):
     # ----------------------------------------------------------- evict
     def _evictable_leaves(self):
         """Leaf nodes whose page the cache solely owns (refcount 1),
-        oldest-touched first. Interior nodes become leaves as their
-        children evict, so repeated calls drain whole chains."""
+        lowest priority class first (batch pages go before interactive
+        ones at equal recency), oldest-touched within the class.
+        Interior nodes become leaves as their children evict, so
+        repeated calls drain whole chains."""
         out = []
         stack = [self._root]
         while stack:
@@ -228,7 +248,7 @@ class PrefixCache(object):
                     self.pool.refcount(node.page_id) == 1:
                 out.append(node)
             stack.extend(kids)
-        out.sort(key=lambda n: n.last_used)
+        out.sort(key=lambda n: (-n.prio, n.last_used))
         return out
 
     def _drop(self, node):
@@ -243,12 +263,15 @@ class PrefixCache(object):
         pool's reclaimer, so every alloc under pressure lands here
         before the scheduler resorts to preemption."""
         freed = 0
+        evicted = {}                 # (tenant, priority rank) -> pages
         with self._mu:
             while freed < n:
                 leaves = self._evictable_leaves()
                 if not leaves:
                     break
                 for node in leaves:
+                    k = (node.tenant, node.prio)
+                    evicted[k] = evicted.get(k, 0) + 1
                     self._drop(node)
                     freed += 1
                     if freed >= n:
@@ -256,6 +279,9 @@ class PrefixCache(object):
             self._publish_gauges()
         if freed and _obs.enabled():
             _obs.inc('decode.prefix_evictions_total', freed)
+            for (tenant, rank), pages in evicted.items():
+                _obs.inc('tenant.evicted_pages', pages, tenant=tenant,
+                         priority=PRIORITIES[rank])
             _obs.flight_event('prefix_cache_evict', pages=freed,
                               cached_pages=self._pages)
         return freed

@@ -13,14 +13,18 @@ rest of the batch) and leave it the moment they finish, immediately
 freeing their pages for the admission of the next waiting request.
 
 Pool exhaustion (a sequence crossing into a page the pool cannot
-supply) preempts the *youngest* running sequence — the one that loses
-the least progress — releases its pages, and requeues it at the front
-of the waiting line with ``prompt + generated-so-far`` as its new
-prefill prefix (recompute-style preemption: already-streamed tokens
-are never re-streamed; the re-prefill rebuilds their KV and decoding
-continues from where it stopped). The scheduler is driven by the
-engine's single worker thread; only the waiting queue is touched from
-submit() threads (under the engine lock).
+supply) preempts the *lowest-priority-class, youngest* running
+sequence (serving.tenancy classes; all-equal priorities reduce to
+plain youngest — the one that loses the least progress), releases its
+pages, and requeues it at the front of the waiting line with
+``prompt + generated-so-far`` as its new prefill prefix
+(recompute-style preemption: already-streamed tokens are never
+re-streamed; the re-prefill rebuilds their KV and decoding continues
+from where it stopped). Admission is highest-class-first (FIFO within
+a class), so ``batch`` traffic backfills only the slots no
+latency-class request wants. The scheduler is driven by the engine's
+single worker thread; only the waiting queue is touched from submit()
+threads (under the engine lock).
 
 Decode-position bookkeeping: ``cache_len`` counts KV entries
 materialized on device. After prefilling a prefix of length p the
@@ -37,6 +41,7 @@ import time
 from concurrent.futures import Future
 
 from ... import observe as _obs
+from ..tenancy import priority_rank
 from .kv_pool import BlockTable
 
 __all__ = ['Sequence', 'GenerationStream', 'Scheduler',
@@ -100,10 +105,11 @@ class Sequence(object):
                  'seed', 'eos_id', 'table', 'generated', 'streamed',
                  'state', 'stream', 'cache_len', 'pending_token',
                  't_submit', 't_admit', 't_first_token', 't_last_token',
-                 'preemptions', 'cached_len', 'published_pages', 'ctx')
+                 'preemptions', 'cached_len', 'published_pages', 'ctx',
+                 'tenant', 'priority', 'prio_rank')
 
     def __init__(self, request_id, prompt, max_new_tokens, temperature,
-                 seed, eos_id, ctx=None):
+                 seed, eos_id, ctx=None, tenant=None, priority=None):
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -125,6 +131,12 @@ class Sequence(object):
         self.cached_len = 0        # prefix-cache hit span (this prefill)
         self.published_pages = 0   # full pages already offered to cache
         self.ctx = ctx      # reqtrace.RequestContext (trace correlation)
+        # multi-tenant scheduling citizenship (serving.tenancy): None
+        # lands on 'standard', so untenanted traffic schedules exactly
+        # as before
+        self.tenant = tenant
+        self.priority = priority
+        self.prio_rank = priority_rank(priority)
 
     def prefix(self):
         """Tokens whose KV must exist before the next decode step —
@@ -191,7 +203,19 @@ class Scheduler(object):
         with self._mu:
             if len(self.running) >= self.max_batch or not self.waiting:
                 return None
-            seq = self.waiting[0]
+            # priority admission: highest class first, FIFO within the
+            # class — so the batch class only backfills slots no
+            # latency-class request is waiting for (all-equal
+            # priorities reduce to plain FIFO, including preempted
+            # sequences requeued at the front)
+            idx, best = 0, self.waiting[0].prio_rank
+            if best > 0:
+                for i, s in enumerate(self.waiting):
+                    if s.prio_rank < best:
+                        idx, best = i, s.prio_rank
+                        if best == 0:
+                            break
+            seq = self.waiting[idx]
             prefix = seq.prefix()
             if self.cache is not None and not seq.table.block_ids:
                 seq.cached_len = self.cache.match(prefix, seq.table)
@@ -206,7 +230,7 @@ class Scheduler(object):
                     seq.published_pages = 0
                 _obs.inc('decode.admission_blocked_total')
                 return None
-            self.waiting.popleft()
+            del self.waiting[idx]
             seq.state = RUNNING
             seq.t_admit = time.perf_counter()
             self.running.append(seq)
@@ -243,8 +267,16 @@ class Scheduler(object):
         return True
 
     def _pick_victim(self):
-        # youngest running sequence loses the least progress; ties to
-        # the highest slot keep older requests' latency stable
+        # lowest priority CLASS first (batch before standard before
+        # interactive), youngest within the class — the youngest loses
+        # the least progress, and the preemption mechanics (release +
+        # front-requeue + bit-exact re-prefill) are identical for every
+        # class. All-equal priorities reduce to the old youngest-victim
+        # rule exactly.
+        worst = max(seq.prio_rank for seq in self.running)
+        for seq in reversed(self.running):
+            if seq.prio_rank == worst:
+                return seq
         return self.running[-1]
 
     def preempt(self, seq):
@@ -264,6 +296,8 @@ class Scheduler(object):
         seq.published_pages = 0
         seq.preemptions += 1
         _obs.inc('decode.preemptions_total')
+        _obs.inc('tenant.preempted', tenant=seq.tenant or 'default',
+                 priority=seq.priority or 'standard')
         _obs.flight_event('decode_preempt', request_id=seq.request_id,
                           generated=len(seq.generated),
                           freed_blocks=self.pool.free_blocks())

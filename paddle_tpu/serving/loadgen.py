@@ -25,7 +25,8 @@ it timestamps every completion/reject/error relative to its creation,
 so shed windows and kill windows are plottable after the fact;
 ``percentiles`` renders it. ``diurnal`` / ``flash_crowd`` /
 ``heavy_tailed_rows`` are the scenario shapes the chaos harness
-composes.
+composes; ``tenant_mix`` labels those draws with weighted tenants and
+tenant-prefixed session ids for the multi-tenant scenarios.
 """
 
 import math
@@ -36,7 +37,7 @@ import numpy as np
 
 __all__ = ['Stats', 'percentiles', 'closed_loop', 'open_loop',
            'qps_at', 'diurnal', 'flash_crowd', 'heavy_tailed_rows',
-           'phase_mix']
+           'phase_mix', 'tenant_mix']
 
 
 class Stats(object):
@@ -164,6 +165,33 @@ def phase_mix(rng, long_prompt_frac=0.3, short_prompt=(4, 16),
                 int(rng.randint(short_new[0], short_new[1] + 1)))
     return (int(rng.randint(short_prompt[0], short_prompt[1] + 1)),
             int(rng.randint(long_new[0], long_new[1] + 1)))
+
+
+def tenant_mix(rng, tenants, sessions_per_tenant=4, rows=(4, 64),
+               phases=False):
+    """One draw of a multi-tenant traffic mix: pick a tenant by
+    weight, mint a tenant-prefixed session id (``"acme/s3"`` — the
+    tenancy module's ``tenant_of_session`` convention, so the router
+    charges the right quota bucket AND the rendezvous pin stays
+    per-session), and draw the request shape.
+
+    ``tenants`` is ``[(name, weight), ...]``. With ``phases=False``
+    returns ``(tenant, session, rows)`` where ``rows`` is a
+    ``heavy_tailed_rows`` draw over the ``rows=(lo, hi)`` range (the
+    micro-batch benches' request size); with ``phases=True`` returns
+    ``(tenant, session, prompt_len, max_new_tokens)`` from a
+    ``phase_mix`` draw (the decode benches' shape). Reused by
+    ``bench.py --workload multitenant`` and tools/serving_bench.py
+    ``--tenant-mix``."""
+    names = [t[0] for t in tenants]
+    weights = np.asarray([float(t[1]) for t in tenants])
+    weights = weights / weights.sum()
+    name = names[int(rng.choice(len(names), p=weights))]
+    session = '%s/s%d' % (name, int(rng.randint(sessions_per_tenant)))
+    if phases:
+        prompt_len, max_new = phase_mix(rng)
+        return name, session, prompt_len, max_new
+    return name, session, heavy_tailed_rows(rng, rows[0], rows[1])
 
 
 # ---------------------------------------------------------- the loops

@@ -212,7 +212,8 @@ class Router(object):
                  session_affinity=True, retries=2, admission=None,
                  on_breach='shed', hedge=False, hedge_quantile=0.95,
                  hedge_delay_s=None, hedge_min_delay_s=0.002,
-                 retry_budget=0.1, retry_budget_burst=20.0):
+                 retry_budget=0.1, retry_budget_burst=20.0,
+                 tenants=None):
         reps = list(replicas)
         if not reps:
             raise ValueError('Router needs at least one replica')
@@ -241,6 +242,10 @@ class Router(object):
         self.hedge_quantile = float(hedge_quantile)
         self.hedge_delay_s = hedge_delay_s
         self.hedge_min_delay_s = float(hedge_min_delay_s)
+        # optional multi-tenant policy (serving.tenancy.TenantRegistry):
+        # admission charges the session's tenant bucket before any
+        # dispatch; None keeps the single-tenant behavior exactly
+        self._tenants = tenants
         self._budget = _RetryBudget(retry_budget, retry_budget_burst)
         self._mu = threading.Lock()
         self._rr = itertools.count()    # tiebreak for equal depths
@@ -413,6 +418,11 @@ class Router(object):
                                         deadline_s=deadline_s)
         _obs.inc('router.requests_total', route=self.route)
         self._admission_check(ctx)
+        if self._tenants is not None:
+            # quota charge keyed off the same session id the rendezvous
+            # pin uses; QuotaExceededError propagates synchronously and
+            # the request never reaches the retry-budget deposit below
+            self._tenants.admit(session, route=self.route)
         state = _InFlight(feed, session, ctx, Future(),
                           attempts_left=self.retries)
         # accepted traffic funds the retry budget (shed requests never
@@ -794,9 +804,15 @@ class PhaseRouter(object):
     def __init__(self, prefill_replicas, decode_replicas, slo=None,
                  route='disagg', session_affinity=True, retries=2,
                  colocated=False, handoff_workers=None,
-                 max_inflight=None, via_bytes=True, lat_window=64):
+                 max_inflight=None, via_bytes=True, lat_window=64,
+                 tenants=None):
         self.route = str(route)
         self._slo = slo
+        # optional multi-tenant policy: admission charges requests AND
+        # decode tokens (max_new_tokens) to the session's tenant, and
+        # the resolved priority class rides the request into the decode
+        # scheduler/prefix cache
+        self._tenants = tenants
         self.session_affinity = bool(session_affinity)
         self.retries = int(retries)
         self.colocated = bool(colocated)
@@ -1006,6 +1022,15 @@ class PhaseRouter(object):
                      reason='deadline_expired', route=self.route)
             raise SLOShedError('phase router shed: deadline budget '
                                'already exhausted')
+        tenant = None
+        if self._tenants is not None:
+            # one request + max_new_tokens decode tokens, charged to
+            # the session's tenant before the pipeline slot is taken
+            # (QuotaExceededError propagates synchronously, same
+            # contract as the deadline shed above)
+            tenant = self._tenants.admit(session,
+                                         tokens=int(max_new_tokens),
+                                         route=self.route)
         with self._mu:
             if self._inflight >= self.max_inflight:
                 _obs.inc('router.phase_sheds_total',
@@ -1020,7 +1045,9 @@ class PhaseRouter(object):
         req = dict(prompt=[int(t) for t in prompt_ids],
                    max_new_tokens=int(max_new_tokens),
                    temperature=float(temperature), seed=int(seed),
-                   eos_id=eos_id, session=session, ctx=ctx)
+                   eos_id=eos_id, session=session, ctx=ctx,
+                   tenant=tenant.name if tenant else None,
+                   priority=tenant.priority if tenant else None)
         try:
             self._pipeline.submit(self._run_pipeline, req, stream)
         except RuntimeError:
@@ -1144,7 +1171,9 @@ class PhaseRouter(object):
                                    max_new_tokens=req['max_new_tokens'],
                                    temperature=req['temperature'],
                                    seed=req['seed'],
-                                   eos_id=req['eos_id'], ctx=ctx)
+                                   eos_id=req['eos_id'], ctx=ctx,
+                                   tenant=req.get('tenant'),
+                                   priority=req.get('priority'))
             except QueueFullError as e:
                 last_exc = e
                 continue

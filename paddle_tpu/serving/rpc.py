@@ -149,9 +149,11 @@ def _error_classes():
     from .handoff import (HandoffError, KVDtypeMismatchError,
                           KVGeometryError)
     from .router import NoReplicaAvailableError, SLOShedError
+    from .tenancy import QuotaExceededError
     return {
         'QueueFullError': QueueFullError,
         'SLOShedError': SLOShedError,
+        'QuotaExceededError': QuotaExceededError,
         'EngineClosedError': EngineClosedError,
         'RemoteReplicaError': RemoteReplicaError,
         'NoReplicaAvailableError': NoReplicaAvailableError,
@@ -183,6 +185,7 @@ def _raise_remote(payload, status=None):
 
 
 _ERR_STATUS = {'QueueFullError': 429, 'SLOShedError': 429,
+               'QuotaExceededError': 429,
                'EngineClosedError': 503, 'ValueError': 400,
                'TypeError': 400, 'KeyError': 400,
                'HandoffError': 409, 'KVDtypeMismatchError': 409,
@@ -280,7 +283,9 @@ def serve_engine(engine, prefix='/rpc', on_shutdown=None):
             max_new_tokens=int(req.get('max_new_tokens', 16)),
             temperature=float(req.get('temperature', 0.0)),
             seed=int(req.get('seed', 0)),
-            eos_id=req.get('eos_id'))
+            eos_id=req.get('eos_id'),
+            tenant=req.get('tenant'),
+            priority=req.get('priority'))
         _ack_stream(h)
         try:
             for tok in stream:
@@ -568,12 +573,14 @@ class RemoteReplica(object):
         return self.submit(feed).result(timeout)
 
     def _generate(self, prompt, ctx=None, max_new_tokens=16,
-                  temperature=0.0, seed=0, eos_id=None):
+                  temperature=0.0, seed=0, eos_id=None, tenant=None,
+                  priority=None):
         body = json.dumps({
             'prompt': [int(t) for t in prompt],
             'max_new_tokens': int(max_new_tokens),
             'temperature': float(temperature), 'seed': int(seed),
-            'eos_id': eos_id}).encode()
+            'eos_id': eos_id, 'tenant': tenant,
+            'priority': priority}).encode()
         conn, resp = self._start_request('/generate', body,
                                          self.read_timeout_s,
                                          ctype='application/json')

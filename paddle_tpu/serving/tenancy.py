@@ -1,0 +1,299 @@
+"""Multi-tenant policy layer for the serving fleet.
+
+One fleet, many tenants: each tenant gets a **priority class**
+(``interactive`` > ``standard`` > ``batch``) and a **token-bucket
+quota** (requests/s and decode-tokens/s, refilled continuously on the
+router's clock). The policy threads through the whole stack:
+
+- **admission** — ``Router.submit`` / ``PhaseRouter.submit`` resolve
+  the tenant from the rendezvous session id they already compute
+  (tenant-prefixed: ``"acme/user-42"`` → tenant ``acme``) and charge
+  its buckets before any dispatch. Over-quota traffic sheds with a
+  typed :class:`QuotaExceededError` — a ``QueueFullError`` subclass,
+  so every existing reject/hedge/failover path (and the RPC error
+  envelope) handles it unchanged. A shed request never deposits into
+  the retry budget and never touches a replica.
+- **scheduling** — the decode scheduler preempts its
+  pool-exhaustion victim lowest-priority-class-first (youngest within
+  the class, keeping the bit-exact recompute continuation), admits
+  waiting sequences highest-class-first so the ``batch`` class only
+  backfills slots no latency-class request is waiting for, and the
+  prefix cache evicts batch-tenant pages before interactive ones at
+  equal recency.
+- **co-location** — :func:`colocation_yield` wraps a FleetController
+  ``(pressure_fn, calm_fn)`` pair so SLO pressure pauses a co-located
+  background fine-tuning ``Trainer`` (``trainer.request_yield()``
+  rides the pipelined-drain path — a yield is a sync point like a due
+  checkpoint, so params stay bit-identical to an uninterrupted run)
+  and calm resumes it; ``tenant_yield`` / ``tenant_resume`` flight
+  events mark the windows.
+
+Per-tenant admission, preemption, and eviction are all observable:
+``tenant.admitted`` / ``tenant.shed`` / ``tenant.preempted`` /
+``tenant.evicted_pages`` counters labeled by tenant and priority
+(``tools/metrics_report.py --tenants`` renders the isolation panel).
+
+Knobs (read per call, never at import — this file is in
+tools/repo_lint.py's ENV_SCOPED_FILES): lazily created tenants (an
+unknown prefix, or unprefixed sessions under the ``default`` tenant)
+take ``PADDLE_TPU_TENANT_DEFAULT_PRIORITY`` (standard),
+``PADDLE_TPU_TENANT_DEFAULT_RPS`` / ``PADDLE_TPU_TENANT_DEFAULT_TPS``
+(unlimited when unset), and ``PADDLE_TPU_TENANT_BURST_S`` (bucket
+burst = rate x burst seconds, default 1.0).
+"""
+
+import os
+import threading
+import time
+
+from .. import observe as _obs
+from .engine import QueueFullError
+
+__all__ = ['PRIORITIES', 'PRIORITY_RANK', 'QuotaExceededError',
+           'Tenant', 'TenantRegistry', 'TokenBucket',
+           'tenant_of_session', 'priority_rank', 'colocation_yield',
+           'slo_burn_pressure']
+
+# Highest class first; the rank (index) is the scheduling key — lower
+# rank preempts later, evicts later, admits earlier.
+PRIORITIES = ('interactive', 'standard', 'batch')
+PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+DEFAULT_TENANT = 'default'
+
+
+class QuotaExceededError(QueueFullError):
+    """A tenant's token bucket ran dry: admission shed the request
+    before any dispatch. A QueueFullError subclass, so callers'
+    existing reject/backoff handling — and the RPC typed-error
+    envelope — apply unchanged."""
+
+
+def priority_rank(priority):
+    """Scheduling rank for a priority-class name; None and unknown
+    names land on 'standard' so untenanted traffic keeps today's
+    behavior exactly."""
+    return PRIORITY_RANK.get(priority, PRIORITY_RANK['standard'])
+
+
+def tenant_of_session(session):
+    """Tenant name from a (possibly tenant-prefixed) session id:
+    ``'acme/user-42'`` → ``'acme'``; ``None`` or an unprefixed id →
+    ``'default'``. The full session id still feeds the rendezvous
+    hash, so two tenants' sessions pin independently — the prefix is
+    an accounting key, not a placement override."""
+    if session is None:
+        return DEFAULT_TENANT
+    s = str(session)
+    head, sep, _rest = s.partition('/')
+    return head if sep and head else DEFAULT_TENANT
+
+
+def _env_float(name):
+    raw = os.environ.get(name, '')
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
+class TokenBucket(object):
+    """Continuous-refill token bucket: ``rate`` tokens/s up to
+    ``burst``. ``try_charge`` refills from the elapsed clock then
+    spends atomically; ``refund`` returns a charge whose sibling
+    bucket rejected the same request. The clock is the caller's
+    (``now=``) so the router's admission clock — or a test's synthetic
+    one — drives refill deterministically."""
+
+    __slots__ = ('rate', 'burst', 'tokens', '_last', '_mu')
+
+    def __init__(self, rate, burst=None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None \
+            else max(self.rate, 1.0)
+        self.tokens = self.burst
+        self._last = None
+        self._mu = threading.Lock()
+
+    def try_charge(self, n=1.0, now=None):
+        now = time.monotonic() if now is None else float(now)
+        with self._mu:
+            if self._last is not None and now > self._last:
+                self.tokens = min(self.burst, self.tokens
+                                  + (now - self._last) * self.rate)
+            self._last = now if self._last is None \
+                else max(self._last, now)
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+    def refund(self, n=1.0):
+        with self._mu:
+            self.tokens = min(self.burst, self.tokens + n)
+
+
+class Tenant(object):
+    """One tenant: a priority class plus optional request-rate and
+    decode-token-rate buckets (None = unlimited on that dimension)."""
+
+    __slots__ = ('name', 'priority', 'rank', 'requests', 'tokens')
+
+    def __init__(self, name, priority='standard', request_rate=None,
+                 token_rate=None, burst_s=1.0):
+        if priority not in PRIORITY_RANK:
+            raise ValueError('priority must be one of %s, got %r'
+                             % (PRIORITIES, priority))
+        self.name = str(name)
+        self.priority = priority
+        self.rank = PRIORITY_RANK[priority]
+        burst_s = float(burst_s)
+        self.requests = None if request_rate is None else TokenBucket(
+            request_rate, max(1.0, float(request_rate) * burst_s))
+        self.tokens = None if token_rate is None else TokenBucket(
+            token_rate, max(1.0, float(token_rate) * burst_s))
+
+
+class TenantRegistry(object):
+    """Tenant definitions + the admission charge. Unknown tenants
+    (including the implicit ``default`` for unprefixed sessions) are
+    created lazily from the ``PADDLE_TPU_TENANT_*`` knobs at first
+    sight, so a registry-equipped router never rejects traffic for
+    merely lacking a row — only for exceeding one."""
+
+    def __init__(self):
+        self._tenants = {}
+        self._mu = threading.Lock()
+
+    def add(self, name, priority='standard', request_rate=None,
+            token_rate=None, burst_s=None):
+        if burst_s is None:
+            burst_s = _env_float('PADDLE_TPU_TENANT_BURST_S') or 1.0
+        t = Tenant(name, priority=priority, request_rate=request_rate,
+                   token_rate=token_rate, burst_s=burst_s)
+        with self._mu:
+            self._tenants[t.name] = t
+        return t
+
+    def get(self, name):
+        with self._mu:
+            return self._tenants.get(name)
+
+    def names(self):
+        with self._mu:
+            return sorted(self._tenants)
+
+    def resolve(self, session):
+        """The Tenant accountable for ``session`` (see
+        :func:`tenant_of_session`), lazily created from the
+        ``PADDLE_TPU_TENANT_DEFAULT_*`` knobs when undeclared."""
+        name = tenant_of_session(session)
+        t = self.get(name)
+        if t is None:
+            prio = os.environ.get('PADDLE_TPU_TENANT_DEFAULT_PRIORITY',
+                                  '') or 'standard'
+            if prio not in PRIORITY_RANK:
+                prio = 'standard'
+            t = self.add(name, priority=prio,
+                         request_rate=_env_float(
+                             'PADDLE_TPU_TENANT_DEFAULT_RPS'),
+                         token_rate=_env_float(
+                             'PADDLE_TPU_TENANT_DEFAULT_TPS'))
+        return t
+
+    def admit(self, session, tokens=0, now=None, route='serve'):
+        """Charge one request (plus ``tokens`` decode tokens) to the
+        session's tenant; returns the Tenant on admission, raises
+        :class:`QuotaExceededError` on an empty bucket. A request
+        rejected by the token bucket refunds its request charge, so an
+        oversized request does not also burn request quota."""
+        t = self.resolve(session)
+        reason = None
+        if t.requests is not None and \
+                not t.requests.try_charge(1.0, now=now):
+            reason = 'requests'
+        elif tokens and t.tokens is not None and \
+                not t.tokens.try_charge(float(tokens), now=now):
+            if t.requests is not None:
+                t.requests.refund(1.0)
+            reason = 'tokens'
+        if reason is not None:
+            _obs.inc('tenant.shed', tenant=t.name, priority=t.priority,
+                     reason=reason, route=route)
+            _obs.flight_event('tenant_quota_shed', tenant=t.name,
+                              priority=t.priority, reason=reason,
+                              route=route)
+            raise QuotaExceededError(
+                'tenant %r (%s) over %s quota on route %r'
+                % (t.name, t.priority, reason, route))
+        _obs.inc('tenant.admitted', tenant=t.name, priority=t.priority,
+                 route=route)
+        return t
+
+
+# ---------------------------------------------------- co-location yield
+def slo_burn_pressure(tracker, route, burn_high=1.0, burn_low=0.5):
+    """A standalone ``(pressure_fn, calm_fn)`` pair over an SloTracker
+    burn rate — the serving-side signal the co-location yield watches
+    (the FleetController's built-in burn logic, extracted so it can be
+    wrapped by :func:`colocation_yield` and driven with a synthetic
+    ``now`` in tests)."""
+    def pressure_fn(now):
+        burn = tracker.burn_rate(route, now=now)
+        signals = {'burn_rate': burn, 'mean_queue_depth': 0.0}
+        if burn is not None and burn > burn_high:
+            return True, 'burn_rate', signals
+        return False, None, signals
+
+    def calm_fn(signals):
+        burn = signals.get('burn_rate')
+        return burn is None or burn < burn_low
+
+    return pressure_fn, calm_fn
+
+
+def colocation_yield(trainer, pressure_fn, calm_fn=None,
+                     route='serve'):
+    """Wrap a FleetController policy pair so SLO pressure pauses a
+    co-located background ``Trainer`` and calm resumes it.
+
+    ::
+
+        pf, cf = colocation_yield(
+            trainer, *slo_burn_pressure(tracker, 'serve'))
+        ctl = FleetController(router, factory,
+                              min_replicas=n, max_replicas=n,
+                              pressure_fn=pf, calm_fn=cf)
+
+    The wrapped ``pressure_fn`` runs inside every controller tick, so
+    the trainer yields within one tick of pressure: on the rising edge
+    it calls ``trainer.request_yield()`` (the training loop drains its
+    in-flight pipeline — the checkpoint sync point — then parks before
+    the next dispatch, leaving params exactly where an uninterrupted
+    run would put them at that step count) and records a
+    ``tenant_yield`` flight event; once the inner policy reports calm
+    it calls ``trainer.resume_from_yield()`` and records
+    ``tenant_resume``. The inner verdict passes through untouched, so
+    the same pair can still scale a fleet that has headroom."""
+    state = {'yielded': False}
+
+    def wrapped_pressure(now):
+        pressured, reason, signals = pressure_fn(now)
+        if pressured and not state['yielded']:
+            state['yielded'] = True
+            trainer.request_yield()
+            _obs.inc('tenant.trainer_yields_total', route=route)
+            _obs.set_gauge('tenant.trainer_yielded', 1, route=route)
+            _obs.flight_event('tenant_yield', route=route,
+                              reason=reason or 'pressure')
+        elif not pressured and state['yielded']:
+            if calm_fn is None or calm_fn(signals):
+                state['yielded'] = False
+                trainer.resume_from_yield()
+                _obs.set_gauge('tenant.trainer_yielded', 0, route=route)
+                _obs.flight_event('tenant_resume', route=route)
+        return pressured, reason, signals
+
+    def wrapped_calm(signals):
+        return True if calm_fn is None else calm_fn(signals)
+
+    return wrapped_pressure, wrapped_calm

@@ -148,6 +148,18 @@ class Trainer(object):
         self._pulled = 0
         self._trained = 0
         self._pending = 0
+        # ---------------------------------------- co-location yield
+        # (serving.tenancy.colocation_yield): request_yield() asks the
+        # loop to pause at the next dispatch boundary; the loop drains
+        # its in-flight pipeline first — the checkpoint sync point —
+        # then parks until resume_from_yield(). Pausing between
+        # dispatches never changes the dispatched computation, so the
+        # final params are bit-identical to an uninterrupted run at
+        # the same step count.
+        self._yield_requested = False
+        self._yield_gate = threading.Event()
+        self._yield_gate.set()
+        self._parked = False
 
     def _to_feed(self, data, feeder, feed_order):
         if feeder is not None:
@@ -293,6 +305,8 @@ class Trainer(object):
             if host_prefetch and int(host_prefetch) > 0:
                 units = self._prefetch_units(units, int(host_prefetch))
             for feed, n_steps, n_items in units:
+                if self._yield_requested:
+                    self._yield_point()
                 self._dispatch(epoch, step, feed, n_steps, n_items)
                 step += n_steps
                 if len(self._inflight) >= depth:
@@ -458,6 +472,50 @@ class Trainer(object):
                 yield item
         finally:
             closed.set()
+
+    # ------------------------------------------------ co-location yield
+    def request_yield(self):
+        """Ask the training loop to pause at its next dispatch
+        boundary (serving.tenancy.colocation_yield calls this when the
+        co-located serving replica hits SLO pressure). Returns
+        immediately; the loop drains its in-flight pipeline — the same
+        sync point a due checkpoint uses — then parks with the device
+        idle until :meth:`resume_from_yield`. A yield never changes
+        what gets dispatched, so params stay bit-identical to an
+        uninterrupted run at the same step count."""
+        self._yield_gate.clear()
+        self._yield_requested = True
+
+    def resume_from_yield(self):
+        """Release a :meth:`request_yield` park (idempotent)."""
+        self._yield_requested = False
+        self._yield_gate.set()
+
+    def yielded(self):
+        """True while the training loop is actually parked (drained
+        and blocked) — the co-location scenario's observable."""
+        return self._parked
+
+    def _yield_point(self):
+        # drain: every dispatched step resolves before the pause, so
+        # a resume (or a checkpoint during the pause window) sees a
+        # consistent param state
+        while self._inflight:
+            self._resolve_oldest()
+        self._parked = True
+        t0 = time.perf_counter()
+        _obs.set_gauge('trainer.yielded', 1)
+        self._yield_gate.wait()
+        self._parked = False
+        if self._idle_since is not None:
+            # the parked window is the tenant's time, not host-blocked
+            # wall — restart the idle clock so the overlap fraction
+            # only bills real feed-preparation gaps
+            self._idle_since = time.perf_counter()
+        _obs.set_gauge('trainer.yielded', 0)
+        if _obs.enabled():
+            _obs.record('trainer.yield_seconds',
+                        time.perf_counter() - t0)
 
     # ------------------------------------------------- dispatch/resolve
     def _dispatch(self, epoch, step0, feed, n_steps, n_items):

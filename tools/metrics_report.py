@@ -15,7 +15,10 @@ end-of-run state); ``--all-pids`` reports the newest summary per pid,
 line of any kind. ``--json`` emits one machine-readable object for
 scripting, ``--slo`` renders the SLO panel (per-route objectives,
 error-budget burn rate, goodput, and the top-5 slowest sampled trace
-ids — each one a ``/tracez?trace_id=`` timeline), and ``--prom``
+ids — each one a ``/tracez?trace_id=`` timeline), ``--tenants``
+renders the multi-tenant isolation panel (per-tenant
+admitted/shed/preempted/evicted-pages from the ``tenant.*`` counters,
+plus the co-located trainer's yield ledger), and ``--prom``
 converts the chosen record to Prometheus text exposition (drop it in a node_exporter textfile-collector dir and
 offline runs feed the same dashboards as live ``/metrics`` scrapes) —
 fast tests exercise all three paths so this tool cannot bit-rot.
@@ -198,6 +201,99 @@ def render_slo(rec):
                              '(/tracez?trace_id=%s)'
                              % (s['seconds'], s['trace_id'],
                                 s['trace_id']))
+    return '\n'.join(lines)
+
+
+# --------------------------------------------------------- tenant view
+# render order for the isolation panel: most protected class first
+_TENANT_PRIORITIES = ('interactive', 'standard', 'batch')
+
+
+def derive_tenants(rec):
+    """Multi-tenant isolation panel from one record's tenant.*
+    metrics: per-tenant admitted/shed (with the shed-reason split:
+    'requests' vs 'tokens' bucket), decode preemptions, prefix-cache
+    pages evicted, and the co-located trainer's yield ledger
+    (tenant.trainer_yields_total / tenant.trainer_yielded /
+    trainer.yield_seconds)."""
+    parse = _registry_mod().parse_rendered
+    tenants = {}
+
+    def ent(labels):
+        e = tenants.setdefault(labels.get('tenant', '?'), {
+            'priority': None, 'admitted': 0, 'shed': 0,
+            'shed_reasons': {}, 'preempted': 0, 'evicted_pages': 0})
+        if labels.get('priority'):
+            e['priority'] = labels['priority']
+        return e
+
+    trainer = {}
+    for rendered, v in rec.get('counters', {}).items():
+        name, labels = parse(rendered)
+        if name == 'tenant.admitted':
+            ent(labels)['admitted'] += v
+        elif name == 'tenant.shed':
+            e = ent(labels)
+            e['shed'] += v
+            reason = labels.get('reason', '?')
+            e['shed_reasons'][reason] = \
+                e['shed_reasons'].get(reason, 0) + v
+        elif name == 'tenant.preempted':
+            ent(labels)['preempted'] += v
+        elif name == 'tenant.evicted_pages':
+            ent(labels)['evicted_pages'] += v
+        elif name == 'tenant.trainer_yields_total':
+            trainer['yields'] = trainer.get('yields', 0) + v
+    for rendered, v in rec.get('gauges', {}).items():
+        name, _labels = parse(rendered)
+        if name == 'tenant.trainer_yielded':
+            trainer['yielded'] = v
+    for rendered, stats in rec.get('histograms', {}).items():
+        name, _labels = parse(rendered)
+        if name == 'trainer.yield_seconds':
+            trainer['yield_seconds'] = {
+                k: stats.get(k) for k in ('count', 'mean', 'max')}
+    return {'ts': rec.get('ts'), 'pid': rec.get('pid'),
+            'host': rec.get('host', 0), 'tenants': tenants,
+            'trainer': trainer}
+
+
+def render_tenants(rec):
+    doc = derive_tenants(rec)
+    if not doc['tenants'] and not doc['trainer']:
+        return 'no tenant.* metrics in this record'
+    lines = ['== per-tenant admission / scheduling '
+             '(most protected class first)']
+    lines.append('%-16s %-12s %10s %10s %10s %12s'
+                 % ('Tenant', 'Priority', 'Admitted', 'Shed',
+                    'Preempted', 'EvictedPgs'))
+
+    def order(item):
+        name, e = item
+        prio = e['priority']
+        rank = _TENANT_PRIORITIES.index(prio) \
+            if prio in _TENANT_PRIORITIES else 1
+        return (rank, name)
+
+    for name, e in sorted(doc['tenants'].items(), key=order):
+        lines.append('%-16s %-12s %10d %10d %10d %12d'
+                     % (name, e['priority'] or '?', e['admitted'],
+                        e['shed'], e['preempted'],
+                        e['evicted_pages']))
+        if e['shed_reasons']:
+            lines.append('     shed by: %s' % '  '.join(
+                '%s=%d' % (k, v) for k, v in
+                sorted(e['shed_reasons'].items())))
+    t = doc['trainer']
+    if t:
+        lines.append('== co-located trainer')
+        ys = t.get('yield_seconds') or {}
+        lines.append('   yields %s   currently yielded %s   '
+                     'parked mean %s s max %s s'
+                     % (t.get('yields', 0),
+                        int(t['yielded']) if 'yielded' in t else '?',
+                        _fmt_val(ys.get('mean')),
+                        _fmt_val(ys.get('max'))))
     return '\n'.join(lines)
 
 
@@ -540,14 +636,19 @@ def main(argv=None):
                         'census and scale/heal/quarantine events over '
                         'the JSONL\'s snapshots, final per-replica '
                         'states, and hedge rate vs retry budget')
+    p.add_argument('--tenants', action='store_true',
+                   help='render the multi-tenant isolation panel: '
+                        'per-tenant admitted/shed/preempted/evicted '
+                        'pages by priority class, and the co-located '
+                        'trainer yield ledger')
     args = p.parse_args(argv)
     if args.json and args.prom:
         sys.stderr.write('metrics_report: --json and --prom are '
                          'mutually exclusive\n')
         return 2
-    if (args.slo or args.fleet) and args.prom:
-        sys.stderr.write('metrics_report: --slo/--fleet and --prom are '
-                         'mutually exclusive\n')
+    if (args.slo or args.fleet or args.tenants) and args.prom:
+        sys.stderr.write('metrics_report: --slo/--fleet/--tenants and '
+                         '--prom are mutually exclusive\n')
         return 2
 
     records = load_records(args.path)
@@ -580,6 +681,12 @@ def main(argv=None):
                 print(json.dumps(docs[0] if len(docs) == 1 else docs))
             else:
                 print('\n\n'.join(render_slo(r) for r in chosen))
+        elif args.tenants:
+            if args.json:
+                docs = [derive_tenants(r) for r in chosen]
+                print(json.dumps(docs[0] if len(docs) == 1 else docs))
+            else:
+                print('\n\n'.join(render_tenants(r) for r in chosen))
         elif args.json:
             docs = [derive(r) for r in chosen]
             print(json.dumps(docs[0] if len(docs) == 1 else docs))

@@ -117,6 +117,10 @@ QUEUE = [
     # death, crash-loop quarantine, subprocess-vs-in-process bit
     # identity; rpc.*/worker.* metrics land in the shared JSONL
     ('crosshost', 'crosshost', None, 900),
+    # multi-tenant policies: noisy-neighbor isolation, typed quota
+    # sheds, priority preemption ordering, trainer co-location yield
+    # with bit-identical params; tenant.* metrics land in the JSONL
+    ('multitenant', 'multitenant', None, 700),
 ]
 
 # non-bench tools: (key, argv, timeout) — raw stdout lines stored

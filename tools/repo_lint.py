@@ -53,7 +53,10 @@ ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
                     'paddle_tpu/parallel/collective.py',
                     # cross-host RPC knobs (timeouts, verify default)
                     # must stay per-call reads
-                    'paddle_tpu/serving/rpc.py')
+                    'paddle_tpu/serving/rpc.py',
+                    # tenant quota knobs (PADDLE_TPU_TENANT_*) must
+                    # stay per-call reads
+                    'paddle_tpu/serving/tenancy.py')
 LINT_ROOT = 'paddle_tpu'
 
 # files OUTSIDE the lint root that still get the full env-scoped lint —

@@ -15,6 +15,13 @@ load — overload shows up as `requests_rejected` growing, the
 QueueFullError backpressure path). Request batch sizes are sampled
 uniformly from [--rows-lo, --rows-hi].
 
+--tenant-mix 'fg:3:interactive,bg:1:batch' drives the same load as a
+weighted multi-tenant mix (loadgen.tenant_mix): requests flow through
+a quota-equipped Router with tenant-prefixed session ids, --tenant-rps
+caps each tenant's request rate (QuotaExceededError sheds count as
+rejects), and the report gains per-tenant admitted/shed rows from the
+tenant.* counters.
+
 Metrics land in the standard observe pipeline: pass --metrics-jsonl
 (or set PADDLE_TPU_METRICS_JSONL) and read the run afterwards with
 tools/metrics_report.py. --json emits one machine-readable object on
@@ -49,26 +56,45 @@ def build_tiny_model(dirname, in_dim=8, hidden=16, classes=4):
     return dirname
 
 
-def _closed_loop(engine, make_feed, stats, deadline, clients):
+def _closed_loop(submit, make_request, stats, deadline, clients):
     from paddle_tpu.serving.loadgen import closed_loop
 
     def do_request(rng):
-        feed, rows = make_feed(rng)
-        engine.predict(feed, timeout=60)
+        feed, rows, session = make_request(rng)
+        submit(feed, session).result(timeout=60)
         return rows
 
     closed_loop(do_request, stats, deadline, clients)
 
 
-def _open_loop(engine, make_feed, stats, deadline, qps, seed=7):
+def _open_loop(submit, make_request, stats, deadline, qps, seed=7):
     from paddle_tpu.serving.loadgen import open_loop
 
     def submit_request(rng):
-        feed, rows = make_feed(rng)
-        return engine.submit(feed), rows
+        feed, rows, session = make_request(rng)
+        return submit(feed, session), rows
 
     open_loop(submit_request, stats, deadline, qps, seed=seed)
     # engine.shutdown(drain=True) in main() is the completion barrier
+
+
+def _parse_tenant_mix(spec):
+    """'name:weight[:priority],...' -> [(name, weight, priority)]."""
+    out = []
+    for part in spec.split(','):
+        bits = part.split(':')
+        if len(bits) not in (2, 3) or not bits[0]:
+            raise SystemExit("serving_bench: --tenant-mix wants "
+                             "'name:weight[:priority],...', got %r"
+                             % spec)
+        try:
+            weight = float(bits[1])
+        except ValueError:
+            raise SystemExit('serving_bench: bad tenant weight in %r'
+                             % part)
+        out.append((bits[0], weight,
+                    bits[2] if len(bits) == 3 else 'standard'))
+    return out
 
 
 def main(argv=None):
@@ -97,6 +123,16 @@ def main(argv=None):
                    help='max rows per request (default max-batch-size)')
     p.add_argument('--no-warmup', action='store_true',
                    help='skip AOT warmup (shows live-compile cost)')
+    p.add_argument('--tenant-mix', default=None,
+                   help="weighted tenant mix 'name:weight[:priority]"
+                        ",...' — requests route through a quota-"
+                        'equipped Router with tenant-prefixed '
+                        'session ids')
+    p.add_argument('--tenant-rps', type=float, default=None,
+                   help='per-tenant request-rate quota (requests/s; '
+                        'default unlimited)')
+    p.add_argument('--tenant-sessions', type=int, default=4,
+                   help='distinct session ids per tenant')
     p.add_argument('--metrics-jsonl', default=None,
                    help='observe JSONL path (or set '
                         'PADDLE_TPU_METRICS_JSONL)')
@@ -131,8 +167,7 @@ def main(argv=None):
     feed_shapes = {n: [d for d in shape] for n, (shape, _) in
                    specs.items()}
 
-    def make_feed(rng):
-        rows = int(rng.randint(args.rows_lo, rows_hi + 1))
+    def build_feed(rng, rows):
         feed = {}
         for name, (shape, dtype) in specs.items():
             dims = [rows] + [int(d) for d in shape[1:]]
@@ -144,7 +179,36 @@ def main(argv=None):
             feed[name] = rng.rand(*dims).astype('float32') \
                 if str(dtype).startswith(('float', 'bfloat')) \
                 else np.zeros(dims, dtype=str(dtype))
-        return feed, rows
+        return feed
+
+    mix_specs = _parse_tenant_mix(args.tenant_mix) \
+        if args.tenant_mix else None
+    router = None
+    if mix_specs:
+        from paddle_tpu.serving import Router, TenantRegistry
+        from paddle_tpu.serving.loadgen import tenant_mix
+        registry = TenantRegistry()
+        for name, _weight, prio in mix_specs:
+            registry.add(name, priority=prio,
+                         request_rate=args.tenant_rps)
+        router = Router([engine], tenants=registry)
+        weights = [(n, w) for n, w, _ in mix_specs]
+
+        def make_request(rng):
+            _tenant, session, rows = tenant_mix(
+                rng, weights,
+                sessions_per_tenant=args.tenant_sessions,
+                rows=(args.rows_lo, rows_hi))
+            return build_feed(rng, rows), rows, session
+
+        submit = lambda feed, session: router.submit(  # noqa: E731
+            feed, session=session)
+    else:
+        def make_request(rng):
+            rows = int(rng.randint(args.rows_lo, rows_hi + 1))
+            return build_feed(rng, rows), rows, None
+
+        submit = lambda feed, session: engine.submit(feed)  # noqa: E731
 
     t_w0 = time.perf_counter()
     signatures = 0 if args.no_warmup else engine.warmup()
@@ -166,10 +230,13 @@ def main(argv=None):
     t0 = time.perf_counter()
     deadline = t0 + args.duration
     if args.mode == 'closed':
-        _closed_loop(engine, make_feed, stats, deadline, args.clients)
+        _closed_loop(submit, make_request, stats, deadline,
+                     args.clients)
     else:
-        _open_loop(engine, make_feed, stats, deadline, qps)
+        _open_loop(submit, make_request, stats, deadline, qps)
     engine.shutdown(drain=True)
+    if router is not None:
+        router.close()
     wall = time.perf_counter() - t0
 
     snap = observe.snapshot()
@@ -208,6 +275,15 @@ def main(argv=None):
                    'buckets': engine._ladder.batch_sizes},
         'feed_shapes': feed_shapes,
     }
+    if mix_specs:
+        sel = lambda prefix, name: sum(  # noqa: E731
+            v for k, v in counters.items()
+            if k.startswith(prefix) and 'tenant=%s' % name in k)
+        report['tenants'] = {
+            name: {'weight': weight, 'priority': prio,
+                   'admitted': sel('tenant.admitted', name),
+                   'shed': sel('tenant.shed', name)}
+            for name, weight, prio in mix_specs}
     observe.disable()
 
     if args.json:
@@ -228,6 +304,12 @@ def main(argv=None):
                           100.0 * (waste.get('mean') or 0.0)))
         print('  compiles   %d warmup signatures in %.2fs; %d total '
               'misses, %d hits' % (signatures, warmup_s, misses, hits))
+        if mix_specs:
+            for name, row in sorted(report['tenants'].items()):
+                print('  tenant     %s (%s, w=%g): admitted=%d '
+                      'shed=%d' % (name, row['priority'],
+                                   row['weight'], row['admitted'],
+                                   row['shed']))
     return 0
 
 
