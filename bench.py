@@ -252,6 +252,23 @@ def _transformer_train_flops(batch, src_len, trg_len, vocab, n_layer=6,
     return 3.0 * (enc + dec + logits)
 
 
+def transformer_mfu_est(tok_s, batch=64, seq=64, vocab=32000):
+    """THE MFU formula — shared by the headline detail and
+    bench_trainspeed (ISSUE 19 satellite: one accounting path, not
+    two). Analytic matmul FLOPs per token at the given shapes
+    (:func:`_transformer_train_flops`) against the chip peak from
+    ``observe.device_peak_flops`` (PADDLE_TPU_PEAK_TFLOPS /
+    BENCH_PEAK_TFLOPS override it; 197 TFLOP/s — TPU v5e — when the
+    device kind is unknown, preserving the old hand-rolled default)."""
+    from paddle_tpu import observe
+    flops_per_tok = _transformer_train_flops(batch, seq, seq, vocab) \
+        / (batch * seq)
+    peak = observe.device_peak_flops()
+    if peak is None:
+        peak = float(os.environ.get('BENCH_PEAK_TFLOPS', '197')) * 1e12
+    return tok_s * flops_per_tok / peak
+
+
 def bench_transformer_masked(batch=8, seq=512, vocab=32000, iters=10):
     """Masked co-headline (VERDICT r4 next-#4): a variable-length batch
     at seq 512 — the actual NMT workload shape, where attention matters
@@ -1072,6 +1089,315 @@ def bench_quant(dp=8, steps=150, hidden=256, in_dim=64,
             'burn_delta': round(quant_leg['burn_during_kill'] -
                                 base['burn_during_kill'], 4),
         }
+    return out
+
+
+def bench_trainspeed(dp=8, steps=24, hidden=64, in_dim=32, batch=8,
+                     overlap_iters=6, fp8_n=64, mfu_batch=2, mfu_seq=16,
+                     mfu_vocab=512, mfu_iters=3, reduced=False):
+    """Training raw speed (ISSUE 19), asserted legs:
+
+    1. **bucketed exact allreduce** — the same dyadic MLP+SGD
+       regression trained unbucketed vs bucketed
+       (ParallelStrategy(grad_bucket_mb=...)) on the dp CPU mesh;
+       asserts final params BIT-IDENTICAL (the exact path is a pure
+       relayout) and >= 2 buckets formed (trainer.grad_bucket_count).
+    2. **backward/allreduce overlap** — three-point estimate
+       (observe.overlap_fraction): bucketed step vs unbucketed step vs
+       the per-bucket collective round-trip alone; asserts
+       trainer.allreduce_overlap_fraction is published and > 0.
+    3. **fp8 matmul** — parity (rel err <= 5e-2 at fp8_n x fp8_n),
+       dispatch strictly follows the tuner table (fp8 dispatched iff
+       the measured winner is fp8 — fp8.matmul_dispatch_total
+       counter), and PADDLE_TPU_FP8_MATMUL beats the table both ways.
+    4. **ZeRO-1 sharded optimizer state** — Adam, replicated vs
+       shard_optimizer_state=True; asserts final params bit-identical
+       and the analytic optimizer_state_bytes model shows per-device
+       state reduced >= 0.8*dp (gauged at transpile).
+    5. **quantized + bucketed composition** — both knobs on; asserts
+       final-loss delta within the quant tolerance (EQuARX compression
+       and bucket overlap stack).
+    6. **MFU headline** — the unified transformer_mfu_est accounting
+       vs XLA cost-analysis FLOPs on the reduced transformer (analytic
+       / cost-analysis ratio within [1/3, 3]); tok/s + MFU deltas vs
+       the BENCH_builder_r4_onchip capture recorded in the JSON.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import observe, tuning
+    from paddle_tpu.ops.fp8_matmul import fp8_matmul, maybe_fp8_matmul
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.transpiler import (ParallelStrategy,
+                                                optimizer_state_bytes,
+                                                transpile)
+    from paddle_tpu.trainer import record_allreduce_overlap
+
+    out = {'workload': 'trainspeed'}
+    dp = max(1, min(int(dp), jax.device_count()))
+    rng = np.random.RandomState(0)
+    # dyadic feeds: every value is k/8, so dp partial sums are exact in
+    # fp32 under ANY association — bit-identity asserts stay meaningful
+    X = (rng.randint(-8, 8, (batch * dp, in_dim)) / 8.0) \
+        .astype('float32')
+    Y = (rng.randint(-8, 8, (batch * dp, 1)) / 8.0).astype('float32')
+
+    def train_leg(bucket_mb=None, shard_opt=False, quant_on=False,
+                  opt='sgd', n_steps=steps):
+        fluid = _fresh()
+        x = fluid.layers.data(name='x', shape=[in_dim], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=hidden, act='relu')
+        h = fluid.layers.fc(input=h, size=hidden, act='relu')
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        if opt == 'sgd':
+            fluid.optimizer.SGD(learning_rate=0.125).minimize(cost)
+        else:
+            fluid.optimizer.Adam(learning_rate=0.125).minimize(cost)
+        prog = fluid.default_main_program()
+        prog.random_seed = 7
+        if dp > 1:
+            transpile(prog, make_mesh(dp=dp), ParallelStrategy(
+                grad_bucket_mb=bucket_mb,
+                shard_optimizer_state=True if shard_opt else None,
+                quantized_allreduce=quant_on))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses, t0 = [], None
+        for i in range(n_steps):
+            got = exe.run(feed={'x': X, 'y': Y}, fetch_list=[cost])
+            losses.append(float(np.asarray(got[0]).reshape(())))
+            if i == 0:
+                t0 = time.perf_counter()   # after the compiling step
+        per_step = (time.perf_counter() - t0) / max(1, n_steps - 1)
+        weights = {p.name: np.asarray(fluid.global_scope().find(p.name))
+                   for p in prog.all_parameters()}
+        return losses, weights, per_step, prog
+
+    # ---- legs 1+2: bucketed bit-identity, then overlap -------------
+    loss_f, w_f, t_fused, _ = train_leg()
+    loss_b, w_b, t_buck, _ = train_leg(bucket_mb=0.001)
+    g = observe.snapshot()['gauges']
+    n_buckets = g.get('trainer.grad_bucket_count', 0)
+    bit_identical = all(np.array_equal(w_f[k], w_b[k]) for k in w_f)
+    if dp > 1:
+        assert bit_identical, \
+            'bucketed exact path must be bit-identical to unbucketed'
+        assert n_buckets >= 2, \
+            'bucket target 0.001MB formed %s buckets (< 2)' % n_buckets
+    out['bucketing'] = {
+        'dp': dp, 'steps': steps, 'n_buckets': int(n_buckets),
+        'target_bytes': int(g.get('trainer.grad_bucket_target_bytes', 0)),
+        'max_bucket_bytes': int(g.get('trainer.grad_bucket_max_bytes', 0)),
+        'final_loss': round(loss_b[-1], 6),
+        'bit_identical_to_unbucketed': bool(bit_identical),
+    }
+
+    overlap = {'dp': dp}
+    if dp > 1:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        mesh = make_mesh(dp=dp)
+        sizes = [max(dp, -(-int(w.size) // dp) * dp)
+                 for w in w_f.values()]
+        arrs = [jnp.ones((s,), jnp.float32) for s in sizes]
+
+        @jax.jit
+        def comm_fn(arrs):
+            # the bucket collective boundary alone: one P('dp')/P()
+            # constraint round trip per bucket-sized array
+            outs = []
+            for a in arrs:
+                c = jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P('dp')))
+                outs.append(jax.lax.with_sharding_constraint(
+                    c, NamedSharding(mesh, P())))
+            return outs
+
+        np.asarray(comm_fn(arrs)[0])                   # compile
+        t0 = time.perf_counter()
+        for _ in range(max(1, overlap_iters)):
+            r = comm_fn(arrs)
+        np.asarray(r[0])
+        t_comm = (time.perf_counter() - t0) / max(1, overlap_iters)
+        frac = record_allreduce_overlap(t_buck, t_fused, t_comm)
+        assert frac is not None and frac > 0.0, \
+            'overlap fraction %r (step %.5fs compute %.5fs comm %.5fs)' \
+            % (frac, t_buck, t_fused, t_comm)
+        g = observe.snapshot()['gauges']
+        assert 'trainer.allreduce_overlap_fraction' in g, \
+            'overlap gauge must be published'
+        overlap.update(
+            step_seconds=round(t_buck, 6),
+            compute_seconds=round(t_fused, 6),
+            comm_seconds=round(t_comm, 6),
+            fraction=round(float(frac), 4))
+    out['overlap'] = overlap
+
+    # ---- leg 3: fp8 matmul parity + dispatch discipline ------------
+    prng = np.random.RandomState(5)
+    a = jnp.asarray(prng.randn(fp8_n, fp8_n).astype('float32'))
+    b = jnp.asarray(prng.randn(fp8_n, fp8_n).astype('float32'))
+    ref = np.asarray(jnp.matmul(a, b))
+    rel = float(np.linalg.norm(np.asarray(fp8_matmul(a, b)) - ref)
+                / np.linalg.norm(ref))
+    assert rel <= 0.05, 'fp8 matmul rel err %.4f > 0.05' % rel
+
+    import tempfile
+    saved = {k: os.environ.get(k) for k in
+             ('PADDLE_TPU_AUTOTUNE', 'PADDLE_TPU_TUNING_TABLE',
+              'PADDLE_TPU_FP8_MATMUL')}
+    tdir = tempfile.mkdtemp(prefix='trainspeed_tune_')
+
+    def dispatch_count():
+        return observe.snapshot()['counters'].get(
+            'fp8.matmul_dispatch_total', 0)
+
+    try:
+        os.environ['PADDLE_TPU_AUTOTUNE'] = 'record'
+        os.environ.pop('PADDLE_TPU_FP8_MATMUL', None)
+        # fp8-winning table -> dispatched (and counted)
+        os.environ['PADDLE_TPU_TUNING_TABLE'] = \
+            os.path.join(tdir, 'fp8_wins.json')
+        tuning.reset()
+        tuning.set_timer(lambda op, key, v, t:
+                         0.001 if v.get('impl') == 'fp8' else 0.010)
+        c0 = dispatch_count()
+        assert maybe_fp8_matmul(a, b) is not None, \
+            'fp8 table winner must dispatch fp8'
+        assert dispatch_count() == c0 + 1, 'dispatch counter must move'
+        # explicit off gate beats the fp8-winning table
+        os.environ['PADDLE_TPU_FP8_MATMUL'] = '0'
+        assert maybe_fp8_matmul(a, b) is None, 'off gate beats table'
+        # native-winning table -> NOT dispatched
+        os.environ.pop('PADDLE_TPU_FP8_MATMUL', None)
+        os.environ['PADDLE_TPU_TUNING_TABLE'] = \
+            os.path.join(tdir, 'native_wins.json')
+        tuning.reset()
+        tuning.set_timer(lambda op, key, v, t:
+                         0.001 if v.get('impl') == 'native' else 0.010)
+        c0 = dispatch_count()
+        assert maybe_fp8_matmul(a, b) is None, \
+            'native table winner must NOT dispatch fp8'
+        assert dispatch_count() == c0, \
+            'no dispatch may be counted on the native path'
+        # explicit on gate beats the native-winning table
+        os.environ['PADDLE_TPU_FP8_MATMUL'] = '1'
+        assert maybe_fp8_matmul(a, b) is not None, 'on gate beats table'
+    finally:
+        tuning.set_timer(None)
+        tuning.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out['fp8'] = {'n': fp8_n, 'rel_err': round(rel, 5),
+                  'dispatch_follows_table': True,
+                  'env_gate_beats_table': True}
+
+    # ---- leg 4: ZeRO-1 sharded optimizer state ---------------------
+    loss_a, w_a, _, prog_a = train_leg(opt='adam')
+    loss_z, w_z, _, prog_z = train_leg(opt='adam', shard_opt=True)
+    z_bit = all(np.array_equal(w_a[k], w_z[k]) for k in w_a)
+    mem_r = optimizer_state_bytes(prog_a)
+    mem_z = optimizer_state_bytes(prog_z)
+    if dp > 1:
+        assert z_bit, 'ZeRO-1 params must be bit-identical to replicated'
+        assert mem_z['reduction'] >= 0.8 * dp, \
+            'optimizer state reduction %.2fx < 0.8*dp (dp=%d)' \
+            % (mem_z['reduction'], dp)
+        g = observe.snapshot()['gauges']
+        assert 'trainer.optimizer_state_bytes_per_device' in g, \
+            'ZeRO-1 memory gauge must be published at transpile'
+    out['zero1'] = {
+        'dp': dp, 'bit_identical_to_replicated': bool(z_bit),
+        'state_bytes_total': mem_z['total'],
+        'state_bytes_per_device_replicated': mem_r['per_device'],
+        'state_bytes_per_device_sharded': mem_z['per_device'],
+        'reduction_x': round(mem_z['reduction'], 3),
+    }
+
+    # ---- leg 5: quantized + bucketed composition -------------------
+    loss_qb, _, _, _ = train_leg(bucket_mb=0.001, quant_on=True)
+    delta = abs(loss_qb[-1] - loss_f[-1])
+    tol = max(0.05, 0.25 * abs(loss_f[-1]))
+    if dp > 1:
+        assert delta <= tol, \
+            'quantized+bucketed final loss %.4f vs exact %.4f (tol %.4f)' \
+            % (loss_qb[-1], loss_f[-1], tol)
+    out['quant_bucketed'] = {
+        'final_loss_exact': round(loss_f[-1], 6),
+        'final_loss_quant_bucketed': round(loss_qb[-1], 6),
+        'loss_delta': round(delta, 6), 'tolerance': round(tol, 6),
+    }
+
+    # ---- leg 6: MFU — unified accounting + headline delta ----------
+    saved_cost = os.environ.get('PADDLE_TPU_OBSERVE_COST')
+    os.environ['PADDLE_TPU_OBSERVE_COST'] = '1'  # need executor.step_flops
+    try:
+        fluid = _fresh()
+        from paddle_tpu.models import transformer as T
+        avg_cost, _ = T.transformer_base(
+            src_vocab_size=mfu_vocab, trg_vocab_size=mfu_vocab,
+            src_seq_len=mfu_seq, trg_seq_len=mfu_seq,
+            max_length=max(256, mfu_seq))
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        feed = _to_device(T.make_fake_batch(mfu_batch, mfu_seq, mfu_seq,
+                                            mfu_vocab, mfu_vocab))
+        got = exe.run(feed=feed, fetch_list=[avg_cost])  # single-step key
+        np.asarray(got[0])
+        xla_flops = observe.snapshot()['gauges'].get(
+            'executor.step_flops', 0)
+        dt = _time_multi(exe, feed, [avg_cost], mfu_iters)
+    finally:
+        if saved_cost is None:
+            os.environ.pop('PADDLE_TPU_OBSERVE_COST', None)
+        else:
+            os.environ['PADDLE_TPU_OBSERVE_COST'] = saved_cost
+    tok_s = mfu_batch * mfu_seq / dt
+    analytic = _transformer_train_flops(mfu_batch, mfu_seq, mfu_seq,
+                                        mfu_vocab)
+    assert xla_flops, 'executor.step_flops gauge missing — the unified ' \
+        'MFU path needs the XLA cost analysis'
+    ratio = analytic / xla_flops
+    # analytic counts matmul FLOPs only (x3 bwd); XLA counts the whole
+    # program — agreement within 3x is the unification contract
+    assert 1.0 / 3.0 <= ratio <= 3.0, \
+        'analytic %.3e vs cost-analysis %.3e FLOPs (ratio %.3f)' \
+        % (analytic, xla_flops, ratio)
+    mfu = transformer_mfu_est(tok_s, mfu_batch, mfu_seq, mfu_vocab)
+    mfu_leg = {
+        'batch': mfu_batch, 'seq': mfu_seq, 'vocab': mfu_vocab,
+        'tok_per_sec': round(tok_s, 1), 'mfu_est': round(mfu, 6),
+        'analytic_flops_per_step': analytic,
+        'xla_cost_analysis_flops': xla_flops,
+        'analytic_vs_xla_ratio': round(ratio, 3),
+    }
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'BENCH_builder_r4_onchip.json')) as f:
+            cap = json.load(f)
+        base_tok = float(cap['detail']['transformer_tok_per_sec'])
+        base_mfu = transformer_mfu_est(base_tok)  # headline shapes
+        mfu_leg['baseline'] = {
+            'file': 'BENCH_builder_r4_onchip.json',
+            'transformer_tok_per_sec': base_tok,
+            'mfu_est': round(base_mfu, 4),
+            'tok_per_sec_delta': round(tok_s - base_tok, 1),
+            'tok_per_sec_ratio': round(tok_s / base_tok, 6),
+            'mfu_delta': round(mfu - base_mfu, 6),
+            'note': 'measured leg runs the reduced shape on this '
+                    'backend; the baseline is the on-chip headline '
+                    'shape — deltas recorded, not asserted',
+        }
+    except Exception as e:
+        mfu_leg['baseline'] = {'error': '%s: %s' % (type(e).__name__, e)}
+    out['mfu'] = mfu_leg
     return out
 
 
@@ -2989,7 +3315,8 @@ def _run_workload_child(workload, backend, reduced):
         from paddle_tpu.core.platform_boot import force_host_cpu
         # the quant/linalg ablations need a dp(x tp) mesh even
         # off-chip: 8 virtual CPU devices, same as the test conftest
-        force_host_cpu(8 if workload in ('quant', 'linalg') else None)
+        force_host_cpu(8 if workload in ('quant', 'linalg', 'trainspeed')
+                       else None)
     # one home for the cache-arming quirk (env alone does not arm it on
     # this jax build); a workload killed mid-compile then restarts from
     # the cached executable instead of re-burning its watchdog budget
@@ -3090,6 +3417,11 @@ def _run_workload_child(workload, backend, reduced):
         kw = dict(steps=60, kv_duration=1.5, fleet_duration=3.0,
                   reduced=True) if reduced else {}
         print('RESULT_JSON %s' % json.dumps(bench_quant(**kw)),
+              flush=True)
+        return
+    if workload == 'trainspeed':
+        kw = dict(steps=20, mfu_iters=2, reduced=True) if reduced else {}
+        print('RESULT_JSON %s' % json.dumps(bench_trainspeed(**kw)),
               flush=True)
         return
     if workload == 'linalg':
@@ -3537,13 +3869,10 @@ def main():
     if tok_s is not None:
         detail['transformer_tok_per_sec'] = round(tok_s, 1)
         if not reduced:
-            # headline MFU estimate from analytic matmul FLOPs at the
-            # headline shapes (batch 64, seq 64, vocab 32k) vs bf16 peak
-            flops_per_tok = _transformer_train_flops(64, 64, 64, 32000) \
-                / (64 * 64)
-            peak = float(os.environ.get('BENCH_PEAK_TFLOPS', '197')) * 1e12
+            # headline MFU estimate at the headline shapes (batch 64,
+            # seq 64, vocab 32k) via the unified observe-backed path
             detail['transformer_mfu_est'] = round(
-                tok_s * flops_per_tok / peak, 4)
+                transformer_mfu_est(tok_s), 4)
     if img_s is not None:
         detail['resnet50_img_per_sec'] = round(img_s, 1)
     if masked_head is not None:
@@ -3643,7 +3972,7 @@ WORKLOAD_CHOICES = [
     'moe_cap1.0', 'moe_cap1.25', 'moe_cap2.0', 'pipeline_transformer',
     'pipeline_resnet50', 'decode_transformer', 'fleet', 'autoscale',
     'quant', 'disagg', 'linalg', 'autotune', 'autotune_child',
-    'verify', 'crosshost', 'multitenant',
+    'verify', 'crosshost', 'multitenant', 'trainspeed',
 ]
 
 if __name__ == '__main__':

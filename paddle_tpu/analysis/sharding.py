@@ -120,3 +120,57 @@ def check(ctx):
                         'every step' % (op.type, xn, tuple(xs), yn,
                                         tuple(ys)), op=op, op_index=i,
                         var=yn)
+
+    # ZeRO-1 contracts. Optimizer ops are found structurally (any op
+    # with a 'Param' input slot — the same rule the transpiler's
+    # accumulator loop uses): (a) same-shape accumulators of one update
+    # must agree on a layout, else GSPMD reshards state every step;
+    # (b) a dp-sharded accumulator wants a dp-sharded (reduce-scattered)
+    # gradient — a replicated grad beside sharded state makes XLA
+    # materialize the full gradient on every device and slice it,
+    # spending the memory ZeRO-1 was meant to save.
+    for i, op in enumerate(ctx.block.ops):
+        pnames = op.inputs.get('Param')
+        if not pnames:
+            continue
+        pvar = ctx.find_var(pnames[0])
+        pshape = None if pvar is None or pvar.shape is None \
+            else tuple(pvar.shape)
+        if pshape is None:
+            continue
+        state_specs = {}
+        for slot, names in op.inputs.items():
+            if slot in ('Param', 'Grad', 'LearningRate'):
+                continue
+            for n in names:
+                v = ctx.find_var(n)
+                if v is None or not getattr(v, 'persistable', False) \
+                        or v.shape is None or tuple(v.shape) != pshape:
+                    continue
+                state_specs[n] = tuple(_spec_entries(shardings.get(n)))
+        if not state_specs:
+            continue
+        if len(set(state_specs.values())) > 1:
+            ctx.warning('zero-state-spec-mismatch',
+                        '%s accumulators for param %r carry differing '
+                        'specs %s — GSPMD reshards optimizer state '
+                        'every step; re-run parallel.transpile so one '
+                        'layout decision covers them all'
+                        % (op.type, pnames[0],
+                           {n: s for n, s in sorted(state_specs.items())}),
+                        op=op, op_index=i, var=pnames[0])
+        grads = op.inputs.get('Grad') or []
+        gname = grads[0] if grads else None
+        dp_state = [n for n, s in sorted(state_specs.items())
+                    if any('dp' in _entry_axes(e) for e in s)]
+        if dp_state and gname is not None:
+            g_dp = any('dp' in _entry_axes(e)
+                       for e in _spec_entries(shardings.get(gname)))
+            if not g_dp:
+                ctx.warning('zero-grad-replicated',
+                            '%s state %s for param %r is dp-sharded but '
+                            'gradient %r is not — the update all-gathers '
+                            'the full gradient on every device each '
+                            'step, defeating ZeRO-1\'s reduce-scatter'
+                            % (op.type, dp_state, pnames[0], gname),
+                            op=op, op_index=i, var=gname)

@@ -484,10 +484,12 @@ class Executor(object):
         # read per call and folded into the cache key: flipping the
         # PADDLE_TPU_QUANT_ALLREDUCE knob mid-process recompiles
         # instead of silently reusing the other mode's executable
+        from ..parallel.collective import grad_bucket_policy
         from ..quant.core import grad_allreduce_policy
         qpolicy = grad_allreduce_policy(program)
+        bpolicy = grad_bucket_policy(program)
         key = (id(program), program._version, program.amp,
-               program.remat_policy, qpolicy, feed_sig,
+               program.remat_policy, qpolicy, bpolicy, feed_sig,
                tuple(fetch_names))
         self._maybe_verify('single', key, program, feed_vals,
                            fetch_names)
@@ -495,10 +497,11 @@ class Executor(object):
         compiled, missed = self._lookup_or_compile(
             'single', key, use_program_cache,
             lambda: self._compile(program, sorted(feed_vals),
-                                  fetch_names, quant_allreduce=qpolicy),
+                                  fetch_names, quant_allreduce=qpolicy,
+                                  grad_bucket=bpolicy),
             program=program,
             aot_parts=('single', program.amp, program.remat_policy,
-                       qpolicy, feed_sig, tuple(fetch_names)))
+                       qpolicy, bpolicy, feed_sig, tuple(fetch_names)))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='single',
@@ -584,16 +587,19 @@ class Executor(object):
                      for n, v in feed_vals.items()}
         feed_sig = tuple(sorted((n, sig_shape[n], str(v.dtype))
                                 for n, v in feed_vals.items()))
+        from ..parallel.collective import grad_bucket_policy
         from ..quant.core import grad_allreduce_policy
         qpolicy = grad_allreduce_policy(program)
+        bpolicy = grad_bucket_policy(program)
         key = ('multi', id(program), program._version, program.amp,
-               program.remat_policy, qpolicy, feed_sig,
+               program.remat_policy, qpolicy, bpolicy, feed_sig,
                tuple(fetch_names), steps, stacked_feed)
         self._maybe_verify('multi', key, program, feed_vals, fetch_names)
 
         def _build_multi():
             base = self._compile(program, sorted(feed_vals), fetch_names,
-                                 quant_allreduce=qpolicy)
+                                 quant_allreduce=qpolicy,
+                                 grad_bucket=bpolicy)
 
             # state that is read each step chains through the scan carry;
             # written-only persistables (no reader) are ALSO carried —
@@ -637,8 +643,8 @@ class Executor(object):
             'multi', key, True, _build_multi,
             program=program,
             aot_parts=('multi', program.amp, program.remat_policy,
-                       qpolicy, feed_sig, tuple(fetch_names), steps,
-                       stacked_feed))
+                       qpolicy, bpolicy, feed_sig, tuple(fetch_names),
+                       steps, stacked_feed))
         self.last_cache_miss = missed
         if not missed and _obs.enabled():
             _obs.inc('executor.cache_hit_total', kind='multi',
@@ -784,7 +790,7 @@ class Executor(object):
         return out
 
     def _compile(self, program, feed_names, fetch_names,
-                 quant_allreduce=None):
+                 quant_allreduce=None, grad_bucket=None):
         import jax
 
         block = program.global_block()
@@ -863,6 +869,49 @@ class Executor(object):
                 _obs.set_gauge('quant.allreduce_compression',
                                fp32_b / max(q_b, 1.0))
                 _obs.inc('quant.allreduce_compiles_total')
+
+        # Bucketed asynchronous gradient allreduce (the EQuARX overlap
+        # leg): instead of leaving the dp reduction as one fused
+        # collective after the whole backward, dense gradients are
+        # partitioned into size-targeted buckets in reverse production
+        # order (assignment is static — computed here from the declared
+        # shapes, so trace and re-trace agree) and each bucket gets its
+        # own sharding-constraint round trip in step_fn. XLA then emits
+        # one reduce-scatter/all-gather pair per bucket with dataflow
+        # deps only on that bucket's gradients, which the latency-hiding
+        # scheduler overlaps against the remaining backward compute.
+        # Same gating as quant_grads: a training step on a dp>1 mesh.
+        grad_buckets = None
+        if grad_bucket is not None and marker_idx is not None and \
+                mesh is not None and dict(mesh.shape).get('dp', 1) > 1:
+            from ..parallel.collective import assign_grad_buckets
+            marker = ops[marker_idx]
+            sparse_names = set(marker.attrs.get('sparse_grads') or {})
+            dense_pairs = [
+                (pn, gn) for pn, gn in zip(marker.attrs['param_names'],
+                                           marker.attrs['grad_names'])
+                if pn not in sparse_names]
+            items = []
+            for pn, _ in dense_pairs:
+                v = block._find_var_recursive(pn)
+                shape = v.shape if v is not None and v.shape else (1,)
+                numel = 1
+                for d in shape:
+                    numel *= int(d)
+                dt = np.dtype(to_jnp_dtype(v.dtype)) if v is not None \
+                    else np.dtype('float32')
+                items.append((numel * dt.itemsize, str(dt)))
+            target = int(grad_bucket[1] * 1024 * 1024)
+            buckets = assign_grad_buckets(items, target)
+            grad_buckets = {'pairs': dense_pairs, 'buckets': buckets}
+            if _obs.enabled():
+                per_bucket = [sum(items[i][0] for i in b)
+                              for b in buckets]
+                _obs.set_gauge('trainer.grad_bucket_count', len(buckets))
+                _obs.set_gauge('trainer.grad_bucket_target_bytes', target)
+                _obs.set_gauge('trainer.grad_bucket_max_bytes',
+                               max(per_bucket) if per_bucket else 0)
+                _obs.inc('trainer.grad_bucket_compiles_total')
 
         def run_ops(op_list, env, base_key, start_index=0):
             import jax as _jax
@@ -974,6 +1023,55 @@ class Executor(object):
                 (_, kept), grads = jax.value_and_grad(
                     fwd, has_aux=True)(params)
                 env.update(kept)
+
+                # Bucketed allreduce: each bucket is concatenated,
+                # padded to a dp multiple, and pushed through a
+                # P('dp') -> [optional qdq] -> P() sharding-constraint
+                # round trip. The constraint pair is the per-bucket
+                # collective boundary — XLA lowers it to a
+                # reduce-scatter/all-gather over just this bucket's
+                # gradients, with dataflow deps only on them, so the
+                # scheduler overlaps it with the rest of the backward.
+                # Exact path is a pure relayout (bit-identical to
+                # unbucketed); the quantized path compresses per bucket
+                # (key namespace 0x6b31, distinct from per-grad 0x5172).
+                bucket_vals = {}
+                if grad_buckets is not None:
+                    from jax.sharding import NamedSharding as _NS
+                    from jax.sharding import PartitionSpec as _P
+                    n_dp = dict(mesh.shape)['dp']
+                    pairs = grad_buckets['pairs']
+                    for bi, bucket in enumerate(grad_buckets['buckets']):
+                        names = [pairs[i][0] for i in bucket]
+                        flats = [grads[n].reshape(-1) for n in names]
+                        cat = _jnp.concatenate(flats) \
+                            if len(flats) > 1 else flats[0]
+                        numel = cat.shape[0]
+                        pad = (-numel) % n_dp
+                        if pad:
+                            cat = _jnp.pad(cat, (0, pad))
+                        cat = jax.lax.with_sharding_constraint(
+                            cat, _NS(mesh, _P('dp')))
+                        if quant_grads is not None:
+                            from ..quant.core import qdq as _bqdq
+                            bkey = jax.random.fold_in(
+                                jax.random.fold_in(base_key, 0x6b31),
+                                bi)
+                            cat = _bqdq(cat,
+                                        block=quant_grads['block'],
+                                        key=bkey)
+                        cat = jax.lax.with_sharding_constraint(
+                            cat, _NS(mesh, _P()))
+                        if pad:
+                            cat = cat[:numel]
+                        off = 0
+                        for n in names:
+                            g = grads[n]
+                            sz = int(g.size)
+                            bucket_vals[n] = cat[off:off + sz] \
+                                .reshape(g.shape).astype(g.dtype)
+                            off += sz
+
                 for pi, (pn, gn) in enumerate(zip(param_names,
                                                   grad_names)):
                     if pn in sparse_info:
@@ -982,6 +1080,8 @@ class Executor(object):
                         rows = grads[SPARSE_SEED_PREFIX +
                                      sparse_info[pn]['out']]
                         env[gn] = rows.reshape(-1, rows.shape[-1])
+                    elif pn in bucket_vals:
+                        env[gn] = bucket_vals[pn]
                     elif quant_grads is not None:
                         from ..quant.core import qdq as _qdq
                         gkey = jax.random.fold_in(
@@ -991,6 +1091,18 @@ class Executor(object):
                                        key=gkey)
                     else:
                         env[gn] = grads[pn]
+                if mesh is not None:
+                    # grads are assigned here, not as op outputs, so the
+                    # run_ops constraint pass never sees them; ZeRO-1's
+                    # reduce-scatter (transpiler dp-extends the grad
+                    # spec when shard_optimizer_states is on) is applied
+                    # at the assignment boundary instead.
+                    from jax.sharding import NamedSharding as _NS
+                    for gn in grad_names:
+                        gspec = shardings.get(gn)
+                        if gspec is not None and gn in env:
+                            env[gn] = jax.lax.with_sharding_constraint(
+                                env[gn], _NS(mesh, gspec))
                 env = run_ops(post, env, base_key,
                               start_index=marker_idx + 1)
             else:
@@ -1024,10 +1136,12 @@ class Executor(object):
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
         feed_vals = self._normalize_feed(block, feed or {})
+        from ..parallel.collective import grad_bucket_policy
         from ..quant.core import grad_allreduce_policy
         compiled = self._compile(
             program, sorted(feed_vals), fetch_names,
-            quant_allreduce=grad_allreduce_policy(program))
+            quant_allreduce=grad_allreduce_policy(program),
+            grad_bucket=grad_bucket_policy(program))
         scope_vals, feed_vals = self._prepare_inputs(
             'Executor.compile_step', program, compiled, scope, feed_vals)
         return compiled.raw_fn, scope_vals, feed_vals

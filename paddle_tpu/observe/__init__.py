@@ -60,7 +60,7 @@ import zlib
 from .anomaly import AnomalyMonitor
 from .flight import FlightRecorder
 from .mfu import (GoodputTracker, cost_analysis_flops,  # noqa: F401
-                  device_peak_flops)
+                  device_peak_flops, overlap_fraction)
 from .registry import Registry
 from .spans import SpanRecorder
 
@@ -71,7 +71,7 @@ __all__ = ['enabled', 'enable', 'enable_from_env', 'disable', 'reset',
            'export_trace',
            'run_begin', 'step_done', 'overhead', 'goodput',
            'step_telemetry', 'summary_table', 'snapshot',
-           'device_peak_flops', 'cost_analysis_flops',
+           'device_peak_flops', 'cost_analysis_flops', 'overlap_fraction',
            # live diagnostics / crash forensics / anomaly surface
            'serve', 'stop_serving', 'register_health_check',
            'unregister_health_check', 'flight_recorder', 'flight_event',

@@ -18,7 +18,7 @@ import os
 import time
 
 __all__ = ['PEAK_TFLOPS_BF16', 'device_peak_flops', 'cost_analysis_flops',
-           'GoodputTracker']
+           'overlap_fraction', 'GoodputTracker']
 
 # bf16 dense peak per chip generation (TFLOP/s per chip). Matmul peak
 # from public TPU specs; override with PADDLE_TPU_PEAK_TFLOPS (or the
@@ -61,6 +61,30 @@ def device_peak_flops(device=None):
     if 'tpu' in kind:
         return PEAK_TFLOPS_BF16['v5e'] * 1e12  # conservative default
     return None
+
+
+def overlap_fraction(step_seconds, compute_seconds, comm_seconds):
+    """Fraction of the shorter leg hidden behind the longer one, from
+    three wall-clock measurements: the combined step, the compute-only
+    leg, and the communication-only leg. If nothing overlapped the step
+    would take compute + comm; if the shorter leg were fully hidden it
+    would take max(compute, comm) — so
+
+        overlap = (compute + comm - step) / min(compute, comm)
+
+    clamped to [0, 1]. Used for the bucketed backward/allreduce overlap
+    gauge (``trainer.allreduce_overlap_fraction``); None on degenerate
+    inputs (any leg non-positive, or a step faster than both legs can
+    explain is still clamped, but a step of 0 is meaningless)."""
+    try:
+        s = float(step_seconds)
+        c = float(compute_seconds)
+        m = float(comm_seconds)
+    except (TypeError, ValueError):
+        return None
+    if s <= 0 or c <= 0 or m <= 0:
+        return None
+    return max(0.0, min(1.0, (c + m - s) / min(c, m)))
 
 
 def cost_analysis_flops(compiled):

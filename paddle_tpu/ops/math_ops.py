@@ -19,6 +19,15 @@ def _flatten_2d(x, num_col_dims):
     return x.reshape(lead, tail)
 
 
+def _matmul_2d(x2, y2):
+    """2D contraction with dtype dispatch: the explicit
+    PADDLE_TPU_FP8_MATMUL gate beats the tuning.decide_matmul_dtype
+    table beats the native default (ops/fp8_matmul.py)."""
+    from .fp8_matmul import maybe_fp8_matmul
+    out = maybe_fp8_matmul(x2, y2)
+    return jnp.matmul(x2, y2) if out is None else out
+
+
 @register('mul')
 def _mul(ctx):
     """out = flatten(x) @ flatten(y)  (reference mul_op.cc:24)."""
@@ -28,7 +37,7 @@ def _mul(ctx):
     yd = ctx.attr('y_num_col_dims', 1)
     x2 = _flatten_2d(x, xd)
     y2 = _flatten_2d(y, yd)
-    out = jnp.matmul(x2, y2)
+    out = _matmul_2d(x2, y2)
     out_shape = x.shape[:xd] + y.shape[yd:]
     ctx.set_output('Out', out.reshape(out_shape))
 
@@ -41,7 +50,10 @@ def _matmul(ctx):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ctx.attr('transpose_Y', False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    if x.ndim == 2 and y.ndim == 2:
+        out = _matmul_2d(x, y)
+    else:
+        out = jnp.matmul(x, y)
     alpha = ctx.attr('alpha', 1.0)
     if alpha != 1.0:
         out = out * alpha

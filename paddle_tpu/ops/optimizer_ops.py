@@ -12,6 +12,24 @@ import jax.numpy as jnp
 
 from ..core.registry import register
 
+# Accumulator input slots per optimizer op type — the state the ZeRO-1
+# memory model (parallel.transpiler.optimizer_state_bytes) and the
+# analysis sharding checks reason about. [1]-shaped beta-pow scalars
+# have no dp-divisible axis and stay replicated under ZeRO-1.
+STATE_SLOTS = {
+    'sgd': (),
+    'momentum': ('Velocity',),
+    'adam': ('Moment1', 'Moment2', 'Beta1Pow', 'Beta2Pow'),
+    'adagrad': ('Moment',),
+    'adamax': ('Moment', 'InfNorm', 'Beta1Pow'),
+    'decayed_adagrad': ('Moment',),
+    'adadelta': ('AvgSquaredGrad', 'AvgSquaredUpdate'),
+    'rmsprop': ('MeanSquare', 'Moment'),
+    'ftrl': ('SquaredAccumulator', 'LinearAccumulator'),
+    'proximal_gd': (),
+    'proximal_adagrad': ('Moment',),
+}
+
 
 def _lr(ctx):
     lr = ctx.input('LearningRate')

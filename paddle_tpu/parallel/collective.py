@@ -2,9 +2,67 @@
 NCCL allreduce in ParallelExecutor). Thin wrappers over jax.lax for use
 inside shard_map bodies and custom kernels, plus the quantized
 allreduce schedule (PAPERS "EQuARX: Efficient Quantized AllReduce in
-XLA") the trainer's dp gradient path models."""
+XLA") the trainer's dp gradient path models, and the gradient-bucketing
+policy/assignment the executor's bucketed-allreduce path uses
+(``PADDLE_TPU_GRAD_BUCKET_MB`` — read per call, repo_lint enforced)."""
+
+import os
 
 import jax
+
+
+# ------------------------------------------------- gradient bucketing
+def grad_bucket_policy(program=None):
+    """Per-call resolver for the gradient-allreduce bucketing knob.
+
+    Precedence mirrors ``quant.core.grad_allreduce_policy``: an explicit
+    ``PADDLE_TPU_GRAD_BUCKET_MB`` env value wins in either direction
+    ('0'/'off' disables; a number is the per-bucket size target in MB);
+    when unset, the program's ``grad_bucket_mb`` attribute (set by
+    ``ParallelStrategy(grad_bucket_mb=...)``) decides. Returns a
+    hashable policy tuple ``('mb', size_mb)`` — folded into the
+    executor's compile-cache key so flipping the env recompiles instead
+    of silently reusing the other mode — or None when off."""
+    raw = os.environ.get('PADDLE_TPU_GRAD_BUCKET_MB')
+    if raw is None or raw.strip() == '':
+        mb = getattr(program, 'grad_bucket_mb', None)
+    else:
+        s = raw.strip().lower()
+        mb = None if s in ('0', 'off', 'false') else float(s)
+    if mb is None or float(mb) <= 0:
+        return None
+    return ('mb', float(mb))
+
+
+def assign_grad_buckets(items, target_bytes):
+    """Deterministic size-targeted bucket assignment.
+
+    ``items`` is ``[(size_bytes, group), ...]`` in PARAMETER ORDER (the
+    forward order); the walk runs in REVERSE — the backward produces
+    gradients roughly last-layer-first, so reversed parameter order
+    approximates production order and the first bucket closes (and its
+    collective can issue) while earlier layers are still
+    differentiating. Greedy: a bucket closes when adding the next
+    gradient would exceed ``target_bytes`` (a single oversized gradient
+    gets its own bucket) or when the group key changes (buckets never
+    mix groups — concatenation must not promote dtypes). Returns a list
+    of buckets, each a list of original item indices; pure and
+    deterministic, so trace and re-trace agree bit-for-bit."""
+    target = max(1, int(target_bytes))
+    buckets = []
+    cur, cur_bytes, cur_group = [], 0, None
+    for i in reversed(range(len(items))):
+        size, group = items[i]
+        size = int(size)
+        if cur and (cur_bytes + size > target or group != cur_group):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += size
+        cur_group = group
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def _axis_size(axis_name):

@@ -36,7 +36,7 @@ __all__ = [
     'qdq', 'quantize_rows', 'quantize_per_channel_np',
     'grad_allreduce_policy', 'resolve_kv_dtype', 'kv_itemsize',
     'kv_quantized', 'kv_fp8_supported', 'allreduce_wire_bytes',
-    'quantized_allreduce_wire_bytes',
+    'quantized_allreduce_wire_bytes', 'quantize_tensor_fp8',
 ]
 
 
@@ -207,6 +207,21 @@ def quantize_rows(x, kv_dtype):
         raise ValueError('quantize_rows: %r is not a quantized kv '
                          'dtype' % (kv_dtype,))
     return q, s.astype(jnp.float32)
+
+
+# ------------------------------------------------ per-tensor (fp8 mm)
+def quantize_tensor_fp8(x):
+    """Per-tensor fp8(e4m3) quantization for the fp8-cast matmul
+    (ops/fp8_matmul.py): one fp32 scale = absmax/448 over the whole
+    tensor, values cast to float8_e4m3fn after scaling. Returns
+    ``(q, scale)``; the matmul rescales its fp32 accumulation by
+    ``sx * sy``. Per-tensor (not per-block) because the MXU consumes
+    whole operands — scales must factor out of the contraction."""
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(xf)), _EPS)
+    s = (amax / QMAX_FP8).astype(jnp.float32)
+    return (xf / s).astype(jnp.float8_e4m3fn), s
 
 
 # -------------------------------------------------- per-channel (PTQ)

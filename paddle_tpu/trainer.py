@@ -35,9 +35,25 @@ from .fault import inject as _inject
 from .fault.guards import BadStepGuard
 
 __all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
-           'EndStepEvent', 'Trainer']
+           'EndStepEvent', 'Trainer', 'record_allreduce_overlap']
 
 _PREFETCH_ERR = object()
+
+
+def record_allreduce_overlap(step_seconds, compute_seconds,
+                             comm_seconds):
+    """Publish ``trainer.allreduce_overlap_fraction`` — the fraction of
+    the gradient-allreduce leg hidden behind backward compute, from
+    three wall-clock measurements (the bucketed step, the compute-only
+    step, and the collective-only leg; see observe.overlap_fraction).
+    Sits alongside ``trainer.pipeline_overlap_fraction``; the bench
+    `trainspeed` workload measures the legs and asserts it > 0 on the
+    dp mesh. Returns the fraction (or None on degenerate inputs)."""
+    frac = _obs.overlap_fraction(step_seconds, compute_seconds,
+                                 comm_seconds)
+    if frac is not None and _obs.enabled():
+        _obs.set_gauge('trainer.allreduce_overlap_fraction', frac)
+    return frac
 
 
 class BeginEpochEvent(object):

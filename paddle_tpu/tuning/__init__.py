@@ -22,14 +22,15 @@ warm start" covers both.
 from .autotune import (autotune_mode, current_table, decide,  # noqa: F401
                        decide_attention, decide_batch_norm,
                        decide_layer_norm, decide_linalg_block,
-                       decide_paged_attention, decide_summa_panel,
-                       device_kind, env_gate_set, reset, set_timer,
-                       table_path)
+                       decide_matmul_dtype, decide_paged_attention,
+                       decide_summa_panel, device_kind, env_gate_set,
+                       reset, set_timer, table_path)
 from .table import FORMAT_VERSION, TuningTable  # noqa: F401
 
 __all__ = ['autotune_mode', 'decide', 'decide_attention',
            'decide_batch_norm', 'decide_layer_norm',
-           'decide_linalg_block', 'decide_paged_attention',
-           'decide_summa_panel', 'device_kind', 'env_gate_set',
-           'reset', 'set_timer', 'table_path', 'current_table',
-           'TuningTable', 'FORMAT_VERSION']
+           'decide_linalg_block', 'decide_matmul_dtype',
+           'decide_paged_attention', 'decide_summa_panel',
+           'device_kind', 'env_gate_set', 'reset', 'set_timer',
+           'table_path', 'current_table', 'TuningTable',
+           'FORMAT_VERSION']

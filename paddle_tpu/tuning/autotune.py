@@ -39,7 +39,8 @@ from .table import TuningTable
 
 __all__ = ['autotune_mode', 'decide', 'reset', 'set_timer', 'table_path',
            'current_table', 'device_kind', 'env_gate_set',
-           'decide_summa_panel', 'decide_linalg_block']
+           'decide_summa_panel', 'decide_linalg_block',
+           'decide_matmul_dtype']
 
 _STATE = {'table': None, 'table_path': None, 'memo': {}, 'timer': None}
 
@@ -390,6 +391,36 @@ def decide_linalg_block(op, n, m, dtype, mesh, axis='dp'):
                 a_, mesh, block=blk))(a)[0]
         candidates.append(({'impl': 'blocked', 'block': blk}, thunk))
     return decide(op, key, candidates)
+
+
+def decide_matmul_dtype(m, k, n, dtype):
+    """Native (input-dtype) vs fp8(e4m3)-cast contraction for one
+    2D matmul shape — the ``matmul_dtype`` family behind the
+    mul/matmul lowerings' dispatch (ops/fp8_matmul.py). The fp8
+    candidate only enumerates where this jax build carries
+    float8_e4m3fn; the explicit ``PADDLE_TPU_FP8_MATMUL`` gate is
+    checked at the dispatch site and beats this table."""
+    import jax
+    import jax.numpy as jnp
+
+    key = 'matmul_dtype|m%d k%d n%d|%s' % (m, k, n, dtype)
+
+    def mk_inputs():
+        return jnp.ones((m, k), dtype), jnp.ones((k, n), dtype)
+
+    def native_thunk():
+        x, y = mk_inputs()
+        return jax.jit(jnp.matmul)(x, y)
+
+    candidates = [({'impl': 'native'}, native_thunk)]
+    from ..quant.core import kv_fp8_supported
+    if kv_fp8_supported():
+        def fp8_thunk():
+            from ..ops.fp8_matmul import fp8_matmul
+            x, y = mk_inputs()
+            return jax.jit(fp8_matmul)(x, y)
+        candidates.append(({'impl': 'fp8'}, fp8_thunk))
+    return decide('matmul_dtype', key, candidates)
 
 
 def decide_batch_norm(r, c, dtype):

@@ -313,12 +313,15 @@ def test_aot_fingerprint_content_not_identity(tmp_path, monkeypatch):
 # ------------------------------------------------------------ CLI + e2e
 def test_tuning_inspect_cli(tmp_path, monkeypatch):
     monkeypatch.setenv('PADDLE_TPU_AUTOTUNE', 'on')
-    tuning.set_timer(_fake_timer({'tq1024': 'xla'}))
+    tuning.set_timer(_fake_timer({'tq1024': 'xla',
+                                  'matmul_dtype': 'fp8'}))
     tuning.decide_attention(1, 8, 1024, 1024, 64, 'float32', True, False)
     # a linalg-family entry rides the same table (ISSUE 15)
     from paddle_tpu.parallel.mesh import make_mesh
     tuning.decide_summa_panel(64, 512, 64, 'float32',
                               make_mesh(dp=2, tp=2))
+    # a matmul compute-dtype entry too (ISSUE 19)
+    tuning.decide_matmul_dtype(64, 64, 64, 'float32')
     path = tuning.table_path()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.join(repo, 'tools', 'tuning_inspect.py')
@@ -327,7 +330,7 @@ def test_tuning_inspect_cli(tmp_path, monkeypatch):
     assert r.returncode == 0, r.stderr
     doc = json.loads(r.stdout)
     assert doc['kind'] == 'paddle_tpu_tuning_table'
-    assert doc['status'] == 'ok' and doc['n_entries'] == 2
+    assert doc['status'] == 'ok' and doc['n_entries'] == 3
     kind = doc['device_kinds'][0]
     attn = [e for k, e in doc['tables'][kind].items()
             if k.startswith('flash_attention')]
@@ -339,6 +342,13 @@ def test_tuning_inspect_cli(tmp_path, monkeypatch):
     assert lent['op'] == 'summa_matmul'
     assert isinstance(lent['size'], int)
     assert 'margin_over_runner_up' in lent
+    # the matmul-dtype summary names the fp8-vs-native winner + shape
+    (mkey, ment), = doc['matmul_dtype'][kind].items()
+    assert mkey.startswith('matmul_dtype|m64 k64 n64')
+    assert ment['op'] == 'matmul_dtype'
+    assert ment['winner'] == 'fp8'
+    assert ment['shape'] == 'm64 k64 n64'
+    assert 'margin_over_runner_up' in ment
     # --linalg filters the tables to the family
     r3 = subprocess.run([sys.executable, script, path, '--json',
                          '--linalg'],
@@ -346,11 +356,20 @@ def test_tuning_inspect_cli(tmp_path, monkeypatch):
     doc3 = json.loads(r3.stdout)
     assert all(k.startswith('summa_matmul')
                for k in doc3['tables'][kind])
+    # --matmul-dtype filters to the compute-dtype entries
+    r4 = subprocess.run([sys.executable, script, path, '--json',
+                         '--matmul-dtype'],
+                        capture_output=True, text=True, timeout=60)
+    doc4 = json.loads(r4.stdout)
+    assert all(k.startswith('matmul_dtype')
+               for k in doc4['tables'][kind])
+    assert doc4['tables'][kind]
     # text mode renders without jax in the tool (stdlib-only contract)
     r2 = subprocess.run([sys.executable, script, path],
                         capture_output=True, text=True, timeout=60)
     assert r2.returncode == 0 and 'winner' in r2.stdout
     assert 'linalg panel/block winners' in r2.stdout
+    assert 'matmul dtype winners' in r2.stdout
 
 
 def _jsonl_records(path):

@@ -121,6 +121,10 @@ QUEUE = [
     # sheds, priority preemption ordering, trainer co-location yield
     # with bit-identical params; tenant.* metrics land in the JSONL
     ('multitenant', 'multitenant', None, 700),
+    # training raw speed: bucketed-exact bit identity, backward/
+    # allreduce overlap fraction, fp8 matmul dispatch discipline,
+    # ZeRO-1 memory + bit identity, unified-MFU headline deltas
+    ('trainspeed', 'trainspeed', None, 900),
 ]
 
 # non-bench tools: (key, argv, timeout) — raw stdout lines stored

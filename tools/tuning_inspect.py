@@ -28,6 +28,12 @@ FORMAT_VERSION = 1   # mirrors paddle_tpu.tuning.table.FORMAT_VERSION
 # entries recorded by tuning.decide_summa_panel / decide_linalg_block.
 LINALG_OPS = ('summa_matmul', 'blocked_cholesky', 'blocked_qr')
 
+# Matmul compute-dtype entries (ISSUE 19): fp8(e4m3)-cast vs native,
+# recorded by tuning.decide_matmul_dtype. The winner decides whether
+# ops.fp8_matmul dispatches at that shape (PADDLE_TPU_FP8_MATMUL
+# overrides the table either way).
+MATMUL_DTYPE_OPS = ('matmul_dtype',)
+
 
 def _variant_label(variant):
     if not isinstance(variant, dict):
@@ -107,6 +113,25 @@ def inspect(path):
             }
         if fam:
             doc['linalg'][kind] = fam
+
+    # matmul dtype summary: where the tuner measured fp8 to win — the
+    # shapes at which fp8_matmul will actually dispatch off this table
+    doc['matmul_dtype'] = {}
+    for kind, rows in doc['tables'].items():
+        fam = {}
+        for key, e in rows.items():
+            if not key.startswith(MATMUL_DTYPE_OPS):
+                continue
+            variant = e.get('winner_variant') or {}
+            fam[key] = {
+                'op': key.split('|', 1)[0],
+                'shape': key.split('|')[1] if '|' in key else None,
+                'winner': variant.get('impl', e['winner']),
+                'margin_over_runner_up': e.get('margin_over_runner_up'),
+                'mode': e.get('mode'),
+            }
+        if fam:
+            doc['matmul_dtype'][kind] = fam
     return doc
 
 
@@ -144,6 +169,18 @@ def render(doc):
                               if '|' in key else '',
                               (' x%.2f vs runner-up' % margin)
                               if margin else '', e.get('mode')))
+    if doc.get('matmul_dtype'):
+        out.append('  matmul dtype winners')
+        for kind, fam in sorted(doc['matmul_dtype'].items()):
+            out.append('    [%s]' % kind)
+            for key, e in sorted(fam.items()):
+                margin = e.get('margin_over_runner_up')
+                out.append('      %-8s %-24s %s%s  (%s)'
+                           % (e.get('winner'), e.get('shape') or '',
+                              key.split('|')[2] if key.count('|') >= 2
+                              else '',
+                              (' x%.2f vs runner-up' % margin)
+                              if margin else '', e.get('mode')))
     return '\n'.join(out)
 
 
@@ -161,6 +198,9 @@ def main(argv=None):
                     help='only the distributed linear-algebra family '
                          '(summa_matmul / blocked_cholesky / '
                          'blocked_qr panel+block winners)')
+    ap.add_argument('--matmul-dtype', action='store_true',
+                    help='only the matmul compute-dtype entries '
+                         '(fp8 vs native winners per shape)')
     args = ap.parse_args(argv)
     doc = inspect(args.path)
     if args.device_kind is not None:
@@ -168,6 +208,9 @@ def main(argv=None):
                          if k == args.device_kind}
         doc['linalg'] = {k: v for k, v in doc.get('linalg', {}).items()
                          if k == args.device_kind}
+        doc['matmul_dtype'] = {
+            k: v for k, v in doc.get('matmul_dtype', {}).items()
+            if k == args.device_kind}
     if args.op:
         doc['tables'] = {
             kind: {key: e for key, e in rows.items()
@@ -177,6 +220,11 @@ def main(argv=None):
         doc['tables'] = {
             kind: {key: e for key, e in rows.items()
                    if key.startswith(LINALG_OPS)}
+            for kind, rows in doc.get('tables', {}).items()}
+    if args.matmul_dtype:
+        doc['tables'] = {
+            kind: {key: e for key, e in rows.items()
+                   if key.startswith(MATMUL_DTYPE_OPS)}
             for kind, rows in doc.get('tables', {}).items()}
     if args.json:
         json.dump(doc, sys.stdout, indent=1, sort_keys=True)
