@@ -24,7 +24,22 @@ started via ``observe.serve(port=...)`` or ``PADDLE_TPU_STATUSZ_PORT``
                dispatch/retry/shed counters), flight-recorder
                occupancy, health results
     /tracez    last N completed spans as JSON (?n=200), or ONE sampled
-               request's cross-thread timeline (?trace_id=<id>)
+               request's cross-thread timeline (?trace_id=<id>) — with
+               fleet replicas registered (observe.fleet) the trace_id
+               query federates to every replica and returns the merged
+               cross-PROCESS timeline, remote timestamps shifted onto
+               this clock by the estimated offset (&local=1 pins the
+               query to this process; that is how replicas are queried,
+               so federation cannot recurse)
+    /fleetz    the federated fleet view: per-replica scrape health,
+               the merged re-labeled registry snapshot, and derived
+               panels (queue-depth skew, cross-replica p99 spread,
+               handoff wire rate); /metrics?scope=fleet renders the
+               same merge as Prometheus text
+    /clockz    four-timestamp clock-exchange endpoint: answers with
+               its receive/send wall-clock stamps so the controller's
+               NTP-style estimator (observe.fleet.ClockOffsetEstimator)
+               can track this process's clock offset
     /healthz   200 ok / 503 degraded from the liveness health checks
                plus the anomaly monitor (degraded while any detector
                is tripped)
@@ -52,6 +67,7 @@ stays one ``enabled()`` boolean read, server or no server.
 
 import http.server
 import json
+import os
 import threading
 import time
 
@@ -507,18 +523,39 @@ def _tracez_doc(query):
         # (reqtrace.RequestContext tags them all)
         evs = [e for e in evs
                if (e.get('args') or {}).get('trace_id') == trace_id]
-        return {'trace_id': trace_id, 'spans': evs,
-                'threads': sorted({e.get('tid') for e in evs}),
-                'recorded': len(evs)}
+        doc = {'trace_id': trace_id, 'spans': evs,
+               'threads': sorted({e.get('tid') for e in evs}),
+               'recorded': len(evs)}
+        # federation: unless the caller pinned the query to this
+        # process (&local=1 — how WE query replicas, so a federating
+        # replica cannot recurse), fan out to every registered fleet
+        # replica and append its matching spans, timestamps shifted
+        # onto this process's clock by the estimated offset
+        if 'local' not in params:
+            from .fleet import fleet
+            fed = fleet()
+            if fed.replicas():
+                remote = fed.federated_trace(trace_id)
+                doc['spans'] = sorted(evs + remote['spans'],
+                                      key=lambda e: e.get('ts', 0.0))
+                doc['recorded'] = len(doc['spans'])
+                doc['sources'] = remote['sources']
+        return doc
     return {'spans': evs[-max(1, n):], 'recorded': len(evs),
             'dropped': getattr(rec, '_dropped', 0)}
 
 
 _INDEX = """paddle_tpu diagnostics server
 /metrics   Prometheus exposition of the metrics registry
+           (?scope=fleet: the federated fleet-wide merge)
 /varz      observe.snapshot() as JSON
 /statusz   run headline: uptime, cache keys, pipeline depth, MFU/goodput
-/tracez    last completed spans (?n=200)
+/tracez    last completed spans (?n=200); ?trace_id= federates to
+           registered fleet replicas unless &local=1
+/fleetz    federated fleet view: per-replica scrape health, merged
+           registry snapshot, derived panels (queue skew, p99 spread)
+/clockz    four-timestamp clock exchange endpoint (NTP-style offset
+           estimation by the controller)
 /healthz   liveness (503 while degraded / anomaly tripped)
 /readyz    readiness (all checks incl. readiness-only)
 """
@@ -546,8 +583,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if path in ('/', '/help'):
                 self._send(200, _INDEX, ctype='text/plain')
             elif path == '/metrics':
-                self._send(200, prometheus_exposition(snapshot()),
+                if 'scope=fleet' in query:
+                    from .fleet import fleet
+                    body = prometheus_exposition(
+                        fleet().merged_snapshot())
+                else:
+                    body = prometheus_exposition(snapshot())
+                self._send(200, body,
                            ctype='text/plain; version=0.0.4')
+            elif path == '/clockz':
+                # NTP-style exchange: the caller stamps t0 before the
+                # request and t3 after the reply; we answer with our
+                # receive/send wall-clock stamps (t1, t2)
+                t_recv = time.time()
+                self._send(200, json.dumps({'t_recv': t_recv,
+                                            't_send': time.time(),
+                                            'pid': os.getpid()}))
+            elif path == '/fleetz':
+                from .fleet import fleet
+                self._send(200, json.dumps(fleet().fleet_doc(),
+                                           sort_keys=True, default=str))
             elif path == '/varz':
                 self._send(200, json.dumps(snapshot(), sort_keys=True,
                                            default=str))
@@ -568,6 +623,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                             'routes': ['/metrics', '/varz',
                                                        '/statusz',
                                                        '/tracez',
+                                                       '/fleetz',
+                                                       '/clockz',
                                                        '/healthz',
                                                        '/readyz']}))
         except Exception as e:   # never kill the serving thread

@@ -42,7 +42,7 @@ import time
 import traceback
 
 __all__ = ['FlightRecorder', 'DEFAULT_CAPACITY', 'SCHEMA_VERSION',
-           'POSTMORTEM_KIND']
+           'POSTMORTEM_KIND', 'load_postmortem']
 
 DEFAULT_CAPACITY = 512
 SCHEMA_VERSION = 1
@@ -64,6 +64,23 @@ def _jsonable(v):
         except Exception:
             pass
     return str(v)
+
+
+def load_postmortem(path):
+    """Read a postmortem dump back — None when the file does not exist
+    (the worker died before its first dump) or is not a postmortem
+    (wrong kind / unreadable JSON). Dumps are written atomically, so a
+    file that exists is always whole; this is what the fleet controller
+    calls on heartbeat-loss to attach a dead replica's final seconds to
+    its heal event."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get('kind') != POSTMORTEM_KIND:
+        return None
+    return doc
 
 
 def _format_exception(exc):
@@ -126,13 +143,20 @@ class FlightRecorder(object):
                    anomalies=None, host=None, extra=None):
         """The postmortem document (see module docstring for schema)."""
         total, evicted = self.counts()
+        # host is jax.process_index() for trainers but a replica-name
+        # STRING for fleet workers (PADDLE_TPU_OBSERVE_HOST) — both
+        # must survive, or a worker's dump dies in int()
+        try:
+            host_v = 0 if host is None else int(host)
+        except (TypeError, ValueError):
+            host_v = str(host)
         doc = {
             'kind': POSTMORTEM_KIND,
             'schema': SCHEMA_VERSION,
             'reason': str(reason),
             'ts': round(time.time(), 6),
             'pid': os.getpid(),
-            'host': 0 if host is None else int(host),
+            'host': host_v,
             'uptime_seconds': round(time.time() - self.started_at, 6),
             'exception': _format_exception(exc),
             'events': self.events(),

@@ -29,7 +29,7 @@ import re
 import threading
 
 __all__ = ['Counter', 'Gauge', 'Histogram', 'Registry', 'RESERVOIR_CAP',
-           'parse_rendered', 'prometheus_exposition']
+           'parse_rendered', 'prometheus_exposition', 'relabel_snapshot']
 
 RESERVOIR_CAP = 4096
 
@@ -58,6 +58,31 @@ def parse_rendered(rendered):
         k, _, v = part.partition('=')
         labels[k] = v
     return name, labels
+
+
+def relabel_snapshot(snapshot, **labels):
+    """Return a copy of a Registry.snapshot()-shaped dict with ``labels``
+    merged into every rendered series name — the federation step that
+    turns N per-replica snapshots into one fleet view without series
+    collisions (``worker.queue_depth`` from replica r0 and r1 become
+    ``worker.queue_depth{host=...,replica=r0}`` / ``{...replica=r1}``).
+    Injected labels win on key conflict; non-metric top-level keys
+    (ts/pid/host/kind) pass through untouched; values are not copied
+    deeply — treat the result as read-only."""
+    out = {}
+    for kind, series in snapshot.items():
+        if kind not in ('counters', 'gauges', 'histograms') or \
+                not isinstance(series, dict):
+            out[kind] = series
+            continue
+        relabeled = {}
+        for rendered, v in series.items():
+            name, old = parse_rendered(rendered)
+            merged = dict(old)
+            merged.update(labels)
+            relabeled[_render(name, _label_key(merged))] = v
+        out[kind] = relabeled
+    return out
 
 
 # ------------------------------------------- Prometheus text exposition

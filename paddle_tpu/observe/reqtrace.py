@@ -18,7 +18,15 @@ request. ``RequestContext`` is the correlating handle:
   (per-token decode events),
 - thread hops are linked by Chrome-trace flow events (``ctx.flow_*``,
   spans.FlowHandle) so Perfetto draws the arrows and
-  ``/tracez?trace_id=`` reassembles the timeline server-side.
+  ``/tracez?trace_id=`` reassembles the timeline server-side,
+- PROCESS hops ride the wire form: ``ctx.to_wire()`` is a JSON-safe
+  dict (trace id, sampling bit, deadline as a *relative* remaining
+  budget, baggage) that serving/rpc.py injects into every RPC request
+  envelope and serving/handoff.py stamps into every KV packet header;
+  ``from_wire()`` reconstitutes the context at admission on the far
+  side, so controller-side and replica-side spans share one trace id
+  and the flow id (= the trace id) links the slices across (pid, tid)
+  tracks in a merged Perfetto file (tools/fleet_trace.py).
 
 Sampling: ``PADDLE_TPU_TRACE_SAMPLE`` (a fraction, read PER CALL —
 never at import) decides whether a request records spans; unsampled
@@ -36,7 +44,7 @@ import threading
 import time
 
 __all__ = ['RequestContext', 'new_context', 'sample_rate',
-           'TRACE_SAMPLE_ENV']
+           'from_wire', 'TRACE_SAMPLE_ENV']
 
 
 def _obs():
@@ -81,12 +89,14 @@ def _new_trace_id():
         return '%012x' % _rng.getrandbits(48)
 
 
-def new_context(route, deadline_s=None, sample=None):
+def new_context(route, deadline_s=None, sample=None, baggage=None):
     """Create the per-request context at admission. ``deadline_s`` is a
     relative budget (seconds from now); ``sample`` overrides the
     environment sampling fraction (pass 1.0/0.0 for deterministic
-    tests). A request is only ever sampled while telemetry is enabled —
-    spans would be dropped on the floor otherwise."""
+    tests); ``baggage`` is a small JSON-safe dict that rides the wire
+    form across process hops. A request is only ever sampled while
+    telemetry is enabled — spans would be dropped on the floor
+    otherwise."""
     rate = sample_rate() if sample is None else float(sample)
     if rate >= 1.0:
         sampled = True
@@ -101,22 +111,67 @@ def new_context(route, deadline_s=None, sample=None):
         route=route,
         deadline=(time.perf_counter() + float(deadline_s))
         if deadline_s is not None else None,
-        sampled=sampled)
+        sampled=sampled, baggage=baggage)
+
+
+def from_wire(doc, route=None):
+    """Reconstitute a :class:`RequestContext` from its ``to_wire()``
+    dict on the receiving side of a process hop. Returns None for a
+    falsy ``doc`` (the hop carried no trace). The trace id and baggage
+    survive verbatim; the *relative* ``deadline_s`` budget becomes an
+    absolute perf_counter deadline on THIS process's clock (wall-clock
+    skew between hosts never corrupts the budget); the sampling bit is
+    honored only while local telemetry is enabled — same contract as
+    admission. A sampled reconstituted context is pre-armed with a
+    flow handle (flow id = trace id), so ``flow_step``/``flow_end`` on
+    the receiving side link back to the sender's ``flow_begin``."""
+    if not doc:
+        return None
+    trace_id = doc.get('trace_id')
+    sampled = bool(doc.get('sampled')) and trace_id is not None \
+        and _enabled()
+    deadline_s = doc.get('deadline_s')
+    ctx = RequestContext(
+        trace_id=trace_id,
+        route=route if route is not None else doc.get('route'),
+        deadline=(time.perf_counter() + float(deadline_s))
+        if deadline_s is not None else None,
+        sampled=sampled, baggage=doc.get('baggage'))
+    if sampled:
+        from .spans import FlowHandle
+        ctx._flow = FlowHandle(int(trace_id, 16), 'rpc')
+    return ctx
 
 
 class RequestContext(object):
     """Identity + budget + recording surface for one request."""
 
     __slots__ = ('trace_id', 'route', 'deadline', 'sampled', 't_start',
-                 '_flow')
+                 'baggage', '_flow')
 
-    def __init__(self, trace_id, route, deadline, sampled):
+    def __init__(self, trace_id, route, deadline, sampled,
+                 baggage=None):
         self.trace_id = trace_id
         self.route = route
         self.deadline = deadline      # absolute perf_counter, or None
         self.sampled = sampled
         self.t_start = time.perf_counter()
+        self.baggage = dict(baggage) if baggage else None
         self._flow = None
+
+    # ----------------------------------------------------------- wire
+    def to_wire(self):
+        """JSON-safe wire form for a process hop: trace id, sampling
+        bit, the deadline converted to a RELATIVE remaining budget
+        (absolute perf_counter values are meaningless in another
+        process), the route, and the baggage dict. Always returns a
+        dict — the sender decides whether to attach it."""
+        remaining = self.remaining()
+        return {'trace_id': self.trace_id,
+                'sampled': bool(self.sampled),
+                'deadline_s': remaining,
+                'route': self.route,
+                'baggage': self.baggage}
 
     # ------------------------------------------------------------ budget
     def remaining(self):

@@ -35,10 +35,75 @@ import sys
 import threading
 import time
 
-__all__ = ['Objective', 'SloTracker', 'DEFAULT_WINDOW_S']
+__all__ = ['Objective', 'SloTracker', 'DEFAULT_WINDOW_S',
+           'fleet_derived']
 
 DEFAULT_WINDOW_S = 60.0
 SLOWEST_K = 5
+
+
+def fleet_derived(per_replica, prev=None, dt_s=None):
+    """Fleet-level derived panels over per-replica registry snapshots
+    (``{replica_name: Registry.snapshot()-shaped dict}`` — raw, NOT
+    re-labeled). Pure function of its inputs so it works against live
+    /fleetz scrapes and replayed JSONL alike. Panels:
+
+    - ``queue_depth`` — each replica's ``worker.queue_depth`` gauge,
+      plus the skew (max − min) and mean: a hot replica shows up as
+      skew, not as a fleet-average blur.
+    - ``p99_spread_s`` — per-replica p99 over every ``*.request_seconds``
+      histogram (worst label set per replica), and the cross-replica
+      spread (max − min): the disagg-tuning number PAPERS' serving
+      writeups watch.
+    - ``handoff_bytes_per_s`` — fleet KV-handoff wire rate, computed
+      from ``handoff.bytes_total`` deltas when a previous snapshot
+      dict and ``dt_s`` are given (None otherwise; the totals are
+      always reported).
+    """
+    from .registry import parse_rendered
+    depths, p99s = {}, {}
+    bytes_now = 0.0
+    for name, snap in sorted((per_replica or {}).items()):
+        gauges = snap.get('gauges', {}) or {}
+        for rendered, v in gauges.items():
+            if parse_rendered(rendered)[0] == 'worker.queue_depth':
+                depths[name] = v
+        worst = None
+        for rendered, st in (snap.get('histograms', {}) or {}).items():
+            if not isinstance(st, dict):
+                continue
+            if parse_rendered(rendered)[0].endswith('.request_seconds'):
+                p = st.get('p99')
+                if p is not None and (worst is None or p > worst):
+                    worst = p
+        if worst is not None:
+            p99s[name] = worst
+        for rendered, v in (snap.get('counters', {}) or {}).items():
+            if parse_rendered(rendered)[0] == 'handoff.bytes_total':
+                bytes_now += v
+    rate = None
+    if prev is not None and dt_s:
+        bytes_prev = 0.0
+        for snap in (prev or {}).values():
+            for rendered, v in (snap.get('counters', {}) or {}).items():
+                if parse_rendered(rendered)[0] == 'handoff.bytes_total':
+                    bytes_prev += v
+        rate = max(0.0, bytes_now - bytes_prev) / float(dt_s)
+    dvals = [v for v in depths.values() if isinstance(v, (int, float))]
+    pvals = list(p99s.values())
+    return {
+        'queue_depth': {
+            'per_replica': depths,
+            'skew': (max(dvals) - min(dvals)) if dvals else None,
+            'mean': (sum(dvals) / len(dvals)) if dvals else None,
+        },
+        'p99_spread_s': {
+            'per_replica': p99s,
+            'spread': (max(pvals) - min(pvals)) if pvals else None,
+        },
+        'handoff_bytes_per_s': rate,
+        'handoff_bytes_total': bytes_now,
+    }
 
 
 class Objective(object):

@@ -78,6 +78,7 @@ class SpanRecorder(object):
         # threads and aligns with the jax trace clock reasonably well
         self._epoch0 = time.time() - time.perf_counter()
         self._flow_ids = 0
+        self._proc_labels = set()
 
     # ---------------------------------------------------------- record
     def begin(self, name, attrs=None, bridge_jax=True):
@@ -196,6 +197,21 @@ class SpanRecorder(object):
             ev['args'] = dict(attrs)
         self._append(ev)
 
+    # -------------------------------------------------- process metadata
+    def set_process_name(self, label):
+        """Record a Chrome-trace ``process_name`` metadata event so this
+        process's track carries a human label ('controller', 'r0', ...)
+        in a merged fleet view (tools/fleet_trace.py) instead of a bare
+        pid. Idempotent per label — the heartbeat loop may call it every
+        tick without flooding the ring."""
+        with self._lock:
+            if label in self._proc_labels:
+                return
+            self._proc_labels.add(label)
+        self._append({'name': 'process_name', 'ph': 'M',
+                      'pid': os.getpid(), 'tid': threading.get_ident(),
+                      'args': {'name': str(label)}})
+
     # ---------------------------------------------------------- export
     def events(self):
         with self._lock:
@@ -205,6 +221,7 @@ class SpanRecorder(object):
         with self._lock:
             self._events = []
             self._dropped = 0
+            self._proc_labels = set()
 
     def chrome_trace(self):
         """Chrome trace JSON object (dict) of all completed spans."""

@@ -165,7 +165,7 @@ class _Lineage(object):
     underneath is replaced."""
 
     __slots__ = ('base', 'deaths', 'restarts', 'next_restart_at',
-                 'quarantined_until', 'pending_heal')
+                 'quarantined_until', 'pending_heal', 'last_postmortem')
 
     def __init__(self, base):
         self.base = base
@@ -174,6 +174,9 @@ class _Lineage(object):
         self.next_restart_at = 0.0
         self.quarantined_until = None
         self.pending_heal = False
+        # the dead replica's last flight-recorder dump (pulled at
+        # death, attached to the heal event) — its final seconds
+        self.last_postmortem = None
 
 
 class _Record(object):
@@ -388,12 +391,30 @@ class FleetController(object):
                                  self.backoff_base_s)
                       * (2.0 ** lin.restarts))
         lin.next_restart_at = now + backoff
+        # postmortem aggregation: pull the dead replica's last flight
+        # dump NOW (a SIGTERMed worker dumped on the way down; a
+        # SIGKILLed one left its last heartbeat snapshot) and stash it
+        # on the lineage — the heal event carries it forward
+        pm = None
+        pm_fn = getattr(rec.replica, 'postmortem', None)
+        if callable(pm_fn):
+            try:
+                pm = pm_fn()
+            except Exception:
+                pm = None
+        if pm is not None:
+            lin.last_postmortem = pm
+            _obs.inc('controller.postmortems_total', route=self.route,
+                     lineage=lin.base)
         _obs.inc('controller.deaths_total', route=self.route,
                  replica=rec.name)
         _obs.flight_event('controller_replica_dead', replica=rec.name,
                           lineage=lin.base, route=self.route,
                           restarts=lin.restarts,
-                          backoff_s=round(backoff, 4))
+                          backoff_s=round(backoff, 4),
+                          postmortem_reason=(pm or {}).get('reason'),
+                          postmortem_events=len((pm or {})
+                                                .get('events') or []))
         try:
             self.router.remove_replica(rec.name)
         except KeyError:
@@ -434,6 +455,20 @@ class FleetController(object):
                 self._drop_dead_records(lin)
                 _obs.inc('controller.heals_total', route=self.route,
                          lineage=lin.base)
+                # the heal event carries the dead predecessor's final
+                # seconds: reason + last ring events from the pulled
+                # postmortem (chaos suites assert this linkage)
+                pm, lin.last_postmortem = lin.last_postmortem, None
+                _obs.flight_event(
+                    'controller_heal', lineage=lin.base,
+                    route=self.route, restarts=lin.restarts,
+                    postmortem_reason=(pm or {}).get('reason'),
+                    postmortem_pid=(pm or {}).get('pid'),
+                    postmortem_events=len((pm or {})
+                                          .get('events') or []),
+                    postmortem_last_kinds=[
+                        e.get('kind') for e in
+                        ((pm or {}).get('events') or [])[-5:]])
 
     def _drop_dead_records(self, lin):
         """Forget a lineage's dead predecessors once a replacement is

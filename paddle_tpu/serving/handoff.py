@@ -296,6 +296,11 @@ def install_packet(engine, packet):
     the chain in its radix prefix cache. Returns
     ``(covered_tokens, installed_pages, dedup_pages)``.
 
+    A packet whose header carries a ``trace`` entry (the exporting
+    side's reqtrace wire form) gets its install spanned under that
+    trace_id — the KV hop shows up on the installing process's track
+    in the merged fleet timeline.
+
     Dedup across the handoff boundary: the packet's chain is first
     walked against the destination cache — pages already resident
     (earlier handoff of the same system prompt, or local traffic) are
@@ -361,6 +366,14 @@ def install_packet(engine, packet):
             pool.free(ours)
     covered_tokens = len(chain_tokens)
     dedup = have
+    ctx = None
+    if packet.header.get('trace'):
+        from ..observe import reqtrace as _reqtrace
+        ctx = _reqtrace.from_wire(packet.header['trace'])
+    if ctx is not None:
+        ctx.stage('kv_install', t0, time.perf_counter(),
+                  pages=installed, dedup=dedup,
+                  covered_tokens=covered_tokens)
     if _obs.enabled():
         _obs.record('handoff.install_seconds',
                     time.perf_counter() - t0)
@@ -373,7 +386,7 @@ def install_packet(engine, packet):
     return covered_tokens, installed, dedup
 
 
-def handoff(src_engine, dst_engine, tokens, via_bytes=True):
+def handoff(src_engine, dst_engine, tokens, via_bytes=True, ctx=None):
     """The whole hop: export from ``src_engine``, (optionally) round-
     trip through the wire encoding, install into ``dst_engine``.
     Returns the covered token count (0 when nothing was cached to
@@ -386,7 +399,12 @@ def handoff(src_engine, dst_engine, tokens, via_bytes=True):
     by default (handoff_verify_enabled('socket')) — and the install
     runs on the destination WORKER against its own prefix cache, so
     the dedup-against-destination path is identical to the in-process
-    hop: shared prefixes still ship once per decode host."""
+    hop: shared prefixes still ship once per decode host.
+
+    ``ctx`` (a reqtrace.RequestContext, when the hop belongs to a
+    traced request) is stamped into the packet header as its wire
+    form, so whichever process performs the install — this one or a
+    remote worker — spans it under the same trace_id."""
     t0 = time.perf_counter()
     remote_src = callable(getattr(src_engine, 'export_packet_bytes',
                                   None))
@@ -394,7 +412,9 @@ def handoff(src_engine, dst_engine, tokens, via_bytes=True):
                                   None))
     transport = 'socket' if (remote_src or remote_dst) else 'inproc'
     if remote_src:
-        data = src_engine.export_packet_bytes(tokens)
+        data = (src_engine.export_packet_bytes(tokens, ctx=ctx)
+                if ctx is not None
+                else src_engine.export_packet_bytes(tokens))
         if not data:
             return 0
         pkt = KVPacket.from_bytes(data)
@@ -402,6 +422,8 @@ def handoff(src_engine, dst_engine, tokens, via_bytes=True):
         pkt = export_packet(src_engine, tokens)
         if pkt is None:
             return 0
+        if ctx is not None:
+            pkt.header['trace'] = ctx.to_wire()
     wire = pkt.wire_bytes()
     if remote_dst:
         covered, installed, dedup = dst_engine.install_packet_bytes(

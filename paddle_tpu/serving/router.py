@@ -1159,7 +1159,7 @@ class PhaseRouter(object):
                     src_name, src_eng, t0_pf = src
                     covered = _handoff.handoff(
                         src_eng, eng, req['prompt'],
-                        via_bytes=self.via_bytes)
+                        via_bytes=self.via_bytes, ctx=ctx)
                     # TTFT attribution: prefill + handoff is the part
                     # the PHASE SPLIT added ahead of the decode
                     # replica's (small) suffix prefill

@@ -38,6 +38,18 @@ engine protocol both sides already speak:
   and reaps the corpse, so the controller's lineage/backoff/quarantine
   machinery governs real PIDs.
 
+**Fleet observability** rides the same wires: a request carrying a
+``reqtrace.RequestContext`` ships its wire form (``ctx.to_wire()``) in
+the submit/generate envelope and the KV-export request, the worker
+reconstitutes it at admission (``reqtrace.from_wire``) so both
+processes span under ONE trace_id linked by Chrome-trace flow events;
+``ready()`` piggybacks an NTP-style /clockz exchange (EWMA offset,
+``rpc.clock_offset_seconds`` gauge, ``clock_offset()``) so merged
+traces can shift replica timestamps onto the controller clock; the
+factory wires each worker a controller-known flight-dump path
+(``postmortem()`` reads it back, SIGKILL included) and registers the
+replica with ``observe.fleet`` for /varz scraping + federated /tracez.
+
 Env knobs are read per call (this file is in tools/repo_lint.py's
 ENV_SCOPED_FILES). Typed errors cross the wire as a JSON envelope
 ``{"error": {"type", "message"}}`` and are re-raised as the same class
@@ -65,6 +77,7 @@ import numpy as np
 
 from .. import observe as _obs
 from ..observe import diagnostics as _diag
+from ..observe import reqtrace as _reqtrace
 from .engine import EngineClosedError, QueueFullError
 
 __all__ = ['RemoteReplica', 'RemoteReplicaError', 'RemoteCallError',
@@ -259,10 +272,21 @@ def serve_engine(engine, prefix='/rpc', on_shutdown=None):
 
     def h_submit(h, body):
         meta, feed = unpack_arrays(body)
+        # reconstitute the caller's trace context from the envelope
+        # (None when the hop carried none): the replica-side spans land
+        # under the SAME trace_id, and the pre-armed flow handle links
+        # them back to the controller's flow_begin
+        ctx = _reqtrace.from_wire(meta.get('trace'))
+        t_in = time.perf_counter()
         # admission runs HERE, synchronously: QueueFullError /
         # EngineClosedError / ValueError travel back as the HTTP
         # status before any compute happens
-        fut = engine.submit(feed, deadline_s=meta.get('deadline_s'))
+        if ctx is not None:
+            ctx.flow_step()
+            ctx.event('rpc_admitted', replica=str(engine.name))
+            fut = engine.submit(feed, ctx=ctx)
+        else:
+            fut = engine.submit(feed, deadline_s=meta.get('deadline_s'))
         _ack_stream(h)
         try:
             outs = fut.result()
@@ -273,11 +297,20 @@ def serve_engine(engine, prefix='/rpc', on_shutdown=None):
         except Exception as e:
             _obs.inc('rpc.errors_total', type=type(e).__name__)
             payload = pack_arrays(_error_doc(e), {})
+        if ctx is not None:
+            ctx.stage('rpc_execute', t_in, time.perf_counter(),
+                      replica=str(engine.name))
+            ctx.flow_end()
         h.wfile.write(payload)
         h.wfile.flush()
 
     def h_generate(h, body):
         req = json.loads(body.decode()) if body else {}
+        ctx = _reqtrace.from_wire(req.get('trace'))
+        t_in = time.perf_counter()
+        if ctx is not None:
+            ctx.flow_step()
+            ctx.event('rpc_admitted', replica=str(engine.name))
         stream = engine.submit(
             [int(t) for t in req.get('prompt', [])],
             max_new_tokens=int(req.get('max_new_tokens', 16)),
@@ -285,13 +318,18 @@ def serve_engine(engine, prefix='/rpc', on_shutdown=None):
             seed=int(req.get('seed', 0)),
             eos_id=req.get('eos_id'),
             tenant=req.get('tenant'),
-            priority=req.get('priority'))
+            priority=req.get('priority'),
+            ctx=ctx)
         _ack_stream(h)
         try:
             for tok in stream:
                 h.wfile.write(_frame({'token': int(tok)}))
                 h.wfile.flush()
             tokens = stream.result()
+            if ctx is not None:
+                ctx.stage('rpc_execute', t_in, time.perf_counter(),
+                          replica=str(engine.name), tokens=len(tokens))
+                ctx.flow_end()
             h.wfile.write(_frame({'done': True,
                                   'finish_reason': stream.finish_reason,
                                   'tokens': [int(t) for t in tokens]}))
@@ -339,6 +377,11 @@ def serve_engine(engine, prefix='/rpc', on_shutdown=None):
         req = json.loads(body.decode()) if body else {}
         pkt = export_packet(engine, [int(t) for t in
                                      req.get('tokens', [])])
+        if pkt is not None and req.get('trace'):
+            # the trace context rides the packet header so the
+            # INSTALLING side (another process entirely) can span its
+            # kv_install under the originating trace_id
+            pkt.header['trace'] = req['trace']
         data = b'' if pkt is None else pkt.to_bytes(transport='socket')
         h.close_connection = True
         h.send_response(200)
@@ -393,7 +436,8 @@ class RemoteReplica(object):
                  heartbeat_timeout_s=2.0, ready_ttl_s=0.2,
                  state_ttl_s=0.05, reconnect_tries=3,
                  backoff_base_s=0.05, backoff_max_s=1.0,
-                 max_inflight=8, clock=None, sleep=None):
+                 max_inflight=8, clock=None, sleep=None,
+                 clock_sync_every_s=1.0, postmortem_path=None):
         url = url.rstrip('/')
         hostport = url.split('://', 1)[-1]
         host, _, port = hostport.rpartition(':')
@@ -422,15 +466,21 @@ class RemoteReplica(object):
         self._ready_cache = (None, False)     # (asof, ok)
         self._state_cache = (None, {})        # (asof, doc)
         self._geometry = None
+        self.clock_sync_every_s = float(clock_sync_every_s)
+        self.postmortem_path = postmortem_path
+        self._clock_est = None                # lazy ClockOffsetEstimator
+        self._clock_sync_at = None
 
     # --------------------------------------------------------- transport
-    def _connect(self, timeout=None):
+    def _connect(self, timeout=None, force=False):
         """One TCP connect with bounded exponential-backoff retries.
         Raises RemoteReplicaError after ``reconnect_tries`` failures —
-        the typed 'this replica is gone' the router failovers on."""
+        the typed 'this replica is gone' the router failovers on.
+        ``force`` connects even after close — the /shutdown RPC itself
+        must go out AFTER ``_closed`` flips (which fences new work)."""
         last = None
         for i in range(self.reconnect_tries):
-            if self._closed:
+            if self._closed and not force:
                 raise RemoteReplicaError(
                     'RemoteReplica %r is shut down' % self.name)
             conn = http.client.HTTPConnection(
@@ -454,12 +504,12 @@ class RemoteReplica(object):
                           last))
 
     def _start_request(self, path, body, read_timeout,
-                       ctype='application/octet-stream'):
+                       ctype='application/octet-stream', force=False):
         """POST and read status+headers (the admission phase). Returns
         (conn, resp) with the socket timeout already widened to
         ``read_timeout`` for the body. Non-200 responses are consumed
         and re-raised typed."""
-        conn = self._connect()
+        conn = self._connect(force=force)
         # Connection: close responses hand the socket over to the
         # response object (conn.sock goes None inside getresponse), so
         # keep our own reference to retime reads for the body phase
@@ -492,12 +542,12 @@ class RemoteReplica(object):
         return conn, resp
 
     def _call(self, path, body=b'', read_timeout=None,
-              ctype='application/json'):
+              ctype='application/json', force=False):
         """One-shot JSON RPC: POST, read the whole body, parse."""
         conn, resp = self._start_request(
             path, body,
             read_timeout if read_timeout is not None
-            else self.read_timeout_s, ctype=ctype)
+            else self.read_timeout_s, ctype=ctype, force=force)
         try:
             data = resp.read()
         except (OSError, socket.timeout,
@@ -511,10 +561,11 @@ class RemoteReplica(object):
             conn.close()
         return data
 
-    def _call_json(self, path, doc=None, read_timeout=None):
+    def _call_json(self, path, doc=None, read_timeout=None,
+                   force=False):
         data = self._call(
             path, json.dumps(doc or {}).encode(),
-            read_timeout=read_timeout)
+            read_timeout=read_timeout, force=force)
         try:
             return json.loads(data.decode())
         except ValueError:
@@ -535,9 +586,20 @@ class RemoteReplica(object):
             return self._generate(feed, ctx=ctx, **gen_kw)
         if deadline_s is None and ctx is not None:
             deadline_s = ctx.remaining()
-        body = pack_arrays({'deadline_s': deadline_s}, dict(feed))
+        meta = {'deadline_s': deadline_s}
+        if ctx is not None:
+            # trace context crosses the process boundary in the
+            # envelope; the flow arrow starts HERE so the worker's
+            # flow_step draws controller→replica in the merged view
+            meta['trace'] = ctx.to_wire()
+            ctx.flow_begin('rpc_hop')
+        t0 = time.perf_counter()
+        body = pack_arrays(meta, dict(feed))
         conn, resp = self._start_request('/submit', body,
                                          self.read_timeout_s)
+        if ctx is not None:
+            ctx.stage('rpc_admission', t0, time.perf_counter(),
+                      replica=self.name)
         fut = Future()
         fut.set_running_or_notify_cancel()
         self._pool.submit(self._read_submit_result, conn, resp, fut)
@@ -575,15 +637,23 @@ class RemoteReplica(object):
     def _generate(self, prompt, ctx=None, max_new_tokens=16,
                   temperature=0.0, seed=0, eos_id=None, tenant=None,
                   priority=None):
-        body = json.dumps({
+        doc = {
             'prompt': [int(t) for t in prompt],
             'max_new_tokens': int(max_new_tokens),
             'temperature': float(temperature), 'seed': int(seed),
             'eos_id': eos_id, 'tenant': tenant,
-            'priority': priority}).encode()
+            'priority': priority}
+        if ctx is not None:
+            doc['trace'] = ctx.to_wire()
+            ctx.flow_begin('rpc_hop')
+        body = json.dumps(doc).encode()
+        t0 = time.perf_counter()
         conn, resp = self._start_request('/generate', body,
                                          self.read_timeout_s,
                                          ctype='application/json')
+        if ctx is not None:
+            ctx.stage('rpc_admission', t0, time.perf_counter(),
+                      replica=self.name)
         stream = RemoteStream(self.name, len(prompt))
         self._pool.submit(self._read_stream, conn, resp, stream)
         return stream
@@ -647,6 +717,12 @@ class RemoteReplica(object):
         ok = self._probe_readyz()
         with self._mu:
             self._ready_cache = (now, ok)
+        if ok:
+            # piggyback clock alignment on the heartbeat: only after a
+            # SUCCESSFUL probe (a half-dead worker must not eat extra
+            # connections), throttled to one exchange per
+            # clock_sync_every_s
+            self._maybe_sync_clock(now)
         return ok
 
     def _probe_readyz(self):
@@ -663,6 +739,62 @@ class RemoteReplica(object):
             return False
         finally:
             conn.close()
+
+    def _maybe_sync_clock(self, now):
+        """One NTP-style four-timestamp exchange against the worker's
+        /clockz (t0 send / t1 recv / t2 send / t3 recv), folded into
+        the EWMA estimator and published as the
+        ``rpc.clock_offset_seconds{replica=}`` gauge. Any failure is
+        silent — clock alignment is advisory, never on the request
+        path."""
+        with self._mu:
+            if self._clock_sync_at is not None and \
+                    now - self._clock_sync_at < self.clock_sync_every_s:
+                return
+            self._clock_sync_at = now
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self.heartbeat_timeout_s)
+        try:
+            t0 = time.time()
+            conn.request('GET', '/clockz')
+            resp = conn.getresponse()
+            data = resp.read()
+            t3 = time.time()
+            if resp.status != 200:
+                return
+            doc = json.loads(data.decode())
+            t1, t2 = float(doc['t_recv']), float(doc['t_send'])
+        except (OSError, socket.timeout, ValueError, KeyError,
+                TypeError, http.client.HTTPException):
+            return                   # pre-/clockz server or torn reply
+        finally:
+            conn.close()
+        from ..observe.fleet import ClockOffsetEstimator
+        with self._mu:
+            if self._clock_est is None:
+                self._clock_est = ClockOffsetEstimator()
+            off = self._clock_est.update(t0, t1, t2, t3)
+        _obs.set_gauge('rpc.clock_offset_seconds', off,
+                       replica=self.name)
+
+    def clock_offset(self):
+        """EWMA-smoothed wall-clock offset of the worker relative to
+        this process (worker − local, seconds) — None before the first
+        successful /clockz exchange. tools/fleet_trace.py and the
+        federated /tracez shift replica span timestamps by this."""
+        est = self._clock_est
+        return est.offset() if est is not None else None
+
+    def postmortem(self):
+        """The worker's last flight-recorder dump (SIGTERM dump or
+        periodic heartbeat snapshot) parsed from ``postmortem_path`` —
+        None when no path was configured or no dump exists yet. This
+        survives SIGKILL: the worker re-dumps on a heartbeat cadence,
+        so the controller can read a dead replica's final seconds."""
+        if not self.postmortem_path:
+            return None
+        from ..observe.flight import load_postmortem
+        return load_postmortem(self.postmortem_path)
 
     def _state(self):
         now = self._clock()
@@ -713,13 +845,16 @@ class RemoteReplica(object):
         return self.proc.pid if self.proc is not None else None
 
     # ------------------------------------------------------- KV handoff
-    def export_packet_bytes(self, tokens):
+    def export_packet_bytes(self, tokens, ctx=None):
         """serving.handoff duck-type: the worker exports + serializes
         (sha1-stamped, socket default) and this returns the raw packet
-        bytes — b'' when nothing was cached to ship."""
-        return self._call('/kv/export',
-                          json.dumps({'tokens': [int(t) for t
-                                                 in tokens]}).encode())
+        bytes — b'' when nothing was cached to ship. ``ctx`` (when
+        given) rides the request so the exported packet's header
+        carries the trace context to the installing side."""
+        doc = {'tokens': [int(t) for t in tokens]}
+        if ctx is not None:
+            doc['trace'] = ctx.to_wire()
+        return self._call('/kv/export', json.dumps(doc).encode())
 
     def install_packet_bytes(self, data):
         """serving.handoff duck-type: install on the WORKER, against
@@ -758,11 +893,17 @@ class RemoteReplica(object):
         SIGKILL anything still alive (a hung/stopped corpse), and
         reap it so no zombie outlives the fleet."""
         self._closed = True
+        from ..observe.fleet import fleet as _fleet
+        _fleet().unregister(self.name)
         try:
+            # force: _closed is already set (fencing new submits), but
+            # THIS call must still reach the worker — otherwise every
+            # shutdown degrades to the SIGKILL path and the worker
+            # never exports its trace/flight files
             self._call_json('/shutdown', {'drain': bool(drain)},
                             read_timeout=(self.read_timeout_s
                                           if timeout is None
-                                          else timeout))
+                                          else timeout), force=True)
         except (RemoteReplicaError, RemoteCallError):
             pass                     # already dead/unreachable: fall through
         if self.proc is not None:
@@ -892,6 +1033,17 @@ class ProcessReplicaFactory(object):
         cfg['port_file'] = port_file
         cfg.setdefault('metrics_jsonl', self._worker_jsonl(name))
         cfg.setdefault('host_label', name)
+        # controller-known postmortem + trace paths: the worker dumps
+        # its flight ring here on SIGTERM and on a heartbeat cadence
+        # (so SIGKILL still leaves a recent snapshot), and exports its
+        # span recorder here on exit — tools/fleet_trace.py merges the
+        # per-process trace files into one Perfetto view
+        cfg.setdefault('flight_dump',
+                       os.path.join(self.workdir,
+                                    '%s.flight.json' % name))
+        cfg.setdefault('trace_json',
+                       os.path.join(self.workdir,
+                                    '%s.trace.json' % name))
         cfg_path = os.path.join(self.workdir, '%s.json' % name)
         with open(cfg_path, 'w') as f:
             json.dump(cfg, f, sort_keys=True)
@@ -942,7 +1094,8 @@ class ProcessReplicaFactory(object):
             connect_timeout_s=self.connect_timeout_s,
             admission_timeout_s=self.admission_timeout_s,
             read_timeout_s=self.read_timeout_s,
-            max_inflight=self.max_inflight)
+            max_inflight=self.max_inflight,
+            postmortem_path=cfg['flight_dump'])
         while time.perf_counter() < deadline:
             if rep.ready():
                 break
@@ -963,6 +1116,11 @@ class ProcessReplicaFactory(object):
         _obs.flight_event('rpc_worker_spawned', replica=name,
                           pid=proc.pid, url=doc['url'],
                           seconds=round(spawn_s, 3))
+        # every live worker joins the metrics federation: the fleet
+        # poller scrapes its /varz and the controller's /fleetz +
+        # federated /tracez see it (shutdown unregisters)
+        from ..observe.fleet import fleet as _fleet
+        _fleet().register(rep, name=name)
         with self._mu:
             self._replicas[name] = rep
         return rep

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Merge per-process span exports into one fleet-wide Perfetto trace.
+
+Every process in a cross-host serving fleet (controller, replica
+workers) exports its own chrome-trace JSON — the controller via
+``observe.disable()`` / ``observe.export_trace``, each worker via its
+``trace_json`` config key. Those files share a wall-clock timebase
+only approximately: replica clocks drift, and a handoff span that
+*follows* an RPC admission span can render *before* it if the replica
+clock runs early. This tool merges N trace files into ONE Perfetto
+file, applying a per-input clock offset (as estimated by the
+controller's NTP-style heartbeat exchange — ``rpc.clock_offset_seconds``
+gauge, or ``RemoteReplica.clock_offset()``) so every track sits on the
+controller's timebase, with each process on its own named (pid, tid)
+track::
+
+    python tools/fleet_trace.py \
+        --input controller.trace.json \
+        --input r0=r0.trace.json:0.0032 \
+        --input r1=r1.trace.json:-0.0011 \
+        --output fleet.trace.json
+
+Input spec: ``[label=]path[:offset_s]``. The offset is the replica's
+clock offset relative to the controller in SECONDS (positive = replica
+clock ahead); every event's ``ts`` is shifted by ``-offset*1e6`` µs.
+Accepted file shapes: a chrome-trace doc (``{"traceEvents": [...]}``),
+an ``/tracez`` doc (``{"spans": [...]}``), or a bare event list.
+
+Because controller-side and replica-side spans of one request share a
+trace_id-derived flow id (``reqtrace`` wire propagation), the merged
+file renders the full cross-process request path as one connected flow
+in Perfetto / chrome://tracing.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ['merge_traces', 'load_trace_events', 'parse_input_spec']
+
+
+def load_trace_events(doc):
+    """Extract the event list from any of the accepted trace shapes."""
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict):
+        if isinstance(doc.get('traceEvents'), list):
+            return doc['traceEvents']
+        if isinstance(doc.get('spans'), list):
+            return doc['spans']
+    raise ValueError('unrecognized trace shape: expected a list, '
+                     '{"traceEvents": [...]}, or {"spans": [...]}')
+
+
+def parse_input_spec(spec):
+    """``[label=]path[:offset_s]`` -> (label_or_None, path, offset_s).
+
+    The offset suffix must parse as a float; a Windows-style drive
+    colon would not, so ``C:\\x.json`` stays a path.
+    """
+    label = None
+    if '=' in spec:
+        label, spec = spec.split('=', 1)
+        label = label or None
+    offset = 0.0
+    if ':' in spec:
+        head, tail = spec.rsplit(':', 1)
+        try:
+            offset = float(tail)
+        except ValueError:
+            head = spec
+        spec = head
+    return label, spec, offset
+
+
+def merge_traces(inputs):
+    """Merge [(label, events, offset_s), ...] into one chrome-trace doc.
+
+    Per input: shift every event's ``ts`` by ``-offset_s*1e6`` µs onto
+    the common (controller) timebase, remap colliding pids (two workers
+    on different hosts can share a pid) to unique ones, and inject an
+    ``M``/process_name metadata event when the input is labeled so each
+    process gets a named track in Perfetto. Events sorted by ts.
+    """
+    merged = []
+    used_pids = {}     # (input_index, orig_pid) -> merged pid
+    taken = set()
+    next_pid = [1]
+
+    def _alloc(idx, pid):
+        key = (idx, pid)
+        got = used_pids.get(key)
+        if got is not None:
+            return got
+        cand = pid
+        while cand in taken:
+            cand = next_pid[0]
+            next_pid[0] += 1
+        taken.add(cand)
+        used_pids[key] = cand
+        return cand
+
+    for idx, (label, events, offset_s) in enumerate(inputs):
+        shift_us = float(offset_s or 0.0) * 1e6
+        named_pids = set()
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            out = dict(ev)
+            pid = _alloc(idx, out.get('pid', 0))
+            out['pid'] = pid
+            if 'ts' in out and out.get('ph') != 'M':
+                try:
+                    out['ts'] = float(out['ts']) - shift_us
+                except (TypeError, ValueError):
+                    pass
+            if label and out.get('ph') != 'M':
+                args = dict(out.get('args') or {})
+                args.setdefault('replica', label)
+                out['args'] = args
+            if label and pid not in named_pids:
+                named_pids.add(pid)
+                merged.append({'name': 'process_name', 'ph': 'M',
+                               'pid': pid, 'tid': out.get('tid', 0),
+                               'args': {'name': label}})
+            merged.append(out)
+    merged.sort(key=lambda e: (e.get('ph') != 'M',
+                               float(e.get('ts', 0) or 0)))
+    return {'traceEvents': merged, 'displayTimeUnit': 'ms'}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--input', action='append', default=[],
+                    metavar='[LABEL=]PATH[:OFFSET_S]',
+                    help='trace file; optional track label and clock '
+                         'offset in seconds (positive = that clock '
+                         'runs ahead of the controller)')
+    ap.add_argument('--output', required=True,
+                    help='merged Perfetto JSON path')
+    args = ap.parse_args(argv)
+    if not args.input:
+        ap.error('at least one --input is required')
+
+    inputs = []
+    for spec in args.input:
+        label, path, offset = parse_input_spec(spec)
+        with open(path) as f:
+            events = load_trace_events(json.load(f))
+        if label is None:
+            label = os.path.splitext(os.path.basename(path))[0]
+        inputs.append((label, events, offset))
+
+    doc = merge_traces(inputs)
+    with open(args.output, 'w') as f:
+        json.dump(doc, f)
+    print('wrote %s (%d events from %d inputs)'
+          % (args.output, len(doc['traceEvents']), len(inputs)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

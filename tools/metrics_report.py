@@ -396,6 +396,10 @@ def derive_fleet(records):
     # heartbeats worker.up / worker.ready / worker.queue_depth into its
     # own JSONL (host = replica name); the newest record per host wins
     workers = {}
+    # controller-estimated per-replica clock offsets (the NTP-style
+    # heartbeat exchange) — the numbers tools/fleet_trace.py wants as
+    # its per-input :OFFSET_S suffixes
+    clock_offsets = {}
     for rec in records:
         doc = None
         for rendered, v in rec.get('gauges', {}).items():
@@ -404,13 +408,20 @@ def derive_fleet(records):
                 if doc is None:
                     doc = {'pid': rec.get('pid')}
                 doc[name.split('.', 1)[1]] = v
+            elif name == 'rpc.clock_offset_seconds':
+                clock_offsets[labels.get('replica', '?')] = v
         if doc is not None:
             workers[str(rec.get('host', '?'))] = doc
+    depths = [w['queue_depth'] for w in workers.values()
+              if isinstance(w.get('queue_depth'), (int, float))]
     return {
         'census_timeline': census_timeline,
         'scale_events': events,
         'replicas': replicas,
         'workers': workers,
+        'queue_depth_skew': round(max(depths) - min(depths), 6)
+        if depths else None,
+        'clock_offsets': clock_offsets,
         'totals': {k.split('.', 1)[1]: v for k, v in totals.items()},
         'hedge': hedge,
         'phases': derive_phases(records),
@@ -519,6 +530,15 @@ def render_fleet(records):
                             int(w.get('up', 0)),
                             int(w.get('ready', 0)),
                             w.get('queue_depth', '?')))
+        if doc.get('queue_depth_skew') is not None:
+            lines.append('   queue depth skew (max-min): %s'
+                         % doc['queue_depth_skew'])
+    if doc.get('clock_offsets'):
+        lines.append('== per-replica clock offsets (controller '
+                     'heartbeat estimate, s)')
+        for name in sorted(doc['clock_offsets']):
+            lines.append('   %-24s %+.*f' % (name, 6,
+                                             doc['clock_offsets'][name]))
     h = doc['hedge']
     if h:
         lines.append('== hedged requests vs retry budget')

@@ -132,9 +132,13 @@ def main(argv=None):
     from paddle_tpu import observe
     from paddle_tpu.serving import rpc
 
-    if cfg.get('metrics_jsonl'):
-        observe.enable(jsonl=cfg['metrics_jsonl'],
+    if cfg.get('metrics_jsonl') or cfg.get('trace_json'):
+        observe.enable(jsonl=cfg.get('metrics_jsonl'),
+                       trace=cfg.get('trace_json'),
                        every_secs=float(cfg.get('flush_every_s', 0.25)))
+    # label this process's span track for the merged fleet Perfetto
+    # view (tools/fleet_trace.py): pid -> replica name
+    observe.spans().set_process_name(name)
 
     engine = _build_engine(cfg)
     if callable(getattr(engine, 'warmup', None)):
@@ -143,7 +147,18 @@ def main(argv=None):
 
     stop = threading.Event()
     binding = rpc.serve_engine(engine, on_shutdown=stop.set)
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    # order matters: install OUR stop handler first, THEN arm the
+    # flight recorder — its SIGTERM handler dumps the postmortem and
+    # chains to the previously installed handler (stop.set), so a
+    # SIGTERM both leaves the dump AND exits the main loop cleanly
+    terminated = threading.Event()
+
+    def _on_sigterm(*_):
+        terminated.set()
+        stop.set()
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    if cfg.get('flight_dump'):
+        observe.arm_flight(path=cfg['flight_dump'])
 
     srv = observe.serve(port=int(cfg.get('port', 0)))
     observe.set_gauge('worker.up', 1, replica=name)
@@ -153,7 +168,13 @@ def main(argv=None):
                             'pid': os.getpid(), 'name': name})
 
     # heartbeat loop: worker.* gauges land in the JSONL so the parent's
-    # metrics_report --fleet renders a per-process census
+    # metrics_report --fleet renders a per-process census; on a
+    # snapshot cadence the flight ring re-dumps to the controller-known
+    # path, so even a SIGKILL (no handler runs) leaves the controller a
+    # recent postmortem of this worker's final seconds
+    import time as _time
+    snap_every = float(cfg.get('postmortem_snapshot_s', 1.0))
+    last_snap = _time.monotonic()
     try:
         while not stop.wait(0.25):
             observe.set_gauge('worker.ready', int(bool(engine.ready())),
@@ -161,6 +182,10 @@ def main(argv=None):
             observe.set_gauge('worker.queue_depth',
                               int(engine.queue_depth()), replica=name)
             observe.maybe_flush()
+            if cfg.get('flight_dump') and \
+                    _time.monotonic() - last_snap >= snap_every:
+                last_snap = _time.monotonic()
+                observe.flight_dump('heartbeat_snapshot')
     finally:
         binding.close()
         try:
@@ -168,8 +193,13 @@ def main(argv=None):
         except Exception:
             pass
         observe.set_gauge('worker.up', 0, replica=name)
+        if cfg.get('flight_dump') and not terminated.is_set():
+            # a SIGTERM already dumped with reason='sigterm' (via the
+            # arm_flight handler) — don't overwrite that with a clean
+            # worker_exit dump
+            observe.flight_dump('worker_exit')
         observe.stop_serving()
-        observe.disable()
+        observe.disable()                  # exports trace_json if set
     return 0
 
 

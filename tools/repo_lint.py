@@ -59,13 +59,18 @@ ENV_SCOPED_FILES = ('paddle_tpu/serving/router.py',
                     'paddle_tpu/serving/tenancy.py',
                     # PADDLE_TPU_SHARD_OPT_STATE (ISSUE 19) must stay
                     # a per-transpile read
-                    'paddle_tpu/parallel/transpiler.py')
+                    'paddle_tpu/parallel/transpiler.py',
+                    # fleet federation poll cadence
+                    # (PADDLE_TPU_FLEET_POLL_S) must stay a per-cycle
+                    # read so tests can speed it up live
+                    'paddle_tpu/observe/fleet.py')
 LINT_ROOT = 'paddle_tpu'
 
 # files OUTSIDE the lint root that still get the full env-scoped lint —
 # the replica worker entrypoint runs paddle_tpu code in a fresh process
 # and must not freeze env at import either
-EXTRA_ENV_SCOPED_FILES = ('tools/replica_worker.py',)
+EXTRA_ENV_SCOPED_FILES = ('tools/replica_worker.py',
+                          'tools/fleet_trace.py')
 
 _ENV_ATTRS = ('environ', 'getenv')
 _ENV_NAMES = ('environ', 'getenv', 'get_flag', 'FLAGS')
